@@ -1,0 +1,20 @@
+"""dllama-tpu: a TPU-native tensor-parallel LLM inference framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of Distributed Llama
+(reference: /root/reference, KMouratidis/distributed-llama): Llama-family
+inference with Q40 block-quantized weights, Q80-quantized activation exchange,
+tensor/sequence/data parallelism over a `jax.sharding.Mesh`, an OpenAI-compatible
+HTTP server, CLI frontends, and HF/Meta checkpoint converters.
+
+Layer map (see SURVEY.md §7.2 for what each replaces in the reference):
+  ops/        quantization primitives + compute kernels (jnp reference + Pallas TPU)
+  parallel/   mesh axes (dp/tp/sp/pp/ep), shardings, quantized collectives, ring attention
+  models/     Llama graph + `.m` model-file format
+  engine/     compiled prefill/decode steps, KV cache, sampler, host driver
+  tokenizer/  `.t` format, byte-level BPE, streaming decode, chat templates, EOS detection
+  serve/      OpenAI-compatible HTTP API server
+  cli/        `inference` / `chat` / `serve` frontends
+  tools/      HF / Meta / tokenizer converters, model downloader
+"""
+
+__version__ = "0.1.0"

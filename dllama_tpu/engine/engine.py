@@ -1,0 +1,174 @@
+"""Inference engine: compiled prefill/decode steps + host-side driver.
+
+Replaces the reference's executor/step-list machinery and RootLlmInference
+driver (nn-executor.cpp, app.cpp:131-195): XLA *is* the executor here — one
+jitted step function with a donated KV cache, driven by a host loop. The
+reference's per-forward control packet broadcast (app.cpp:161-173) has no
+analog: a pjit'd step over a mesh launches on all chips from one host call.
+
+Prefill is chunked in power-of-two widths so a prompt of any length compiles
+at most log2(max_chunk)+1 step variants (the reference instead fixes
+nBatches=32 and pads the final chunk; we never compute padded positions).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dllama_tpu.engine.sampling import Sampler
+from dllama_tpu.models.config import LlamaConfig
+from dllama_tpu.models.llama import KVCache, forward
+from dllama_tpu.ops.layers import build_rope_cache
+
+
+@dataclass
+class GenerationStats:
+    """Per-token timing in the reference's report shape (dllama.cpp:93-104)."""
+
+    prefill_tokens: int = 0
+    prefill_s: float = 0.0
+    decode_tokens: int = 0
+    decode_s: float = 0.0
+
+    @property
+    def prefill_tok_s(self) -> float:
+        return self.prefill_tokens / self.prefill_s if self.prefill_s else 0.0
+
+    @property
+    def decode_tok_s(self) -> float:
+        return self.decode_tokens / self.decode_s if self.decode_s else 0.0
+
+    def summary(self) -> str:
+        return (
+            f"Prefill: {self.prefill_tokens} tokens in {self.prefill_s*1000:.0f} ms "
+            f"({self.prefill_tok_s:.1f} tok/s)\n"
+            f"Decode:  {self.decode_tokens} tokens in {self.decode_s*1000:.0f} ms "
+            f"({self.decode_tok_s:.1f} tok/s, {1000*self.decode_s/max(1,self.decode_tokens):.2f} ms/token)"
+        )
+
+
+class InferenceEngine:
+    """Owns params + KV cache + compiled steps for one model replica.
+
+    `shardings` (optional, from parallel/sharding.py) carries the mesh and the
+    in/out shardings for the step function; without it everything runs on the
+    default device (single chip — the reference's `--workers`-less mode).
+    """
+
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        params,
+        batch: int = 1,
+        cache_dtype=jnp.bfloat16,
+        max_seq_len: int | None = None,
+        max_prefill_chunk: int = 128,
+        shardings=None,
+        donate_cache: bool = True,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.batch = batch
+        self.seq_len = min(max_seq_len or cfg.seq_len, cfg.seq_len)
+        self.max_prefill_chunk = max_prefill_chunk
+        self.shardings = shardings
+        self.rope_cache = build_rope_cache(cfg, self.seq_len)
+        self.cache = KVCache.create(cfg, batch, cache_dtype, self.seq_len)
+        self.pos = 0
+
+        if shardings is not None:
+            self.params = shardings.put_params(self.params)
+            self.cache = shardings.put_cache(self.cache)
+            self.rope_cache = shardings.put_replicated(self.rope_cache)
+
+        donate = (1,) if donate_cache else ()
+        self._step = jax.jit(partial(self._step_impl, cfg), donate_argnums=donate)
+
+    @staticmethod
+    def _step_impl(cfg, params, cache, tokens, pos, rope_cache):
+        logits, cache = forward(cfg, params, tokens, pos, cache, rope_cache)
+        return logits[:, -1], cache
+
+    # ------------------------------------------------------------------ core
+
+    def step(self, tokens: np.ndarray) -> jax.Array:
+        """Run T tokens at the current position; returns last-pos logits [B, V]."""
+        t = tokens.shape[1]
+        if self.pos + t > self.seq_len:
+            raise ValueError(f"position {self.pos}+{t} exceeds seq_len {self.seq_len}")
+        logits, self.cache = self._step(
+            self.params, self.cache, jnp.asarray(tokens, jnp.int32), jnp.int32(self.pos), self.rope_cache
+        )
+        self.pos += t
+        return logits
+
+    def reset(self, pos: int = 0) -> None:
+        """Rewind to `pos` (prefix-cache reuse keeps cache contents ≤ pos valid)."""
+        self.pos = pos
+
+    def prefill(self, tokens: np.ndarray) -> jax.Array:
+        """Chunked prefill; returns logits after the last token."""
+        tokens = np.atleast_2d(np.asarray(tokens, dtype=np.int32))
+        n = tokens.shape[1]
+        if n == 0:
+            raise ValueError("prompt must be non-empty")
+        logits = None
+        off = 0
+        while off < n:
+            chunk = min(self.max_prefill_chunk, 1 << (n - off - 1).bit_length())
+            while chunk > n - off:
+                chunk //= 2
+            logits = self.step(tokens[:, off : off + chunk])
+            off += chunk
+        return logits
+
+    def decode_step(self, tokens: np.ndarray) -> jax.Array:
+        return self.step(np.asarray(tokens, dtype=np.int32).reshape(self.batch, 1))
+
+    # ------------------------------------------------------------- generation
+
+    def generate(
+        self,
+        prompt_tokens: list[int],
+        max_tokens: int,
+        sampler: Sampler,
+        stop_fn: Callable[[int], bool] | None = None,
+        stats: GenerationStats | None = None,
+    ) -> Iterator[int]:
+        """Greedy host loop: prefill the prompt, then decode token by token.
+
+        Yields each generated token id; stops at max_tokens, seq_len, or when
+        `stop_fn(token)` returns True (EOS detection lives in the tokenizer
+        layer, as in the reference).
+        """
+        assert self.batch == 1, "generate() drives a single sequence; use step() for batches"
+        t0 = time.perf_counter()
+        logits = self.prefill(np.asarray([prompt_tokens], dtype=np.int32))
+        token = int(sampler(logits)[0])
+        jax.block_until_ready(logits)
+        t1 = time.perf_counter()
+        if stats is not None:
+            stats.prefill_tokens += len(prompt_tokens)
+            stats.prefill_s += t1 - t0
+
+        produced = 0
+        while True:
+            yield token
+            produced += 1
+            if produced >= max_tokens or self.pos >= self.seq_len:
+                break
+            if stop_fn is not None and stop_fn(token):
+                break
+            t2 = time.perf_counter()
+            logits = self.decode_step(np.array([[token]]))
+            token = int(sampler(logits)[0])
+            if stats is not None:
+                stats.decode_tokens += 1
+                stats.decode_s += time.perf_counter() - t2

@@ -1,0 +1,55 @@
+"""On-device token sampling: greedy / temperature / top-p nucleus.
+
+Semantics follow the reference Sampler (tokenizer.cpp:332-453): temp==0 is
+argmax; otherwise softmax(logits/temp) then plain multinomial, or top-p
+truncation when 0 < topp < 1. RNG is jax.random (threefry) seeded from the
+user seed rather than the reference's xorshift — sequences are seedable and
+reproducible, but not bit-identical to the C++ RNG.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("temperature", "topp"))
+def sample(logits: jax.Array, key: jax.Array, temperature: float = 0.8, topp: float = 0.9) -> jax.Array:
+    """logits f32 [B, V] -> tokens i32 [B]."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if 0.0 < topp < 1.0:
+        probs = jax.nn.softmax(logits, axis=-1)
+        sorted_probs = jnp.sort(probs, axis=-1, descending=True)
+        cum = jnp.cumsum(sorted_probs, axis=-1)
+        # keep tokens while the cumulative mass *before* them is < topp
+        # (i.e. include the token that first crosses topp, like sample_topp's
+        # break-after-include, tokenizer.cpp:389-395)
+        keep_sorted = (cum - sorted_probs) < topp
+        threshold = jnp.min(
+            jnp.where(keep_sorted, sorted_probs, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(probs >= threshold, logits, -jnp.inf)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+class Sampler:
+    """Stateful host-side wrapper (the analog of the reference Sampler object)."""
+
+    def __init__(self, temperature: float = 0.8, topp: float = 0.9, seed: int = 0):
+        self.temperature = float(temperature)
+        self.topp = float(topp)
+        self.key = jax.random.PRNGKey(seed)
+
+    def set_seed(self, seed: int) -> None:
+        self.key = jax.random.PRNGKey(seed)
+
+    def set_temp(self, temperature: float) -> None:
+        self.temperature = float(temperature)
+
+    def __call__(self, logits: jax.Array) -> jax.Array:
+        self.key, sub = jax.random.split(self.key)
+        return sample(logits, sub, self.temperature, self.topp)

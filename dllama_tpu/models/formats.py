@@ -1,0 +1,201 @@
+"""`.m` model-file reader/writer.
+
+File schema (llm.cpp:26-98, converter/writer.py:109-143): i32 magic
+0xA00ABCD, i32 headerSize, (key,value) i32 pairs, then raw tensors in fixed
+order (llm.cpp:453-468):
+
+  embedding f32 [vocab, dim]
+  per layer: q [dim,dim] k [kv_dim,dim] v [kv_dim,dim] wo [dim,dim]
+             w1 [hidden,dim] w2 [dim,hidden] w3 [hidden,dim]   (weight_type)
+             rms_norm_0 f32 [dim], rms_norm_1 f32 [dim]
+  final_rms_norm f32 [dim]
+  wcls [vocab, dim]                                            (weight_type)
+
+Matmul tensors are stored [out, in] row-major; we load them as transposed
+``x @ W`` operands ([in, out]) — Q40 becomes a :class:`QTensor`, f32/f16
+become dense arrays. Where the reference root slices each tensor and ships
+shards to workers over TCP (nn-network.cpp:775-869), here every tensor is
+`jax.device_put` with its mesh sharding — XLA/ICI replaces the wire protocol.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dllama_tpu.models.config import MODEL_MAGIC, LlamaConfig
+from dllama_tpu.ops.quant import (
+    FloatType,
+    Q_BLOCK,
+    QTensor,
+    dequantize_q40_np,
+    quantize_q40_np,
+)
+
+
+def read_header(path: str, max_seq_len: int | None = None) -> tuple[LlamaConfig, int]:
+    """Returns (config, header_size_bytes). Mirrors loadLlmHeader (llm.cpp:26-98)."""
+    with open(path, "rb") as f:
+        magic = struct.unpack("<i", f.read(4))[0]
+        if magic in (0xABCD00, 0xABCD01):
+            raise ValueError("old model format is not supported")
+        if magic != MODEL_MAGIC:
+            raise ValueError(f"unsupported magic number: {magic:#x}")
+        header_size = struct.unpack("<i", f.read(4))[0]
+        n_kv = (header_size - 8) // 4 // 2
+        kv = []
+        for _ in range(n_kv):
+            key, value = struct.unpack("<ii", f.read(8))
+            kv.append((key, value))
+    config = LlamaConfig.from_header_kv(kv)
+    return config.clamp_seq_len(max_seq_len), header_size
+
+
+def write_header(f, config: LlamaConfig) -> int:
+    kv = config.to_header_kv()
+    header = struct.pack("<ii", MODEL_MAGIC, 8 + len(kv) * 8)
+    body = b"".join(struct.pack("<ii", k, v) for k, v in kv)
+    f.write(header + body)
+    return len(header) + len(body)
+
+
+def tensor_plan(config: LlamaConfig) -> list[tuple[str, tuple[int, int] | tuple[int], FloatType]]:
+    """(name, file_shape, float_type) in on-disk order (llm.cpp:453-468)."""
+    wt = config.weight_type
+    plan: list = [("embedding", (config.vocab_size, config.dim), FloatType.F32)]
+    for layer in range(config.n_layers):
+        plan += [
+            (f"layers.{layer}.wq", (config.dim, config.dim), wt),
+            (f"layers.{layer}.wk", (config.kv_dim, config.dim), wt),
+            (f"layers.{layer}.wv", (config.kv_dim, config.dim), wt),
+            (f"layers.{layer}.wo", (config.dim, config.dim), wt),
+            (f"layers.{layer}.w1", (config.hidden_dim, config.dim), wt),
+            (f"layers.{layer}.w2", (config.dim, config.hidden_dim), wt),
+            (f"layers.{layer}.w3", (config.hidden_dim, config.dim), wt),
+            (f"layers.{layer}.rms_att", (config.dim,), FloatType.F32),
+            (f"layers.{layer}.rms_ffn", (config.dim,), FloatType.F32),
+        ]
+    plan += [
+        ("final_norm", (config.dim,), FloatType.F32),
+        ("wcls", (config.vocab_size, config.dim), wt),
+    ]
+    return plan
+
+
+def write_tensor(f, x: np.ndarray, float_type: FloatType) -> int:
+    """Serialize a tensor in the reference byte format (writer.py:29-107)."""
+    flat = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
+    if float_type == FloatType.F32:
+        buf = flat.tobytes()
+    elif float_type == FloatType.F16:
+        buf = flat.astype(np.float16).tobytes()
+    elif float_type == FloatType.Q40:
+        packed, scales = quantize_q40_np(flat)
+        rec = np.zeros((packed.shape[0], 2 + Q_BLOCK // 2), dtype=np.uint8)
+        rec[:, :2] = scales.reshape(-1, 1).view(np.uint8)
+        rec[:, 2:] = packed
+        buf = rec.tobytes()
+    else:
+        raise ValueError(f"unsupported weight type: {float_type}")
+    f.write(buf)
+    return len(buf)
+
+
+def save_model(path: str, config: LlamaConfig, tensors: dict[str, np.ndarray]) -> None:
+    """Write a complete `.m` file; `tensors` maps plan names to file-shape arrays."""
+    with open(path, "wb") as f:
+        write_header(f, config)
+        for name, shape, ft in tensor_plan(config):
+            x = tensors[name]
+            assert tuple(x.shape) == tuple(shape), (name, x.shape, shape)
+            write_tensor(f, x, ft)
+
+
+def iter_tensors(path: str, config: LlamaConfig, header_size: int) -> Iterator[tuple[str, tuple, FloatType, np.ndarray]]:
+    """Yield (name, file_shape, float_type, raw_bytes_view) per plan entry.
+
+    Uses a read-only memmap — the analog of the reference's mmap weight load
+    (mmap.hpp:35-70); no copy happens until a tensor is decoded.
+    """
+    data = np.memmap(path, dtype=np.uint8, mode="r")
+    offset = header_size
+    for name, shape, ft in tensor_plan(config):
+        n = int(np.prod(shape))
+        nbytes = ft.nbytes(n)
+        yield name, shape, ft, data[offset : offset + nbytes]
+        offset += nbytes
+    if offset != data.shape[0]:
+        raise ValueError(f"model file size mismatch: consumed {offset}, file has {data.shape[0]}")
+
+
+def decode_dense(raw: np.ndarray, shape: tuple, ft: FloatType) -> np.ndarray:
+    """Decode raw bytes to an f32 array of `shape`."""
+    if ft == FloatType.F32:
+        return raw.view(np.float32).reshape(shape)
+    if ft == FloatType.F16:
+        return raw.view(np.float16).reshape(shape).astype(np.float32)
+    if ft == FloatType.Q40:
+        n = int(np.prod(shape))
+        rec = raw.reshape(n // Q_BLOCK, 2 + Q_BLOCK // 2)
+        scales = rec[:, :2].copy().view(np.float16).reshape(-1)
+        packed = rec[:, 2:]
+        return dequantize_q40_np(packed, scales).reshape(shape)
+    raise ValueError(f"unsupported weight type: {ft}")
+
+
+def _load_matmul(raw: np.ndarray, shape: tuple[int, int], ft: FloatType, dtype, dequantize: bool):
+    """File [out, in] -> x@W operand: QTensor or dense [in, out]."""
+    n_out, k_in = shape
+    if ft == FloatType.Q40 and not dequantize:
+        rec = raw.reshape(n_out * k_in // Q_BLOCK, 2 + Q_BLOCK // 2)
+        scales = rec[:, :2].copy().view(np.float16)
+        packed = rec[:, 2:]
+        return QTensor.from_file_layout(packed, scales, n_out, k_in)
+    return jnp.asarray(decode_dense(raw, shape, ft).T.astype(dtype))
+
+
+def load_params(
+    path: str,
+    config: LlamaConfig,
+    header_size: int,
+    dtype=jnp.bfloat16,
+    dequantize: bool = False,
+    put: Callable[[str, object], object] | None = None,
+):
+    """Load the full parameter pytree.
+
+    Per-layer tensors are stacked on a leading layer axis so the model can
+    `lax.scan` over layers (one XLA while-loop instead of n_layers copies of
+    the graph — the TPU analog of the reference's per-layer segment list).
+
+    `put(name, array)` lets the caller device_put each leaf with a sharding
+    (see parallel/sharding.py); default is plain host->default-device.
+    """
+    put = put or (lambda name, x: x)
+    layer_acc: dict[str, list] = {}
+    params: dict = {}
+    for name, shape, ft, raw in iter_tensors(path, config, header_size):
+        if name in ("embedding",):
+            params["embedding"] = put(name, jnp.asarray(decode_dense(raw, shape, ft).astype(dtype)))
+        elif name in ("final_norm",):
+            params["final_norm"] = put(name, jnp.asarray(decode_dense(raw, shape, ft)))
+        elif name == "wcls":
+            params["wcls"] = put(name, _load_matmul(raw, shape, ft, dtype, dequantize))
+        else:
+            _, _, short = name.split(".")
+            if short in ("rms_att", "rms_ffn"):
+                leaf = jnp.asarray(decode_dense(raw, shape, ft))
+            else:
+                leaf = _load_matmul(raw, shape, ft, dtype, dequantize)
+            layer_acc.setdefault(short, []).append(leaf)
+
+    layers = {}
+    for short, leaves in layer_acc.items():
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *leaves)
+        layers[short] = put(f"layers.{short}", stacked)
+    params["layers"] = layers
+    return params

@@ -1,0 +1,138 @@
+"""Llama decoder forward pass — the graph the reference builds as data
+(buildLlmNet, llm.cpp:125-436) expressed as one scanned, jittable function.
+
+Per layer (mirrors the reference's att+ff segments, SURVEY.md §3.4):
+  x += wo( attention( rope(q), rope(k)→cache, v→cache ) )   [att segment]
+  x += w2( act(w1 h) * w3 h )                               [ff segment]
+with pre-RMSNorm before each block. The reference's SYNC_NODE_SLICES
+all-gathers don't appear here — under pjit the tensor-parallel collectives are
+inserted by XLA from the weight/cache shardings (parallel/sharding.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from dllama_tpu.models.config import LlamaConfig
+from dllama_tpu.ops.layers import activation, apply_rope, gqa_attention, rms_norm
+from dllama_tpu.ops.matmul import matmul
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class KVCache:
+    """[n_layers, batch, n_kv_heads, seq_len, head_size] per tensor.
+
+    Functional stand-in for the reference's per-layer k/v buffers written
+    through position-indexed dynamic pointers (nn-cpu.cpp:198-222); here the
+    write is a donated dynamic_update_slice at pos, which XLA turns into an
+    in-place HBM update.
+    """
+
+    k: jax.Array
+    v: jax.Array
+
+    def tree_flatten(self):
+        return (self.k, self.v), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @classmethod
+    def create(cls, cfg: LlamaConfig, batch: int, dtype=jnp.bfloat16, seq_len: int | None = None):
+        shape = (cfg.n_layers, batch, cfg.n_kv_heads, seq_len or cfg.seq_len, cfg.head_size)
+        return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+    @property
+    def seq_len(self) -> int:
+        return self.k.shape[3]
+
+
+def _layer(cfg: LlamaConfig, x, lp, k_cache, v_cache, rope, pos_base):
+    b, t, d = x.shape
+    # --- attention block (reference "att" segment, llm.cpp:198-312)
+    h = rms_norm(x, lp["rms_att"], cfg.norm_epsilon)
+    q = matmul(h, lp["wq"]).reshape(b, t, cfg.n_heads, cfg.head_size)
+    k = matmul(h, lp["wk"]).reshape(b, t, cfg.n_kv_heads, cfg.head_size)
+    v = matmul(h, lp["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.head_size)
+    q = apply_rope(q, rope)
+    k = apply_rope(k, rope)
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.transpose(0, 2, 1, 3).astype(k_cache.dtype), (0, 0, pos_base, 0)
+    )
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.transpose(0, 2, 1, 3).astype(v_cache.dtype), (0, 0, pos_base, 0)
+    )
+    att = gqa_attention(q, k_cache, v_cache, pos_base).reshape(b, t, d)
+    x = x + matmul(att, lp["wo"])
+    # --- feed-forward block (reference "ff" segment, llm.cpp:314-385)
+    h = rms_norm(x, lp["rms_ffn"], cfg.norm_epsilon)
+    gate = activation(matmul(h, lp["w1"]).astype(jnp.float32), cfg.hidden_act).astype(x.dtype)
+    up = matmul(h, lp["w3"])
+    x = x + matmul(gate * up, lp["w2"])
+    return x, k_cache, v_cache
+
+
+def forward(
+    cfg: LlamaConfig,
+    params: dict,
+    tokens: jax.Array,  # i32 [B, T]
+    pos_base: jax.Array,  # scalar i32
+    cache: KVCache,
+    rope_cache: jax.Array,  # [seq, head_size/2, 2]
+) -> tuple[jax.Array, KVCache]:
+    """Returns (logits f32 [B, T, vocab], updated cache)."""
+    x = params["embedding"][tokens]  # [B, T, D]
+    t = tokens.shape[1]
+    rope = jax.lax.dynamic_slice_in_dim(rope_cache, pos_base, t, axis=0)
+
+    def scan_fn(carry, xs):
+        x = carry
+        lp, kc, vc = xs
+        x, kc, vc = _layer(cfg, x, lp, kc, vc, rope, pos_base)
+        return x, (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(scan_fn, x, (params["layers"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_epsilon)
+    logits = matmul(x, params["wcls"]).astype(jnp.float32)
+    return logits, KVCache(k_new, v_new)
+
+
+def random_params(cfg: LlamaConfig, seed: int = 0, dtype=jnp.bfloat16, quantize: bool = True):
+    """Random-initialized parameter pytree in the same structure load_params
+    produces — for tests and synthetic benchmarks (no real checkpoint needed)."""
+    import numpy as np
+
+    from dllama_tpu.ops.quant import QTensor
+
+    rng = np.random.default_rng(seed)
+
+    def w(k, n):
+        x = (rng.standard_normal((k, n)) * 0.02).astype(np.float32)
+        return QTensor.quantize(x) if quantize else jnp.asarray(x, dtype)
+
+    def stack(fn):
+        leaves = [fn() for _ in range(cfg.n_layers)]
+        return jax.tree.map(lambda *xs: jnp.stack(xs, 0), *leaves)
+
+    params = {
+        "embedding": jnp.asarray(rng.standard_normal((cfg.vocab_size, cfg.dim)) * 0.02, dtype),
+        "final_norm": jnp.ones((cfg.dim,), jnp.float32),
+        "wcls": w(cfg.dim, cfg.vocab_size),
+        "layers": {
+            "wq": stack(lambda: w(cfg.dim, cfg.dim)),
+            "wk": stack(lambda: w(cfg.dim, cfg.kv_dim)),
+            "wv": stack(lambda: w(cfg.dim, cfg.kv_dim)),
+            "wo": stack(lambda: w(cfg.dim, cfg.dim)),
+            "w1": stack(lambda: w(cfg.dim, cfg.hidden_dim)),
+            "w2": stack(lambda: w(cfg.hidden_dim, cfg.dim)),
+            "w3": stack(lambda: w(cfg.dim, cfg.hidden_dim)),
+            "rms_att": stack(lambda: jnp.ones((cfg.dim,), jnp.float32)),
+            "rms_ffn": stack(lambda: jnp.ones((cfg.dim,), jnp.float32)),
+        },
+    }
+    return params

@@ -1,0 +1,12 @@
+from dllama_tpu.ops.quant import (  # noqa: F401
+    FloatType,
+    Q_BLOCK,
+    QTensor,
+    dequantize_q40_np,
+    dequantize_q80_jnp,
+    dequantize_q80_np,
+    parse_float_type,
+    quantize_q40_np,
+    quantize_q80_jnp,
+    quantize_q80_np,
+)

@@ -1,0 +1,115 @@
+"""Core model ops: RMSNorm, RoPE, GQA attention, activations.
+
+jnp reference implementations — under jit XLA fuses these into the surrounding
+matmuls; Pallas variants exist only where fusion isn't enough (see ops/pallas/).
+Numerics follow the reference kernels (nn-cpu-ops.cpp): norms, softmax and
+attention accumulate in f32 regardless of activation dtype.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dllama_tpu.models.config import HiddenAct, LlamaConfig, RopeType
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    """y = x * w / rms(x) with f32 accumulation (nn-cpu-ops.cpp:108-183)."""
+    xf = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * inv * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def activation(x: jax.Array, act: HiddenAct) -> jax.Array:
+    if act == HiddenAct.SILU:
+        return jax.nn.silu(x)
+    return jax.nn.gelu(x, approximate=False)
+
+
+def llama31_scale_freqs(freqs: np.ndarray, cfg: LlamaConfig) -> np.ndarray:
+    """Llama-3.1 NTK-by-parts frequency scaling.
+
+    Note: the reference applies this scaling to the *rotated output values*
+    (nn-cpu-ops.cpp:1139-1153), which deviates from Meta's reference model
+    (and from every HF checkpoint's training-time rope). We implement the
+    correct frequency-domain scaling; SURVEY.md §7.4.3 flags this as a
+    reference idiosyncrasy we chose to fix, not reproduce.
+    """
+    wavelen = 2.0 * math.pi / freqs
+    high_freq_wavelen = cfg.rope_scaling_orig_max_seq_len / cfg.rope_scaling_high_freq_factor
+    low_freq_wavelen = cfg.rope_scaling_orig_max_seq_len / cfg.rope_scaling_low_freq_factor
+    scaled = freqs / cfg.rope_scaling_factor
+    smooth = (cfg.rope_scaling_orig_max_seq_len / wavelen - cfg.rope_scaling_low_freq_factor) / (
+        cfg.rope_scaling_high_freq_factor - cfg.rope_scaling_low_freq_factor
+    )
+    smoothed = (1 - smooth) * scaled + smooth * freqs
+    out = np.where(wavelen < high_freq_wavelen, freqs, np.where(wavelen > low_freq_wavelen, scaled, smoothed))
+    return out.astype(np.float32)
+
+
+def build_rope_cache(cfg: LlamaConfig, seq_len: int | None = None) -> jax.Array:
+    """Precomputed [seq_len, head_size/2, 2] (cos, sin) table, f32.
+
+    The analog of the reference's per-node rope_cache buffer
+    (nn-cpu-ops.cpp:1082-1102), computed for the *interleaved-pair* layout the
+    `.m` format stores Q/K in (converter permutation, convert-hf.py:11-14).
+    """
+    seq_len = seq_len or cfg.seq_len
+    half = cfg.head_size // 2
+    freqs = 1.0 / (cfg.rope_theta ** (np.arange(half, dtype=np.float64) * 2.0 / cfg.head_size))
+    freqs = freqs.astype(np.float32)
+    if cfg.rope_type == RopeType.LLAMA3_1 and cfg.rope_scaling_factor != 1.0:
+        freqs = llama31_scale_freqs(freqs, cfg)
+    t = np.arange(seq_len, dtype=np.float32)
+    angles = np.outer(t, freqs)  # [S, half]
+    cache = np.stack([np.cos(angles), np.sin(angles)], axis=-1)
+    return jnp.asarray(cache, dtype=jnp.float32)
+
+
+def apply_rope(x: jax.Array, rope: jax.Array) -> jax.Array:
+    """Rotate interleaved pairs: x[..., 2i], x[..., 2i+1] by angle pos*freq_i.
+
+    x: [B, T, H, head_size]; rope: [T, head_size/2, 2] rows already gathered
+    for the absolute positions of the T tokens.
+    """
+    b, t, h, hs = x.shape
+    xf = x.astype(jnp.float32).reshape(b, t, h, hs // 2, 2)
+    cos = rope[None, :, None, :, 0]
+    sin = rope[None, :, None, :, 1]
+    x0, x1 = xf[..., 0], xf[..., 1]
+    r0 = x0 * cos - x1 * sin
+    r1 = x0 * sin + x1 * cos
+    return jnp.stack([r0, r1], axis=-1).reshape(b, t, h, hs).astype(x.dtype)
+
+
+def gqa_attention(
+    q: jax.Array,  # [B, T, Hq, hd]
+    k_cache: jax.Array,  # [B, Hkv, S, hd]
+    v_cache: jax.Array,  # [B, Hkv, S, hd]
+    pos_base: jax.Array,  # scalar i32: absolute position of query 0
+) -> jax.Array:
+    """Causal GQA over the full KV cache (nn-cpu-ops.cpp:752-787 equivalent).
+
+    Query t attends to cache slots s <= pos_base + t; unwritten future slots
+    are masked out, so the cache can stay a fixed [S]-sized ring without
+    dynamic shapes (XLA needs static shapes; the mask replaces the
+    reference's `t = 0..pos` loop bound).
+    """
+    b, t, hq, hd = q.shape
+    hkv, s = k_cache.shape[1], k_cache.shape[2]
+    g = hq // hkv
+    qf = q.astype(jnp.float32).reshape(b, t, hkv, g, hd)
+    kf = k_cache.astype(jnp.float32)
+    vf = v_cache.astype(jnp.float32)
+    scores = jnp.einsum("bthgd,bhsd->bhgts", qf, kf) / math.sqrt(hd)
+    spans = jax.lax.broadcasted_iota(jnp.int32, (t, s), 1)
+    limit = pos_base + jax.lax.broadcasted_iota(jnp.int32, (t, s), 0)
+    mask = spans <= limit
+    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgts,bhsd->bthgd", probs, vf)
+    return out.reshape(b, t, hq, hd).astype(q.dtype)

@@ -1,0 +1,249 @@
+"""Q40 / Q80 block quantization as JAX-native array-of-struct-of-arrays.
+
+Reproduces the numeric formats of the reference (nn-quants.hpp:51-67,
+converter/writer.py:29-74) in an idiomatic-TPU layout:
+
+* **Q40**: 32-element blocks, one fp16 scale per block, 4-bit codes with offset
+  -8. File layout is row-major ``[out, in/32]`` blocks of ``{f16 scale, 16
+  bytes}`` where byte ``j`` packs code ``j`` (low nibble) and code ``j+16``
+  (high nibble). On device we store the transpose — ``packed: u8[in/2, out]``,
+  ``scales: f16[in/32, out]`` — so that a matmul ``x @ W`` streams weight
+  columns contiguously along the MXU lane dimension.
+* **Q80**: 32-element blocks, fp16 scale, int8 codes (round-to-nearest). Used
+  for the quantized activation exchange (the reference's ZQ pipe /
+  ``--buffer-float-type q80``).
+
+Quantize math (must match converter/writer.py:29-74 bit-for-bit so files
+interoperate):
+  Q40: delta = (signed value with max |.|) / -8 ; q = clip(floor(x/delta + 8.5), 0, 15)
+  Q80: delta = absmax / 127              ; q = round(x/delta)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from enum import IntEnum
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Q_BLOCK = 32  # block size shared by Q40 and Q80 (nn-quants.hpp:59-67)
+
+
+class FloatType(IntEnum):
+    """Wire/file float-type ids (nn-quants.hpp:51-57, writer.py:6-10)."""
+
+    F32 = 0
+    F16 = 1
+    Q40 = 2
+    Q80 = 3
+    # dllama-tpu extension: bf16 on-device weights (not in reference format).
+    BF16 = 100
+
+    @property
+    def bytes_per_block(self) -> int:
+        return {
+            FloatType.F32: 4 * Q_BLOCK,
+            FloatType.F16: 2 * Q_BLOCK,
+            FloatType.BF16: 2 * Q_BLOCK,
+            FloatType.Q40: 2 + Q_BLOCK // 2,
+            FloatType.Q80: 2 + Q_BLOCK,
+        }[self]
+
+    def nbytes(self, n_elements: int) -> int:
+        assert n_elements % Q_BLOCK == 0 or self in (FloatType.F32, FloatType.F16)
+        if self in (FloatType.F32, FloatType.BF16):
+            return {FloatType.F32: 4, FloatType.BF16: 2}[self] * n_elements
+        if self == FloatType.F16:
+            return 2 * n_elements
+        return (n_elements // Q_BLOCK) * self.bytes_per_block
+
+
+def parse_float_type(name: str) -> FloatType:
+    try:
+        return FloatType[name.upper()]
+    except KeyError:
+        raise ValueError(f"unsupported float type: {name!r}") from None
+
+
+# ---------------------------------------------------------------------------
+# numpy side: file <-> array codecs (used by converters and the .m loader)
+# ---------------------------------------------------------------------------
+
+
+def quantize_q40_np(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """f32[..., K] -> (packed u8[..., K/32, 16], scales f16[..., K/32]).
+
+    Matches converter/writer.py:29-53 exactly (including the floor-after-+8.5
+    rounding and the where(-min > max) tie-break).
+    """
+    shape = x.shape
+    assert shape[-1] % Q_BLOCK == 0, shape
+    g = x.astype(np.float32).reshape(*shape[:-1], -1, Q_BLOCK)
+    gmax = g.max(axis=-1)
+    gmin = g.min(axis=-1)
+    deltas = np.where(-gmin > gmax, gmin, gmax) / -8.0
+    deltas16 = deltas.astype(np.float16)
+    inv = np.where(deltas != 0, 1.0 / deltas, 0.0)
+    q = np.clip(g * inv[..., None] + 8.5, 0, 15).astype(np.uint8)
+    packed = q[..., : Q_BLOCK // 2] | (q[..., Q_BLOCK // 2 :] << 4)
+    return packed, deltas16
+
+
+def dequantize_q40_np(packed: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    """(packed u8[..., B, 16], scales f16[..., B]) -> f32[..., B*32]."""
+    lo = (packed & 0x0F).astype(np.int8) - 8
+    hi = (packed >> 4).astype(np.int8) - 8
+    codes = np.concatenate([lo, hi], axis=-1).astype(np.float32)
+    out = codes * scales[..., None].astype(np.float32)
+    return out.reshape(*packed.shape[:-2], packed.shape[-2] * Q_BLOCK)
+
+
+def quantize_q80_np(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """f32[..., K] -> (codes i8[..., K/32, 32], scales f16[..., K/32])."""
+    shape = x.shape
+    assert shape[-1] % Q_BLOCK == 0, shape
+    g = x.astype(np.float32).reshape(*shape[:-1], -1, Q_BLOCK)
+    absmax = np.abs(g).max(axis=-1)
+    deltas = absmax / 127.0
+    deltas16 = deltas.astype(np.float16)
+    inv = np.where(deltas != 0, 1.0 / deltas, 0.0)
+    codes = np.round(g * inv[..., None]).astype(np.int8)
+    return codes, deltas16
+
+
+def dequantize_q80_np(codes: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    out = codes.astype(np.float32) * scales[..., None].astype(np.float32)
+    return out.reshape(*codes.shape[:-2], codes.shape[-2] * Q_BLOCK)
+
+
+def q40_to_bytes(packed: np.ndarray, scales: np.ndarray) -> bytes:
+    """Serialize to the reference's on-disk block stream {f16 scale, 16 bytes}."""
+    nb = packed.reshape(-1, Q_BLOCK // 2).shape[0]
+    rec = np.zeros((nb, 2 + Q_BLOCK // 2), dtype=np.uint8)
+    rec[:, :2] = scales.reshape(-1, 1).view(np.uint8).reshape(nb, 2)
+    rec[:, 2:] = packed.reshape(nb, Q_BLOCK // 2)
+    return rec.tobytes()
+
+
+def q40_from_bytes(buf: bytes, n_elements: int) -> tuple[np.ndarray, np.ndarray]:
+    nb = n_elements // Q_BLOCK
+    rec = np.frombuffer(buf, dtype=np.uint8, count=nb * (2 + Q_BLOCK // 2)).reshape(
+        nb, 2 + Q_BLOCK // 2
+    )
+    scales = rec[:, :2].copy().view(np.float16).reshape(nb)
+    packed = rec[:, 2:].copy()
+    return packed, scales
+
+
+def q80_to_bytes(codes: np.ndarray, scales: np.ndarray) -> bytes:
+    nb = codes.reshape(-1, Q_BLOCK).shape[0]
+    rec = np.zeros((nb, 2 + Q_BLOCK), dtype=np.uint8)
+    rec[:, :2] = scales.reshape(-1, 1).view(np.uint8).reshape(nb, 2)
+    rec[:, 2:] = codes.reshape(nb, Q_BLOCK).view(np.uint8)
+    return rec.tobytes()
+
+
+def q80_from_bytes(buf: bytes, n_elements: int) -> tuple[np.ndarray, np.ndarray]:
+    nb = n_elements // Q_BLOCK
+    rec = np.frombuffer(buf, dtype=np.uint8, count=nb * (2 + Q_BLOCK)).reshape(
+        nb, 2 + Q_BLOCK
+    )
+    scales = rec[:, :2].copy().view(np.float16).reshape(nb)
+    codes = rec[:, 2:].copy().view(np.int8)
+    return codes, scales
+
+
+# ---------------------------------------------------------------------------
+# device side: QTensor pytree (Q40 weight resident in HBM)
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QTensor:
+    """A Q40 2-D weight for ``x @ W`` with ``W: [k_in, n_out]`` logical shape.
+
+    ``packed: u8[k/2, n]`` — row ``16*b + j`` packs codes for input dims
+    ``32*b + j`` (low nibble) and ``32*b + j + 16`` (high nibble).
+    ``scales: f16[k/32, n]``.
+
+    The lane (last) dimension is the *output* dim, so Pallas kernels stream
+    128-wide output tiles straight onto MXU lanes; the reference instead keeps
+    per-output-row blocks (nn-quants.hpp:59-62) because its GEMV walks rows.
+    """
+
+    packed: jax.Array  # u8 [k//2, n]
+    scales: jax.Array  # f16 [k//32, n]
+
+    def tree_flatten(self):
+        return (self.packed, self.scales), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self) -> tuple[int, int]:
+        return (self.packed.shape[0] * 2, self.packed.shape[1])
+
+    @property
+    def k(self) -> int:
+        return self.packed.shape[0] * 2
+
+    @property
+    def n(self) -> int:
+        return self.packed.shape[1]
+
+    @classmethod
+    def quantize(cls, w) -> "QTensor":
+        """f32[k, n] -> QTensor (numpy path; used by tests and converters)."""
+        w = np.asarray(w, dtype=np.float32)
+        packed, scales = quantize_q40_np(np.ascontiguousarray(w.T))  # [n, k/32, 16]
+        k = w.shape[0]
+        packed = np.transpose(packed, (1, 2, 0)).reshape(k // 2, w.shape[1])
+        scales = np.transpose(scales, (1, 0))
+        return cls(jnp.asarray(packed), jnp.asarray(scales))
+
+    @classmethod
+    def from_file_layout(cls, packed: np.ndarray, scales: np.ndarray, n_out: int, k_in: int) -> "QTensor":
+        """Build from the `.m` on-disk layout: blocks row-major over [n_out, k_in]."""
+        packed = packed.reshape(n_out, k_in // Q_BLOCK, Q_BLOCK // 2)
+        scales = scales.reshape(n_out, k_in // Q_BLOCK)
+        packed = np.ascontiguousarray(np.transpose(packed, (1, 2, 0))).reshape(k_in // 2, n_out)
+        scales = np.ascontiguousarray(np.transpose(scales, (1, 0)))
+        return cls(jnp.asarray(packed), jnp.asarray(scales))
+
+    def dequantize(self, dtype=jnp.float32) -> jax.Array:
+        """Pure-jnp reference dequant -> [k, n] (the XLA fallback path)."""
+        k, n = self.shape
+        p = self.packed.reshape(k // Q_BLOCK, Q_BLOCK // 2, n)
+        lo = (p & 0x0F).astype(jnp.int8) - 8
+        hi = (p >> 4).astype(jnp.int8) - 8
+        codes = jnp.concatenate([lo, hi], axis=1).astype(jnp.float32)
+        w = codes * self.scales[:, None, :].astype(jnp.float32)
+        return w.reshape(k, n).astype(dtype)
+
+
+def quantize_q80_jnp(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """On-device Q80 quantize of activations along the last dim.
+
+    f32/bf16[..., d] -> (codes i8[..., d], scales f32[..., d/32]).
+    Used by the quantized-collective path (parallel/collectives.py) — the
+    TPU-native analog of the reference's Q80 ZQ exchange buffer.
+    """
+    shape = x.shape
+    g = x.astype(jnp.float32).reshape(*shape[:-1], -1, Q_BLOCK)
+    absmax = jnp.max(jnp.abs(g), axis=-1)
+    deltas = absmax / 127.0
+    inv = jnp.where(deltas != 0, 1.0 / deltas, 0.0)
+    codes = jnp.round(g * inv[..., None]).astype(jnp.int8)
+    return codes.reshape(shape), deltas
+
+
+def dequantize_q80_jnp(codes: jax.Array, scales: jax.Array, dtype=jnp.float32) -> jax.Array:
+    shape = codes.shape
+    g = codes.astype(jnp.float32).reshape(*shape[:-1], -1, Q_BLOCK)
+    out = g * scales[..., None]
+    return out.reshape(shape).astype(dtype)

@@ -1,0 +1,37 @@
+"""Quantized collectives: the reference's Q80 activation exchange on ICI.
+
+The reference never moves f32 activations between nodes — every
+SYNC_NODE_SLICES rides the Q80-quantized ZQ pipe, and the col-matmul
+"all-reduce" is an all-gather of quantized partial sums + local merge-add
+(SURVEY.md §3.4, nn-network.cpp:521-554, nn-cpu-ops.cpp:838-875). These are
+the shard_map-level equivalents, for use when bf16 collectives are
+bandwidth-bound (measure before enabling — ICI is fast enough that bf16 is
+the default; Q80 halves the payload at ~1e-2 relative error).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dllama_tpu.ops.quant import dequantize_q80_jnp, quantize_q80_jnp
+
+
+def q80_all_gather(x: jax.Array, axis_name: str, axis: int = 0, tiled: bool = True) -> jax.Array:
+    """all_gather(x) with the payload quantized to Q80 (codes i8 + f32 block
+    scales) — 1/2 the bytes of bf16, 1/4 of f32 on the wire."""
+    codes, scales = quantize_q80_jnp(x)
+    codes_g = jax.lax.all_gather(codes, axis_name, axis=axis, tiled=tiled)
+    scales_g = jax.lax.all_gather(scales, axis_name, axis=axis, tiled=tiled)
+    return dequantize_q80_jnp(codes_g, scales_g, x.dtype)
+
+
+def q80_all_reduce(x: jax.Array, axis_name: str) -> jax.Array:
+    """The reference's all-reduce: all-gather Q80 partial sums, reduce locally
+    (all-gather + merge-add ≡ all-reduce, SURVEY.md §3.4). Payload is the
+    quantized partials; the reduction itself is f32 on-chip."""
+    codes, scales = quantize_q80_jnp(x)
+    codes_g = jax.lax.all_gather(codes, axis_name, axis=0, tiled=False)
+    scales_g = jax.lax.all_gather(scales, axis_name, axis=0, tiled=False)
+    parts = dequantize_q80_jnp(codes_g, scales_g, jnp.float32)
+    return jnp.sum(parts, axis=0).astype(x.dtype)

@@ -1,0 +1,85 @@
+"""Device mesh with every parallelism axis as a first-class name.
+
+The reference has exactly one compute-parallel axis — TP over 2^n TCP nodes
+(SURVEY.md §2.4). Here all five axes exist as named mesh dimensions from day
+one, so a sharding is a PartitionSpec over ('dp','pp','sp','tp','ep') instead
+of hand-written slicing math (nn-core.cpp:170-238):
+
+  dp — data parallel (batch replicas for serving)
+  pp — pipeline parallel (stage-split across pods / DCN)
+  sp — sequence/context parallel (KV sequence axis; ring attention)
+  tp — tensor parallel (the reference's node axis; rides ICI)
+  ep — expert parallel (MoE; the header's N_EXPERTS the reference never uses)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "pp", "sp", "tp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+    ep: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.pp * self.sp * self.tp * self.ep
+
+    def axis_sizes(self) -> tuple[int, ...]:
+        return (self.dp, self.pp, self.sp, self.tp, self.ep)
+
+    @classmethod
+    def parse(cls, spec: str) -> "MeshConfig":
+        """Parse 'tp=4,dp=2' style CLI strings."""
+        kwargs = {}
+        for part in spec.split(","):
+            if not part:
+                continue
+            k, _, v = part.partition("=")
+            if k not in AXES:
+                raise ValueError(f"unknown mesh axis {k!r}; valid: {AXES}")
+            kwargs[k] = int(v)
+        return cls(**kwargs)
+
+
+def make_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
+    """Build the 5-axis mesh. tp is the innermost (fastest-varying) axis so
+    tensor-parallel collectives ride neighboring ICI links."""
+    devices = list(devices if devices is not None else jax.devices())
+    if config is None:
+        config = MeshConfig(tp=len(devices))
+    if config.n_devices > len(devices):
+        raise ValueError(f"mesh needs {config.n_devices} devices, have {len(devices)}")
+    devices = devices[: config.n_devices]
+    grid = np.array(devices).reshape(config.axis_sizes())
+    return Mesh(grid, AXES)
+
+
+def auto_mesh_config(n_devices: int, n_kv_heads: int, want_sp: bool = False) -> MeshConfig:
+    """Pick a (dp, sp, tp) factoring for n devices.
+
+    tp is capped at n_kv_heads (the reference's nNodes <= nKvHeads rule,
+    app.cpp:201-203 — each shard needs >= 1 KV head); the remainder goes to
+    sp (if requested) then dp.
+    """
+    tp = 1
+    for d in range(min(n_devices, n_kv_heads), 0, -1):
+        if n_devices % d == 0 and n_kv_heads % d == 0:
+            tp = d
+            break
+    rest = n_devices // tp
+    sp = 1
+    if want_sp and rest % 2 == 0:
+        sp = 2
+        rest //= 2
+    return MeshConfig(dp=rest, sp=sp, tp=tp)

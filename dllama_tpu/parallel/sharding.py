@@ -1,0 +1,99 @@
+"""Sharding rules: the reference's TP decomposition as PartitionSpecs.
+
+Maps one-to-one onto the reference's slicers (nn-core.cpp:170-238):
+  sliceRowMatmul  (q/k/v/w1/w3/wcls, output-dim shard) -> P(..., 'tp') on out
+  sliceColMatmul  (wo/w2, input-dim shard + merge-add) -> P(..., 'tp', ...) on in
+  sliceKvCache / sliceMultiHeadAtt (head shard)        -> cache P on kv-head axis
+  + the axis the reference lacks: cache seq axis on 'sp' (ring/context parallel)
+
+Under pjit, XLA emits the collectives the reference hand-codes: the
+col-matmul partial-sum exchange (SYNC_NODE_SLICES + OP_MERGE_ADD,
+nn-network.cpp:521-554) becomes a reduce-scatter/all-gather pair on ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dllama_tpu.models.config import LlamaConfig
+from dllama_tpu.models.llama import KVCache
+from dllama_tpu.ops.quant import QTensor
+
+# specs for stacked per-layer weights: leading L axis, then (in, out)
+_ROW_SHARD = P(None, None, "tp")  # output-dim sharded (reference "row" slice)
+_COL_SHARD = P(None, "tp", None)  # input-dim sharded (reference "col" slice)
+
+LAYER_SPECS = {
+    "wq": _ROW_SHARD,
+    "wk": _ROW_SHARD,
+    "wv": _ROW_SHARD,
+    "w1": _ROW_SHARD,
+    "w3": _ROW_SHARD,
+    "wo": _COL_SHARD,
+    "w2": _COL_SHARD,
+    "rms_att": P(None, None),
+    "rms_ffn": P(None, None),
+}
+
+
+class LlamaShardings:
+    """Placement rules bound to a concrete mesh."""
+
+    def __init__(self, mesh: Mesh, cfg: LlamaConfig):
+        self.mesh = mesh
+        self.cfg = cfg
+        tp = mesh.shape["tp"]
+        sp = mesh.shape["sp"]
+        if cfg.n_kv_heads % tp != 0:
+            # the reference's hard requirement nNodes <= nKvHeads (app.cpp:201-203);
+            # ours is divisibility of the kv-head axis.
+            raise ValueError(f"n_kv_heads={cfg.n_kv_heads} not divisible by tp={tp}")
+        if cfg.seq_len % max(sp, 1) != 0:
+            raise ValueError(f"seq_len={cfg.seq_len} not divisible by sp={sp}")
+
+    def _named(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    def param_spec_tree(self, params) -> dict:
+        """A pytree of PartitionSpecs congruent with the params pytree
+        (QTensor packed/scales share one spec — both are [in?, out] shaped)."""
+
+        def expand(spec, leaf):
+            if isinstance(leaf, QTensor):
+                return QTensor(spec, spec)
+            return spec
+
+        layers = {
+            name: expand(LAYER_SPECS[name], leaf)
+            for name, leaf in params["layers"].items()
+        }
+        return {
+            "embedding": P(None, None),  # replicated; vocab shard lives on wcls
+            "final_norm": P(None),
+            "wcls": expand(P(None, "tp"), params["wcls"]),
+            "layers": layers,
+        }
+
+    def put_params(self, params):
+        specs = self.param_spec_tree(params)
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, self._named(s)),
+            params,
+            specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def cache_spec(self) -> P:
+        # [n_layers, batch, n_kv_heads, seq, head_size]
+        return P(None, "dp", "tp", "sp", None)
+
+    def put_cache(self, cache: KVCache) -> KVCache:
+        s = self._named(self.cache_spec())
+        return KVCache(jax.device_put(cache.k, s), jax.device_put(cache.v, s))
+
+    def put_replicated(self, x):
+        return jax.device_put(x, self._named(P()))
+
+    def tokens_spec(self) -> P:
+        return P("dp", None)

@@ -1,0 +1,153 @@
+"""Chat template engine + streaming stop-sequence (EOS) detection.
+
+Behavioral port of the reference's ChatTemplate (tokenizer.cpp:481-552) and
+EosDetector (tokenizer.cpp:554-639): templates are auto-detected from the
+tokenizer's embedded jinja string; the EOS detector buffers partially-matched
+stop strings so they are never emitted to the user.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum, IntEnum
+
+from dllama_tpu.tokenizer.tokenizer import Tokenizer
+
+
+class ChatTemplateType(IntEnum):
+    UNKNOWN = 0
+    LLAMA2 = 1
+    LLAMA3 = 2
+    DEEP_SEEK3 = 3
+
+
+@dataclass
+class ChatItem:
+    role: str
+    message: str
+
+
+@dataclass
+class GeneratedChat:
+    content: str
+    public_prompt: str | None  # template-injected text the user should see (e.g. "<think>\n")
+
+
+class ChatTemplate:
+    def __init__(self, type_: ChatTemplateType, chat_template: str | None, eos: str):
+        if type_ == ChatTemplateType.UNKNOWN:
+            if chat_template is None:
+                raise ValueError("the tokenizer does not include a chat template")
+            if "[INST]" in chat_template:
+                type_ = ChatTemplateType.LLAMA2
+            elif "<|start_header_id|>" in chat_template:
+                type_ = ChatTemplateType.LLAMA3
+            elif "<｜Assistant｜>" in chat_template:
+                type_ = ChatTemplateType.DEEP_SEEK3
+            else:
+                raise ValueError("not supported chat template")
+        self.type = type_
+        self.eos = eos
+
+    def generate(self, items: list[ChatItem], append_generation_prompt: bool = True) -> GeneratedChat:
+        buf = []
+        public_prompt = None
+        if self.type == ChatTemplateType.LLAMA2:
+            i = 0
+            if len(items) >= 2 and items[0].role == "system" and items[1].role == "user":
+                buf.append(
+                    f"[INST] <<SYS>>\n{items[0].message}\n<</SYS>>\n\n{items[1].message} [/INST]{self.eos}"
+                )
+                i = 2
+            for item in items[i:]:
+                if item.role == "assistant":
+                    buf.append(item.message + self.eos)
+                elif item.role == "user":
+                    buf.append(f"[INST] {item.message} [/INST]{self.eos}")
+        elif self.type == ChatTemplateType.LLAMA3:
+            for item in items:
+                buf.append(
+                    f"<|start_header_id|>{item.role}<|end_header_id|>\n\n{item.message}{self.eos}"
+                )
+            if append_generation_prompt:
+                buf.append("<|start_header_id|>assistant<|end_header_id|>\n\n")
+        elif self.type == ChatTemplateType.DEEP_SEEK3:
+            i = 0
+            if items and items[0].role == "system":
+                buf.append(items[0].message)
+                i = 1
+            for item in items[i:]:
+                if item.role == "user":
+                    buf.append(f"<｜User｜>{item.message}")
+                elif item.role == "assistant":
+                    buf.append(f"<｜Assistant｜>{item.message}")
+            if append_generation_prompt:
+                buf.append("<｜Assistant｜><think>\n")
+                public_prompt = "<think>\n"
+        return GeneratedChat("".join(buf), public_prompt)
+
+
+def chat_stops(tokenizer: Tokenizer) -> list[str]:
+    """Stop strings = pieces of the tokenizer's EOS token ids (tokenizer.cpp:455-468)."""
+    return [tokenizer.piece(t) for t in tokenizer.eos_ids]
+
+
+class EosResult(Enum):
+    MAYBE_EOS = 0
+    EOS = 1
+    NOT_EOS = 2
+
+
+class EosDetector:
+    """Streaming multi-stop-sequence matcher with MAYBE buffering.
+
+    `padding_left/right` tolerate up to that many junk characters before/after
+    a stop string (the chat CLI uses left=2/right=2 for stray spaces and
+    newlines around e.g. "<|eot_id|>", dllama.cpp:140).
+    """
+
+    def __init__(self, stop_token_ids: list[int], stop_pieces: list[str], padding_left: int = 0, padding_right: int = 0):
+        self.stop_token_ids = list(stop_token_ids)
+        self.stop_pieces = list(stop_pieces)
+        self.padding_left = padding_left
+        self.padding_right = padding_right
+        self.buffer = ""
+        self._eos_pos: int | None = None
+
+    def is_eos_token(self, token: int) -> bool:
+        return token in self.stop_token_ids
+
+    def append(self, token: int, piece: str | None) -> EosResult:
+        if piece:
+            self.buffer += piece
+        if self.is_eos_token(token):
+            self._eos_pos = len(self.buffer)
+            return EosResult.EOS
+        self._eos_pos = None
+        for stop in self.stop_pieces:
+            if len(self.buffer) > len(stop) + self.padding_left + self.padding_right:
+                continue
+            for lo in range(self.padding_left + 1):
+                n = len(self.buffer) - lo
+                if n == 0 or n > len(stop) + self.padding_right:
+                    continue
+                n = min(n, len(stop))
+                if self.buffer[lo : lo + n] == stop[:n]:
+                    if n == len(stop):
+                        self._eos_pos = lo
+                        self.buffer = self.buffer[:lo]
+                        return EosResult.EOS
+                    return EosResult.MAYBE_EOS
+        return EosResult.NOT_EOS
+
+    def get_delta(self) -> str | None:
+        """Text safe to emit now (everything before any detected stop)."""
+        if not self.buffer:
+            return None
+        if self._eos_pos == 0:
+            return None
+        return self.buffer
+
+    def reset(self) -> None:
+        self.buffer = ""
+        self._eos_pos = None

@@ -1,0 +1,32 @@
+"""Test harness: simulate an 8-device TPU-like mesh on CPU.
+
+The reference tests multi-node behavior by spawning localhost worker processes
+(examples/n-workers.sh); we do strictly better — every distributed test runs in
+CI on a virtual 8-device mesh via XLA's host-platform device splitting
+(SURVEY.md §4). Env vars must be set before jax initializes.
+"""
+
+import os
+
+# Force CPU: the session environment pins JAX_PLATFORMS=axon (the real TPU
+# tunnel); tests must not compete for the single chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+import numpy as np
+import pytest
+
+# This JAX build's default matmul precision is bf16-like even for f32 inputs
+# (on every backend). Tests compare f32 numerics against torch/numpy, so force
+# true-f32 dots; production uses bf16 activations where the default is exact.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

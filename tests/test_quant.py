@@ -1,0 +1,108 @@
+"""Quantization roundtrip + format tests.
+
+Tolerances mirror the reference kernel tests (nn-cpu-ops-test.cpp:84-89):
+Q40 roundtrip eps 0.13, Q80 roundtrip eps 0.01 on U(-1,1)-scale data.
+"""
+
+import numpy as np
+import pytest
+
+from dllama_tpu.ops import quant
+
+
+def test_q40_roundtrip_tolerance(rng):
+    x = rng.uniform(-1, 1, size=4096).astype(np.float32)
+    packed, scales = quant.quantize_q40_np(x)
+    y = quant.dequantize_q40_np(packed, scales)
+    assert np.max(np.abs(x - y)) < 0.13
+
+
+def test_q80_roundtrip_tolerance(rng):
+    x = rng.uniform(-1, 1, size=4096).astype(np.float32)
+    codes, scales = quant.quantize_q80_np(x)
+    y = quant.dequantize_q80_np(codes, scales)
+    assert np.max(np.abs(x - y)) < 0.01
+
+
+def test_q40_bytes_roundtrip(rng):
+    x = rng.normal(size=2048).astype(np.float32)
+    packed, scales = quant.quantize_q40_np(x)
+    buf = quant.q40_to_bytes(packed, scales)
+    assert len(buf) == quant.FloatType.Q40.nbytes(2048)
+    p2, s2 = quant.q40_from_bytes(buf, 2048)
+    np.testing.assert_array_equal(packed.reshape(-1, 16), p2)
+    np.testing.assert_array_equal(scales.reshape(-1), s2)
+
+
+def test_q80_bytes_roundtrip(rng):
+    x = rng.normal(size=2048).astype(np.float32)
+    codes, scales = quant.quantize_q80_np(x)
+    buf = quant.q80_to_bytes(codes, scales)
+    assert len(buf) == quant.FloatType.Q80.nbytes(2048)
+    c2, s2 = quant.q80_from_bytes(buf, 2048)
+    np.testing.assert_array_equal(codes.reshape(-1, 32), c2)
+    np.testing.assert_array_equal(scales.reshape(-1), s2)
+
+
+def test_q40_matches_reference_writer_bits():
+    """Bit-exactness against the converter algorithm on a crafted block
+    (incl. the -min>max tie-break and the +8.5 floor rounding of writer.py:37-41)."""
+    x = np.zeros(32, dtype=np.float32)
+    x[0] = -8.0  # absmax is negative -> delta = -8/-8 = 1.0
+    x[1] = 7.0
+    x[2] = 0.49
+    x[3] = 0.51
+    x[17] = -3.2
+    packed, scales = quant.quantize_q40_np(x)
+    assert scales[0] == np.float16(1.0)
+    q = np.concatenate([packed[0] & 0xF, packed[0] >> 4])
+    assert q[0] == 0  # -8 -> floor(-8+8.5)=0
+    assert q[1] == 15  # 7 -> floor(15.5)=15
+    assert q[2] == 8  # 0.49 -> floor(8.99)=8
+    assert q[3] == 9  # 0.51 -> floor(9.01)=9
+    assert q[17] == 5  # -3.2 -> floor(5.3)=5
+    zero_idx = [i for i in range(32) if i not in (0, 1, 2, 3, 17)]
+    assert all(q[i] == 8 for i in zero_idx)
+
+
+def test_qtensor_dequant_matches_numpy(rng):
+    w = rng.normal(size=(256, 128)).astype(np.float32)
+    qt = quant.QTensor.quantize(w)
+    assert qt.shape == (256, 128)
+    got = np.asarray(qt.dequantize())
+    # independently dequantize via the numpy file codec
+    packed, scales = quant.quantize_q40_np(np.ascontiguousarray(w.T))
+    want = quant.dequantize_q40_np(packed, scales).T
+    np.testing.assert_allclose(got, want, atol=0, rtol=0)
+    assert np.max(np.abs(got - w)) < 0.5  # normal data, scale ~3 sigma / 8
+
+
+def test_qtensor_file_layout_roundtrip(rng):
+    """QTensor.from_file_layout must agree with QTensor.quantize."""
+    k, n = 128, 64
+    w = rng.normal(size=(k, n)).astype(np.float32)
+    qt1 = quant.QTensor.quantize(w)
+    # simulate .m storage: rows are output dims -> quantize W.T rows
+    packed, scales = quant.quantize_q40_np(np.ascontiguousarray(w.T))
+    qt2 = quant.QTensor.from_file_layout(packed.reshape(n, -1), scales.reshape(n, -1), n, k)
+    np.testing.assert_array_equal(np.asarray(qt1.packed), np.asarray(qt2.packed))
+    np.testing.assert_array_equal(np.asarray(qt1.scales), np.asarray(qt2.scales))
+
+
+def test_q80_jnp_matches_np(rng):
+    import jax.numpy as jnp
+
+    x = rng.normal(size=(4, 256)).astype(np.float32)
+    codes, scales = quant.quantize_q80_jnp(jnp.asarray(x))
+    codes_np, scales_np = quant.quantize_q80_np(x)
+    np.testing.assert_array_equal(np.asarray(codes).reshape(-1, 32), codes_np.reshape(-1, 32))
+    np.testing.assert_allclose(
+        np.asarray(scales).reshape(-1), scales_np.reshape(-1).astype(np.float32), rtol=1e-3
+    )
+    y = quant.dequantize_q80_jnp(codes, scales)
+    assert np.max(np.abs(np.asarray(y) - x)) < 0.05
+
+
+@pytest.mark.parametrize("ft,nbytes", [("q40", 18 * 4), ("q80", 34 * 4), ("f32", 512), ("f16", 256)])
+def test_float_type_sizes(ft, nbytes):
+    assert quant.parse_float_type(ft).nbytes(128) == nbytes

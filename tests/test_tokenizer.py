@@ -1,0 +1,143 @@
+"""Tokenizer tests: BPE encode/decode, `.t` roundtrip, chat templates, EOS
+detection — the structural port of tokenizer-test.cpp with a synthetic
+byte-level vocabulary (the reference's golden llama3 cases need a real
+tokenizer file and sit behind its DEV_TESTS gate, tokenizer-test.cpp:5)."""
+
+import pytest
+
+from dllama_tpu.tokenizer.chat import (
+    ChatItem,
+    ChatTemplate,
+    ChatTemplateType,
+    EosDetector,
+    EosResult,
+    chat_stops,
+)
+from dllama_tpu.tokenizer.tokenizer import Tokenizer
+
+
+def make_tokenizer():
+    # ids 0-255: raw bytes; 256+: merges; bos splits regular/special vocab
+    vocab = [bytes([i]) for i in range(256)]
+    scores = [0.0] * 256
+    merges = {b"he": 1.0, b"ll": 2.0, b"llo": 3.0, b"hello": 4.0, b" w": 1.0, b"or": 1.5, b"world": 0.5, b"orld": 2.5}
+    for piece, score in merges.items():
+        vocab.append(piece)
+        scores.append(score)
+    bos_id = len(vocab)
+    vocab += [b"<s>", b"</s>", b"<|eot|>"]
+    scores += [0.0, 0.0, 0.0]
+    return Tokenizer(vocab, scores, bos_id, [bos_id + 1, bos_id + 2], chat_template=None)
+
+
+def test_encode_merges_best_pairs():
+    t = make_tokenizer()
+    toks = t.encode("hello", add_bos=False)
+    assert toks == [t._regular_index[b"hello"]]
+
+
+def test_encode_with_bos_and_bytes():
+    t = make_tokenizer()
+    toks = t.encode("hex", add_bos=True)
+    assert toks[0] == t.bos_id
+    assert t.decode_all(toks) == "hex"
+
+
+def test_encode_special_tokens():
+    t = make_tokenizer()
+    eot = t.vocab.index(b"<|eot|>")
+    toks = t.encode("hi<|eot|>x", add_bos=False, add_special_tokens=True)
+    assert eot in toks
+    # with special matching off, it tokenizes as raw bytes
+    toks2 = t.encode("<|eot|>", add_bos=False, add_special_tokens=False)
+    assert eot not in toks2
+
+
+def test_streaming_decode_utf8_split():
+    t = make_tokenizer()
+    # "é" = 0xC3 0xA9 split across two tokens; neither alone is valid UTF-8
+    assert t.decode(0xC3) is None
+    assert t.decode(0xA9) == "é"
+    # emoji split 1+3 bytes
+    b = "🚀".encode("utf-8")
+    assert t.decode(b[0]) is None
+    assert t.decode(b[1]) is None
+    assert t.decode(b[2]) is None
+    assert t.decode(b[3]) == "🚀"
+
+
+def test_decode_skips_bos_flushes_on_eos():
+    t = make_tokenizer()
+    assert t.decode(t.bos_id) is None
+    assert t.decode(ord("a")) == "a"
+    assert t.decode(t.eos_ids[0]) is None
+
+
+def test_t_file_roundtrip(tmp_path):
+    t = make_tokenizer()
+    t.chat_template = "<|start_header_id|>{{...}}"
+    path = str(tmp_path / "test.t")
+    t.save(path)
+    t2 = Tokenizer.load(path)
+    assert t2.vocab == t.vocab
+    assert t2.scores == pytest.approx(t.scores)
+    assert t2.bos_id == t.bos_id
+    assert t2.eos_ids == t.eos_ids
+    assert t2.chat_template == t.chat_template
+    assert t2.encode("hello world", add_bos=False) == t.encode("hello world", add_bos=False)
+
+
+def test_chat_template_llama3():
+    ct = ChatTemplate(ChatTemplateType.UNKNOWN, "x<|start_header_id|>y", "<|eot_id|>")
+    assert ct.type == ChatTemplateType.LLAMA3
+    out = ct.generate([ChatItem("system", "sys"), ChatItem("user", "hi")])
+    assert out.content == (
+        "<|start_header_id|>system<|end_header_id|>\n\nsys<|eot_id|>"
+        "<|start_header_id|>user<|end_header_id|>\n\nhi<|eot_id|>"
+        "<|start_header_id|>assistant<|end_header_id|>\n\n"
+    )
+
+
+def test_chat_template_llama2():
+    ct = ChatTemplate(ChatTemplateType.UNKNOWN, "... [INST] ...", "</s>")
+    out = ct.generate([ChatItem("system", "S"), ChatItem("user", "U"), ChatItem("assistant", "A"), ChatItem("user", "U2")])
+    assert out.content == "[INST] <<SYS>>\nS\n<</SYS>>\n\nU [/INST]</s>A</s>[INST] U2 [/INST]</s>"
+
+
+def test_chat_template_deepseek3_think_prompt():
+    ct = ChatTemplate(ChatTemplateType.UNKNOWN, "...<｜Assistant｜>...", "<eos>")
+    out = ct.generate([ChatItem("user", "hi")])
+    assert out.content.endswith("<｜Assistant｜><think>\n")
+    assert out.public_prompt == "<think>\n"
+
+
+def test_eos_detector_exact_and_partial():
+    det = EosDetector([42], ["<|eot|>"], padding_left=2, padding_right=2)
+    # partial match buffers
+    assert det.append(1, "<|e") == EosResult.MAYBE_EOS
+    assert det.append(2, "ot|>") == EosResult.EOS
+    assert det.get_delta() is None  # stop was at position 0 -> nothing to emit
+
+    det.reset()
+    # text then stop within left padding
+    assert det.append(1, "a") == EosResult.NOT_EOS
+    assert det.get_delta() == "a"
+    det.reset()
+    assert det.append(3, "a<|eot|>") == EosResult.EOS
+    assert det.get_delta() == "a"
+
+
+def test_eos_detector_stop_token_id():
+    det = EosDetector([42], ["</s>"])
+    assert det.append(42, None) == EosResult.EOS
+
+
+def test_eos_detector_long_text_passes_through():
+    det = EosDetector([42], ["<stop>"], padding_left=1, padding_right=1)
+    assert det.append(1, "this is a long piece") == EosResult.NOT_EOS
+    assert det.get_delta() == "this is a long piece"
+
+
+def test_chat_stops_from_tokenizer():
+    t = make_tokenizer()
+    assert chat_stops(t) == ["</s>", "<|eot|>"]
