@@ -1,0 +1,111 @@
+"""Benchmark: single-chip decode throughput on a synthetic Q40 Llama.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+vs_baseline is measured against the driver north star of 1000 tok/s/chip
+(BASELINE.json: Llama-3.1-8B-Q40 on v5e-8; we scale the target by model size
+so a 1B run compares against 8000 tok/s-equivalent... no — we report raw
+decode tok/s on the benchmarked config and vs_baseline = value / north_star
+where north_star is size-adjusted: 1000 tok/s * (8.03B / params_B)).
+
+Presets via BENCH_PRESET env: tiny (CI smoke), 1b (default), 8b.
+Runs on whatever jax.devices() provides (the axon-tunneled TPU v5e chip in
+this container; CPU elsewhere).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def params_count(cfg) -> float:
+    per_layer = (
+        cfg.dim * cfg.dim * 2  # wq, wo
+        + cfg.dim * cfg.kv_dim * 2  # wk, wv
+        + cfg.dim * cfg.hidden_dim * 3  # w1, w2, w3
+    )
+    return cfg.vocab_size * cfg.dim * 2 + cfg.n_layers * per_layer
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from dllama_tpu.engine.engine import InferenceEngine
+    from dllama_tpu.models.config import LlamaConfig
+    from dllama_tpu.models.llama import random_params
+
+    preset = os.environ.get("BENCH_PRESET", "1b")
+    presets = {
+        # dims follow the HF configs of the reference's model zoo (launch.py)
+        "tiny": dict(dim=512, hidden_dim=1536, n_layers=4, n_heads=8, n_kv_heads=4,
+                     vocab_size=2048, seq_len=512),
+        "1b": dict(dim=2048, hidden_dim=8192, n_layers=16, n_heads=32, n_kv_heads=8,
+                   vocab_size=128256, seq_len=1024),
+        "8b": dict(dim=4096, hidden_dim=14336, n_layers=32, n_heads=32, n_kv_heads=8,
+                   vocab_size=128256, seq_len=1024),
+    }
+    if preset not in presets:
+        raise SystemExit(f"BENCH_PRESET must be one of {sorted(presets)}, got {preset!r}")
+    label = {"tiny": "tiny", "1b": "Llama-3.2-1B", "8b": "Llama-3.1-8B"}[preset]
+    cfg = LlamaConfig(**presets[preset])
+
+    dev = jax.devices()[0]
+    t0 = time.perf_counter()
+    params = random_params(cfg, seed=0, dtype=jnp.bfloat16, quantize=True)
+    eng = InferenceEngine(cfg, params, cache_dtype=jnp.bfloat16, max_prefill_chunk=128)
+    t_setup = time.perf_counter() - t0
+
+    prompt = np.arange(1, 129, dtype=np.int32)[None] % cfg.vocab_size
+    t0 = time.perf_counter()
+    logits = eng.prefill(prompt)
+    jax.block_until_ready(logits)
+    t_prefill_compile = time.perf_counter() - t0
+
+    first = np.asarray(jnp.argmax(logits, -1)).astype(np.int32)
+    prefill_end = eng.pos
+
+    # warmup/compile the fused decode loop with the SAME static n as the timed
+    # run (n is a static arg of the scan — a different n would recompile inside
+    # the timed region)
+    n_decode = int(os.environ.get("BENCH_DECODE_TOKENS", "256"))
+    n_decode = min(n_decode, eng.seq_len - eng.pos - 1)
+    t0 = time.perf_counter()
+    _ = eng.decode_greedy_n(first, n_decode)
+    t_decode_compile = time.perf_counter() - t0
+
+    # timed decode over the same range (cache slots past pos are masked out)
+    eng.reset(prefill_end)
+    t0 = time.perf_counter()
+    toks = eng.decode_greedy_n(first, n_decode)  # np.asarray inside = device sync
+    t_decode = time.perf_counter() - t0
+    tok_s = n_decode / t_decode
+
+    # timed prefill (cache already compiled; re-run from pos 0)
+    eng.reset(0)
+    t0 = time.perf_counter()
+    logits = eng.prefill(prompt)
+    jax.block_until_ready(logits)
+    t_prefill = time.perf_counter() - t0
+    prefill_tok_s = prompt.shape[1] / t_prefill
+
+    n_params = params_count(cfg)
+    north_star = 1000.0 * (8.03e9 / n_params)  # size-adjusted 8B@1000tok/s/chip
+    result = {
+        "metric": f"decode tok/s, {label}-Q40 synthetic, batch=1, 1 chip ({dev.platform})",
+        "value": round(tok_s, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / north_star, 4),
+        "prefill_tok_s": round(prefill_tok_s, 1),
+        "decode_ms_per_token": round(1000.0 / tok_s, 3),
+        "params_b": round(n_params / 1e9, 3),
+        "device": str(dev),
+        "setup_s": round(t_setup, 1),
+        "compile_s": round(t_prefill_compile + t_decode_compile, 1),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -90,11 +90,30 @@ class InferenceEngine:
 
         donate = (1,) if donate_cache else ()
         self._step = jax.jit(partial(self._step_impl, cfg), donate_argnums=donate)
+        self._decode_n = jax.jit(
+            partial(self._decode_n_impl, cfg), static_argnums=(5,), donate_argnums=donate
+        )
 
     @staticmethod
     def _step_impl(cfg, params, cache, tokens, pos, rope_cache):
         logits, cache = forward(cfg, params, tokens, pos, cache, rope_cache)
         return logits[:, -1], cache
+
+    @staticmethod
+    def _decode_n_impl(cfg, params, cache, token, pos, rope_cache, n):
+        """n greedy decode steps fused into one device program (lax.scan) —
+        no host roundtrip per token. The whole reference decode loop
+        (dllama.cpp:69-88: control packet + forward + sample per token)
+        collapses into a single XLA while-loop on chip."""
+
+        def body(carry, _):
+            token, cache, p = carry
+            logits, cache = forward(cfg, params, token, p, cache, rope_cache)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
+            return (nxt, cache, p + 1), nxt[:, 0]
+
+        (_, cache, _), toks = jax.lax.scan(body, (token, cache, pos), None, length=n)
+        return toks, cache
 
     # ------------------------------------------------------------------ core
 
@@ -131,6 +150,21 @@ class InferenceEngine:
 
     def decode_step(self, tokens: np.ndarray) -> jax.Array:
         return self.step(np.asarray(tokens, dtype=np.int32).reshape(self.batch, 1))
+
+    def decode_greedy_n(self, token: np.ndarray, n: int) -> np.ndarray:
+        """Fused n-step greedy decode on device; returns tokens [n, B]."""
+        if self.pos + n > self.seq_len:
+            raise ValueError(f"position {self.pos}+{n} exceeds seq_len {self.seq_len}")
+        toks, self.cache = self._decode_n(
+            self.params,
+            self.cache,
+            jnp.asarray(token, jnp.int32).reshape(self.batch, 1),
+            jnp.int32(self.pos),
+            self.rope_cache,
+            n,
+        )
+        self.pos += n
+        return np.asarray(toks)
 
     # ------------------------------------------------------------- generation
 

@@ -17,8 +17,8 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dllama_tpu.models.config import LlamaConfig
-from dllama_tpu.models.llama import KVCache
 from dllama_tpu.ops.quant import QTensor
+from dllama_tpu.models.llama import KVCache
 
 # specs for stacked per-layer weights: leading L axis, then (in, out)
 _ROW_SHARD = P(None, None, "tp")  # output-dim sharded (reference "row" slice)
@@ -59,8 +59,17 @@ class LlamaShardings:
         """A pytree of PartitionSpecs congruent with the params pytree
         (QTensor packed/scales share one spec — both are [in?, out] shaped)."""
 
+        tp = self.mesh.shape["tp"]
+
         def expand(spec, leaf):
             if isinstance(leaf, QTensor):
+                if spec == _COL_SHARD and leaf.scales.shape[-2] % tp != 0:
+                    # col-sharded Q40 splits the 32-elem quant-block axis: the
+                    # contraction dim must hold tp whole blocks
+                    raise ValueError(
+                        f"Q40 col-shard needs in_dim % (32*tp) == 0; "
+                        f"got {leaf.scales.shape[-2] * 32} with tp={tp}"
+                    )
                 return QTensor(spec, spec)
             return spec
 
@@ -84,12 +93,14 @@ class LlamaShardings:
             is_leaf=lambda x: isinstance(x, P),
         )
 
-    def cache_spec(self) -> P:
-        # [n_layers, batch, n_kv_heads, seq, head_size]
-        return P(None, "dp", "tp", "sp", None)
+    def cache_spec(self, batch: int) -> P:
+        # [n_layers, batch, n_kv_heads, seq, head_size]; batch shards over dp
+        # only when divisible (a single sequence stays replicated over dp)
+        dp = "dp" if batch % self.mesh.shape["dp"] == 0 else None
+        return P(None, dp, "tp", "sp", None)
 
     def put_cache(self, cache: KVCache) -> KVCache:
-        s = self._named(self.cache_spec())
+        s = self._named(self.cache_spec(batch=cache.k.shape[1]))
         return KVCache(jax.device_put(cache.k, s), jax.device_put(cache.v, s))
 
     def put_replicated(self, x):

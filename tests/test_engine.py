@@ -41,6 +41,19 @@ def test_generate_greedy_deterministic():
     assert all(0 <= t < TINY.vocab_size for t in toks1)
 
 
+def test_decode_greedy_n_matches_stepwise():
+    """Fused on-device scan decode == host-loop greedy decode."""
+    e1 = make_engine()
+    sampler = Sampler(temperature=0.0)
+    toks1 = list(e1.generate([1, 2, 3], 9, sampler))
+
+    e2 = make_engine()
+    logits = e2.prefill(np.array([[1, 2, 3]], dtype=np.int32))
+    first = int(np.asarray(jnp.argmax(logits, -1))[0])
+    rest = e2.decode_greedy_n(np.array([first]), 8)[:, 0].tolist()
+    assert [first] + rest == toks1
+
+
 def test_generate_respects_seq_len():
     e = make_engine(max_seq_len=16)
     sampler = Sampler(temperature=0.0)
