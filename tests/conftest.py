@@ -9,7 +9,10 @@ CI on a virtual 8-device mesh via XLA's host-platform device splitting
 import os
 
 # Force CPU: the session environment pins JAX_PLATFORMS=axon (the real TPU
-# tunnel); tests must not compete for the single chip.
+# tunnel); tests must not compete for the single chip. jax is pre-imported by
+# a sitecustomize hook before this file runs, so the env var is captured too
+# late — the config update below is the authoritative override. XLA_FLAGS is
+# still read lazily at first backend init, so setting it here works.
 os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
@@ -20,6 +23,8 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax
 import numpy as np
 import pytest
+
+jax.config.update("jax_platforms", "cpu")
 
 # This JAX build's default matmul precision is bf16-like even for f32 inputs
 # (on every backend). Tests compare f32 numerics against torch/numpy, so force
