@@ -1,0 +1,5 @@
+"""`python -m dllama_tpu` — the `dllama` binary equivalent."""
+
+from dllama_tpu.cli.main import main
+
+raise SystemExit(main())

@@ -1,0 +1,216 @@
+"""CLI frontend — the analog of the reference's `dllama` binary
+(dllama.cpp:207-229, app.cpp:21-110).
+
+Modes:
+  inference  one-shot generation from --prompt, with per-token timing and the
+             tok/s summary (dllama.cpp:10-105's report shape)
+  chat       REPL with chat template + streaming EOS detection
+             (dllama.cpp:121-205)
+  serve      OpenAI-compatible HTTP server (the `dllama-api` binary's role)
+  info       print the model header (llm.cpp:100-123's dump)
+
+There is no `worker` mode: the reference needs one process per node because
+its nodes are TCP peers; here multi-chip is a jax.sharding.Mesh inside one
+process (use --mesh tp=8 etc.), and multi-host runs launch the same command
+on every host via jax.distributed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import time
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dllama-tpu",
+        description="TPU-native distributed-llama: tensor/sequence/data-parallel LLM inference",
+    )
+    p.add_argument("mode", choices=["inference", "chat", "serve", "info"])
+    p.add_argument("--model", required=True, help=".m model file")
+    p.add_argument("--tokenizer", help=".t tokenizer file")
+    p.add_argument("--prompt", help="prompt text (inference mode)")
+    p.add_argument("--steps", type=int, default=64, help="max tokens to generate")
+    p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--topp", type=float, default=0.9)
+    p.add_argument("--seed", type=int, default=None, help="sampler seed (default: time)")
+    p.add_argument("--max-seq-len", type=int, default=None, help="clamp context length (RAM cap)")
+    p.add_argument(
+        "--mesh",
+        default="auto",
+        help="device mesh spec 'tp=4,dp=2,sp=1' or 'auto' (all devices on tp)",
+    )
+    p.add_argument("--no-mesh", action="store_true", help="single-device even if more exist")
+    p.add_argument("--cache-dtype", choices=["bf16", "f32"], default="bf16")
+    p.add_argument("--max-prefill-chunk", type=int, default=128)
+    p.add_argument("--dequantize", action="store_true", help="load Q40 weights as bf16 (faster prefill, 4x HBM)")
+    p.add_argument("--port", type=int, default=9990, help="HTTP port (serve mode)")
+    p.add_argument("--host", default="127.0.0.1", help="HTTP bind address (serve mode)")
+    p.add_argument("--kernels", choices=["auto", "pallas", "xla"], default="auto")
+    p.add_argument("-v", "--verbose", action="store_true")
+    return p
+
+
+def _load(args):
+    import jax.numpy as jnp
+
+    from dllama_tpu.engine.loader import load_model
+    from dllama_tpu.ops import matmul
+
+    matmul.BACKEND = args.kernels
+    return load_model(
+        args.model,
+        args.tokenizer,
+        max_seq_len=args.max_seq_len,
+        mesh=None if args.no_mesh else args.mesh,
+        cache_dtype=jnp.bfloat16 if args.cache_dtype == "bf16" else jnp.float32,
+        dequantize=args.dequantize,
+        max_prefill_chunk=args.max_prefill_chunk,
+    )
+
+
+def cmd_info(args) -> int:
+    from dllama_tpu.models.formats import read_header, tensor_plan
+
+    cfg, header_size = read_header(args.model, args.max_seq_len)
+    print(cfg.describe())
+    total = sum(
+        cfg.weight_type.nbytes(int(np.prod(shape))) if ft == cfg.weight_type else ft.nbytes(int(np.prod(shape)))
+        for _, shape, ft in tensor_plan(cfg)
+    )
+    print(f"header: {header_size} B, weights: {total / 1e9:.2f} GB on disk")
+    return 0
+
+
+def cmd_inference(args) -> int:
+    from dllama_tpu.engine.engine import GenerationStats
+    from dllama_tpu.engine.sampling import Sampler
+
+    if not args.prompt:
+        print("inference mode requires --prompt", file=sys.stderr)
+        return 1
+    if not args.tokenizer:
+        print("inference mode requires --tokenizer", file=sys.stderr)
+        return 1
+    m = _load(args)
+    tok = m.tokenizer
+    sampler = Sampler(args.temperature, args.topp, args.seed if args.seed is not None else int(time.time()))
+    prompt_tokens = tok.encode(args.prompt, add_bos=True)
+    max_tokens = min(args.steps, m.engine.seq_len - len(prompt_tokens))
+    stats = GenerationStats()
+
+    tok.reset_decoder()
+    for t in m.engine.generate(
+        prompt_tokens, max_tokens, sampler, stop_fn=tok.is_eos, stats=stats
+    ):
+        piece = tok.decode(t)
+        if piece:
+            print(piece, end="", flush=True)
+    print()
+    print(stats.summary(), file=sys.stderr)
+    return 0
+
+
+def cmd_chat(args) -> int:
+    from dllama_tpu.engine.sampling import Sampler
+    from dllama_tpu.tokenizer.chat import (
+        ChatItem,
+        ChatTemplate,
+        ChatTemplateType,
+        EosDetector,
+        EosResult,
+        chat_stops,
+    )
+
+    if not args.tokenizer:
+        print("chat mode requires --tokenizer", file=sys.stderr)
+        return 1
+    m = _load(args)
+    tok = m.tokenizer
+    template = ChatTemplate(ChatTemplateType.UNKNOWN, tok.chat_template, "")
+    stops = chat_stops(tok)
+    sampler = Sampler(args.temperature, args.topp, args.seed if args.seed is not None else int(time.time()))
+
+    print("💬 chat mode — empty line or Ctrl-D to exit")
+    try:
+        system = input("📢 system: ").strip()
+    except EOFError:
+        return 0
+    items: list[ChatItem] = []
+    if system:
+        items.append(ChatItem("system", system))
+
+    first = True
+    while True:
+        try:
+            user = input("👱 user: ").strip()
+        except EOFError:
+            break
+        if not user:
+            break
+        items.append(ChatItem("user", user))
+        generated = template.generate(items, append_generation_prompt=True)
+        # feed only the delta since the engine's KV cache holds the history
+        prompt_tokens = tok.encode(generated.content, add_bos=first)
+        items = []  # history lives in the KV cache from here on
+        first = False
+        if generated.public_prompt:
+            print(generated.public_prompt, end="")
+
+        detector = EosDetector(tok.eos_ids, stops, padding_left=2, padding_right=2)
+        tok.reset_decoder()
+        print("🤖 assistant: ", end="", flush=True)
+        budget = m.engine.seq_len - m.engine.pos - len(prompt_tokens) - 1
+        if budget <= 0:
+            print("(context window exhausted)")
+            break
+        for t in m.engine.generate(prompt_tokens, budget, sampler):
+            piece = tok.decode(t)
+            res = detector.append(t, piece)
+            delta = detector.get_delta()
+            if delta:
+                print(delta, end="", flush=True)
+                detector.reset()
+            if res == EosResult.EOS:
+                break
+        print()
+    return 0
+
+
+def cmd_serve(args) -> int:
+    from dllama_tpu.serve.api import run_server
+
+    m = _load(args)
+    if m.tokenizer is None:
+        print("serve mode requires --tokenizer", file=sys.stderr)
+        return 1
+    return run_server(
+        m,
+        host=args.host,
+        port=args.port,
+        default_temperature=args.temperature,
+        default_topp=args.topp,
+        default_seed=args.seed,
+    )
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    return {
+        "info": cmd_info,
+        "inference": cmd_inference,
+        "chat": cmd_chat,
+        "serve": cmd_serve,
+    }[args.mode](args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

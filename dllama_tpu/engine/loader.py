@@ -1,0 +1,81 @@
+"""High-level model loading: file -> sharded params -> ready InferenceEngine.
+
+The analog of the reference's runInferenceApp bootstrap sequence
+(app.cpp:197-260): header -> tokenizer -> graph -> device -> weights. The
+worker-side half of that sequence (config/weight shipping over TCP,
+nn-network.cpp:606-869) has no equivalent here — every weight goes straight
+from the memory-mapped file to its device shard via jax.device_put.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from dllama_tpu.engine.engine import InferenceEngine
+from dllama_tpu.models.config import LlamaConfig
+from dllama_tpu.models.formats import load_params, read_header
+from dllama_tpu.parallel.mesh import MeshConfig, auto_mesh_config, make_mesh
+from dllama_tpu.parallel.sharding import LlamaShardings
+from dllama_tpu.tokenizer.tokenizer import Tokenizer
+
+log = logging.getLogger("dllama_tpu")
+
+
+@dataclasses.dataclass
+class LoadedModel:
+    config: LlamaConfig
+    engine: InferenceEngine
+    tokenizer: Tokenizer | None
+    shardings: LlamaShardings | None
+
+
+def build_shardings(cfg: LlamaConfig, mesh_spec: str | None) -> LlamaShardings | None:
+    """mesh_spec: 'tp=4,dp=2'-style string, 'auto', or None (single device)."""
+    n_dev = len(jax.devices())
+    if mesh_spec is None or (mesh_spec == "auto" and n_dev == 1):
+        return None
+    if mesh_spec == "auto":
+        mesh_cfg = auto_mesh_config(n_dev, cfg.n_kv_heads)
+    else:
+        mesh_cfg = MeshConfig.parse(mesh_spec)
+    mesh = make_mesh(mesh_cfg)
+    log.info("mesh: %s over %d devices", dict(mesh.shape), mesh_cfg.n_devices)
+    return LlamaShardings(mesh, cfg)
+
+
+def load_model(
+    model_path: str,
+    tokenizer_path: str | None = None,
+    *,
+    max_seq_len: int | None = None,
+    mesh: str | None = "auto",
+    batch: int = 1,
+    cache_dtype=jnp.bfloat16,
+    dequantize: bool = False,
+    max_prefill_chunk: int = 128,
+) -> LoadedModel:
+    cfg, header_size = read_header(model_path, max_seq_len)
+    log.info("model: %s", cfg.describe())
+    shardings = build_shardings(cfg, mesh)
+    # params land on the default device first; InferenceEngine re-places them
+    # with the mesh sharding (host-staged, like the reference's root-then-ship).
+    params = load_params(model_path, cfg, header_size, dtype=jnp.bfloat16, dequantize=dequantize)
+    tokenizer = Tokenizer.load(tokenizer_path) if tokenizer_path else None
+    if tokenizer is not None and tokenizer.regular_vocab_size > cfg.vocab_size:
+        raise ValueError(
+            f"tokenizer vocab ({len(tokenizer.vocab)}) exceeds model vocab ({cfg.vocab_size})"
+        )
+    engine = InferenceEngine(
+        cfg,
+        params,
+        batch=batch,
+        cache_dtype=cache_dtype,
+        max_seq_len=max_seq_len,
+        max_prefill_chunk=max_prefill_chunk,
+        shardings=shardings,
+    )
+    return LoadedModel(cfg, engine, tokenizer, shardings)

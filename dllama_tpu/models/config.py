@@ -99,6 +99,18 @@ class LlamaConfig:
     def q_per_kv(self) -> int:
         return self.n_heads // self.n_kv_heads
 
+    def describe(self) -> str:
+        """One-line summary in the spirit of the reference's header dump
+        (llm.cpp:100-123)."""
+        return (
+            f"{self.arch.name} dim={self.dim} hidden={self.hidden_dim} "
+            f"layers={self.n_layers} heads={self.n_heads}/{self.n_kv_heads} "
+            f"vocab={self.vocab_size} seq={self.seq_len} "
+            f"act={self.hidden_act.name} rope={self.rope_type.name} "
+            f"weights={self.weight_type.name}"
+            + (f" experts={self.n_experts}/{self.n_active_experts}" if self.n_experts else "")
+        )
+
     def clamp_seq_len(self, max_seq_len: int | None) -> "LlamaConfig":
         """The reference's --max-seq-len RAM clamp (llm.cpp:89-91)."""
         if max_seq_len and self.seq_len > max_seq_len:

@@ -41,11 +41,9 @@ def matmul(x: jax.Array, w) -> jax.Array:
     """
     if isinstance(w, QTensor):
         if _use_pallas():
-            try:
-                from dllama_tpu.ops.pallas.q40_matmul import q40_matmul
-            except ImportError:
-                pass
-            else:
+            from dllama_tpu.ops.pallas.q40_matmul import q40_matmul, supported
+
+            if supported(x.shape, w):
                 return q40_matmul(x, w)
         wd = w.dequantize(x.dtype)
     else:

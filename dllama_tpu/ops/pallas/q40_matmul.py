@@ -1,0 +1,112 @@
+"""Fused Q40 dequant-matmul Pallas kernel — the decode hot loop.
+
+The reference's equivalent is matmul_Q80_Q40 (nn-cpu-ops.cpp:225-446) plus
+llamafile sgemm for prefill; on TPU the win is HBM bandwidth: the kernel
+streams the *packed* 4-bit weights (0.56 bytes/weight incl. scales) from HBM
+into VMEM and dequantizes on-chip right before the MXU dot — ~3.5x less HBM
+traffic than bf16 weights, which is the whole game for batch=1 decode.
+
+Layout (see ops/quant.QTensor): ``packed: u8[k/2, n]`` where packed row
+``16*b + j`` holds codes for input dims ``32*b + j`` (low nibble) and
+``32*b + j + 16`` (high nibble); ``scales: f16[k/32, n]``.
+
+Grid is (m_tiles, n_tiles, k_tiles) with k innermost: the f32 accumulator
+block stays VMEM-resident across the k sweep and is written back once per
+(m, n) tile. Inputs are double-buffered by the Pallas pipeline automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dllama_tpu.ops.quant import Q_BLOCK, QTensor
+
+
+def _pick_tile(dim: int, candidates: tuple[int, ...]) -> int | None:
+    for c in candidates:
+        if dim % c == 0:
+            return c
+    return None
+
+
+def _kernel(x_ref, packed_ref, scales_ref, out_ref, acc_ref, *, tk: int, tn: int):
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # unpack nibbles -> codes in [-8, 7] laid out [tk//32, 32, tn]
+    p = packed_ref[:].astype(jnp.int32).reshape(tk // Q_BLOCK, Q_BLOCK // 2, tn)
+    lo = (p & 0x0F) - 8
+    hi = (p >> 4) - 8
+    codes = jnp.concatenate([lo, hi], axis=1)  # [tk//32, 32, tn]
+    s = scales_ref[:].astype(jnp.float32)[:, None, :]
+    w = (codes.astype(jnp.float32) * s).reshape(tk, tn).astype(x_ref.dtype)
+    acc_ref[:] += jnp.dot(x_ref[:], w, preferred_element_type=jnp.float32)
+
+    @pl.when(kb == pl.num_programs(2) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def q40_matmul_2d(x: jax.Array, packed: jax.Array, scales: jax.Array, *, interpret: bool = False) -> jax.Array:
+    """x[m, k] @ dequant(packed, scales)[k, n] -> f32[m, n]."""
+    m, k = x.shape
+    n = packed.shape[1]
+    tm = _pick_tile(m, (256, 128, 64, 32, 16, 8)) or m
+    tn = _pick_tile(n, (512, 256, 128)) or n
+    tk = _pick_tile(k, (512, 256, 128, 64, 32)) or k
+    assert k % Q_BLOCK == 0 and tk % Q_BLOCK == 0, (k, tk)
+
+    grid = (m // tm, n // tn, k // tk)
+    return pl.pallas_call(
+        functools.partial(_kernel, tk=tk, tn=tn),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tm, tk), lambda i, j, kb: (i, kb)),
+            pl.BlockSpec((tk // 2, tn), lambda i, j, kb: (kb, j)),
+            pl.BlockSpec((tk // Q_BLOCK, tn), lambda i, j, kb: (kb, j)),
+        ],
+        out_specs=pl.BlockSpec((tm, tn), lambda i, j, kb: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * n * k,
+            bytes_accessed=m * k * x.dtype.itemsize + k * n // 2 + (k // Q_BLOCK) * n * 2 + m * n * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(x, packed, scales)
+
+
+def supported(x_shape: tuple[int, ...], w: QTensor) -> bool:
+    """Tileability check used by the ops.matmul dispatcher."""
+    k, n = w.shape
+    return k % Q_BLOCK == 0 and n % 128 == 0 and k >= 128
+
+
+def q40_matmul(x: jax.Array, w: QTensor, *, interpret: bool = False) -> jax.Array:
+    """``x @ w`` for any leading batch dims; returns x.dtype like the XLA path."""
+    *lead, k = x.shape
+    m = 1
+    for d in lead:
+        m *= d
+    x2 = x.reshape(m, k)
+    # pad rows up to the f32 sublane (8) so tiny decode batches still tile
+    pad = (-m) % 8
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    out = q40_matmul_2d(x2, w.packed, w.scales, interpret=interpret)
+    if pad:
+        out = out[:m]
+    return out.reshape(*lead, w.shape[1]).astype(x.dtype)

@@ -175,7 +175,9 @@ class QTensor:
     """
 
     packed: jax.Array  # u8 [k//2, n]
-    scales: jax.Array  # f16 [k//32, n]
+    scales: jax.Array  # f32 [k//32, n] — file stores f16, but TPU/Mosaic has no
+    # f16 support; every f16 value is exactly representable in f32, so device
+    # scales are widened at load with zero numeric drift.
 
     def tree_flatten(self):
         return (self.packed, self.scales), None
@@ -203,7 +205,7 @@ class QTensor:
         packed, scales = quantize_q40_np(np.ascontiguousarray(w.T))  # [n, k/32, 16]
         k = w.shape[0]
         packed = np.transpose(packed, (1, 2, 0)).reshape(k // 2, w.shape[1])
-        scales = np.transpose(scales, (1, 0))
+        scales = np.transpose(scales, (1, 0)).astype(np.float32)
         return cls(jnp.asarray(packed), jnp.asarray(scales))
 
     @classmethod
@@ -212,7 +214,7 @@ class QTensor:
         packed = packed.reshape(n_out, k_in // Q_BLOCK, Q_BLOCK // 2)
         scales = scales.reshape(n_out, k_in // Q_BLOCK)
         packed = np.ascontiguousarray(np.transpose(packed, (1, 2, 0))).reshape(k_in // 2, n_out)
-        scales = np.ascontiguousarray(np.transpose(scales, (1, 0)))
+        scales = np.ascontiguousarray(np.transpose(scales, (1, 0))).astype(np.float32)
         return cls(jnp.asarray(packed), jnp.asarray(scales))
 
     def dequantize(self, dtype=jnp.float32) -> jax.Array:

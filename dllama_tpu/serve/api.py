@@ -1,0 +1,286 @@
+"""OpenAI-compatible HTTP API server — the `dllama-api` binary's role
+(dllama-api.cpp:509-581).
+
+Routes: POST /v1/chat/completions (stream + non-stream), GET /v1/models,
+GET /health. Request params override the CLI defaults the way the reference's
+params do (dllama-api.cpp:455-484): temperature, top_p, seed, max_tokens,
+stop, stream.
+
+The **prefix cache** reproduces NaiveCache (dllama-api.cpp:264-309): the chat
+history from the previous request is kept with its KV-cache position; when a
+new request's messages extend the cached ones, only the delta is encoded and
+prefilled — the engine rewinds to the cached position instead of replaying
+the whole conversation.
+
+Built on stdlib http.server (the reference hand-rolls HTTP/1.1 the same
+spirit, dllama-api.cpp:104-179); requests are serialized with a lock because
+one engine owns the KV cache — the reference is equally single-request
+(blocking accept loop, dllama-api.cpp:522-533).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from dllama_tpu.engine.sampling import Sampler
+from dllama_tpu.tokenizer.chat import (
+    ChatItem,
+    ChatTemplate,
+    ChatTemplateType,
+    EosDetector,
+    EosResult,
+    chat_stops,
+)
+
+log = logging.getLogger("dllama_tpu.serve")
+
+
+@dataclass
+class PrefixCache:
+    """NaiveCache equivalent: remember the last conversation's messages and
+    the KV position right after them."""
+
+    messages: list[tuple[str, str]] = field(default_factory=list)
+    pos: int = 0
+    bos_sent: bool = False
+
+    def resolve(self, incoming: list[tuple[str, str]]) -> tuple[list[tuple[str, str]], int, bool]:
+        """-> (delta_messages, start_pos, add_bos). Matches whole-message
+        prefixes only, like resolveDeltaPrompt (dllama-api.cpp:286-308)."""
+        n = len(self.messages)
+        if n and len(incoming) > n and incoming[:n] == self.messages:
+            return incoming[n:], self.pos, False
+        return incoming, 0, True
+
+    def clear(self) -> None:
+        self.messages = []
+        self.pos = 0
+        self.bos_sent = False
+
+
+class ApiServer:
+    def __init__(self, loaded, default_temperature=0.8, default_topp=0.9, default_seed=None):
+        self.engine = loaded.engine
+        self.tokenizer = loaded.tokenizer
+        self.config = loaded.config
+        self.template = ChatTemplate(
+            ChatTemplateType.UNKNOWN, self.tokenizer.chat_template, ""
+        )
+        self.stops = chat_stops(self.tokenizer)
+        self.defaults = dict(
+            temperature=default_temperature, topp=default_topp, seed=default_seed
+        )
+        self.cache = PrefixCache()
+        self.lock = threading.Lock()
+        self.model_name = "dllama-tpu"
+
+    # ------------------------------------------------------------------ core
+
+    def complete(self, body: dict, emit=None) -> dict:
+        """Run one chat completion. `emit(text)` streams deltas when given.
+        Returns the non-streaming response dict (also computed when streaming,
+        for the final usage accounting)."""
+        messages = [(m["role"], str(m["content"])) for m in body.get("messages", [])]
+        if not messages:
+            raise ApiError(400, "messages must be a non-empty array")
+        temperature = float(body.get("temperature", self.defaults["temperature"]))
+        topp = float(body.get("top_p", self.defaults["topp"]))
+        seed = body.get("seed", self.defaults["seed"])
+        max_tokens = int(body.get("max_tokens") or body.get("max_completion_tokens") or 0)
+        extra_stops = body.get("stop") or []
+        if isinstance(extra_stops, str):
+            extra_stops = [extra_stops]
+
+        with self.lock:
+            delta, start_pos, add_bos = self.cache.resolve(messages)
+            if start_pos == 0:
+                self.cache.clear()
+            self.engine.reset(start_pos)
+            generated = self.template.generate(
+                [ChatItem(r, c) for r, c in delta], append_generation_prompt=True
+            )
+            prompt_tokens = self.tokenizer.encode(generated.content, add_bos=add_bos)
+            budget = self.engine.seq_len - self.engine.pos - len(prompt_tokens) - 1
+            if budget <= 0:
+                raise ApiError(400, "context window exhausted")
+            if max_tokens > 0:
+                budget = min(budget, max_tokens)
+
+            sampler = Sampler(temperature, topp, seed if seed is not None else int(time.time()))
+            detector = EosDetector(
+                self.tokenizer.eos_ids,
+                self.stops + list(extra_stops),
+                padding_left=2,
+                padding_right=2,
+            )
+            self.tokenizer.reset_decoder()
+            parts: list[str] = []
+            n_generated = 0
+            finish = "length"
+            for t in self.engine.generate(prompt_tokens, budget, sampler):
+                n_generated += 1
+                piece = self.tokenizer.decode(t)
+                res = detector.append(t, piece)
+                text = detector.get_delta()
+                if text:
+                    parts.append(text)
+                    if emit is not None:
+                        emit(text)
+                    detector.reset()
+                if res == EosResult.EOS:
+                    finish = "stop"
+                    break
+
+            content = "".join(parts)
+            # cache the full conversation incl. the reply for the next turn
+            self.cache.messages = messages + [("assistant", content)]
+            self.cache.pos = self.engine.pos
+            self.cache.bos_sent = True
+
+        return {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:16]}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": body.get("model", self.model_name),
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": content},
+                    "finish_reason": finish,
+                }
+            ],
+            "usage": {
+                "prompt_tokens": len(prompt_tokens),
+                "completion_tokens": n_generated,
+                "total_tokens": len(prompt_tokens) + n_generated,
+            },
+        }
+
+    def models(self) -> dict:
+        return {
+            "object": "list",
+            "data": [
+                {
+                    "id": self.model_name,
+                    "object": "model",
+                    "created": int(time.time()),
+                    "owned_by": "dllama-tpu",
+                }
+            ],
+        }
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dllama-tpu"
+    protocol_version = "HTTP/1.1"
+    api: ApiServer  # set by make_handler
+
+    def log_message(self, fmt, *args):
+        log.info("%s %s", self.address_string(), fmt % args)
+
+    def _send_json(self, status: int, payload: dict) -> None:
+        data = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        if self.path == "/v1/models":
+            self._send_json(200, self.api.models())
+        elif self.path == "/health":
+            self._send_json(200, {"status": "ok"})
+        else:
+            self._send_json(404, {"error": {"message": "not found"}})
+
+    def do_POST(self):
+        if self.path not in ("/v1/chat/completions", "/chat/completions"):
+            self._send_json(404, {"error": {"message": "not found"}})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            self._send_json(400, {"error": {"message": "invalid JSON body"}})
+            return
+        try:
+            if body.get("stream"):
+                self._stream(body)
+            else:
+                self._send_json(200, self.api.complete(body))
+        except ApiError as e:
+            self._send_json(e.status, {"error": {"message": e.message}})
+        except BrokenPipeError:
+            log.info("client disconnected mid-stream")
+        except Exception:
+            log.exception("completion failed")
+            self._send_json(500, {"error": {"message": "internal error"}})
+
+    def _stream(self, body: dict) -> None:
+        """SSE chunked streaming (dllama-api.cpp:203-223's role)."""
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        cid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
+        created = int(time.time())
+
+        def chunk(payload: bytes) -> None:
+            self.wfile.write(f"{len(payload):x}\r\n".encode() + payload + b"\r\n")
+            self.wfile.flush()
+
+        def emit_delta(delta: dict, finish=None) -> None:
+            data = {
+                "id": cid,
+                "object": "chat.completion.chunk",
+                "created": created,
+                "model": body.get("model", self.api.model_name),
+                "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
+            }
+            chunk(b"data: " + json.dumps(data).encode() + b"\n\n")
+
+        emit_delta({"role": "assistant"})
+        result = self.api.complete(body, emit=lambda text: emit_delta({"content": text}))
+        emit_delta({}, finish=result["choices"][0]["finish_reason"])
+        chunk(b"data: [DONE]\n\n")
+        chunk(b"")  # terminating zero-length chunk
+
+
+def make_server(loaded, host="127.0.0.1", port=0, **defaults) -> tuple[ThreadingHTTPServer, ApiServer]:
+    api = ApiServer(
+        loaded,
+        default_temperature=defaults.get("default_temperature", 0.8),
+        default_topp=defaults.get("default_topp", 0.9),
+        default_seed=defaults.get("default_seed"),
+    )
+    handler = type("Handler", (_Handler,), {"api": api})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    return httpd, api
+
+
+def run_server(loaded, host="127.0.0.1", port=9990, **defaults) -> int:
+    httpd, _ = make_server(loaded, host, port, **defaults)
+    log.info("serving on http://%s:%d (POST /v1/chat/completions)", host, httpd.server_address[1])
+    print(f"🚀 http://{host}:{httpd.server_address[1]}/v1/chat/completions")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+    return 0

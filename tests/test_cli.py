@@ -1,0 +1,39 @@
+"""CLI tests: info / inference modes end-to-end on a tiny on-disk model."""
+
+import numpy as np
+
+from dllama_tpu.cli.main import build_parser, main
+from tests.test_serve import make_tiny_files
+
+
+def test_parser_flags_match_reference_defaults():
+    args = build_parser().parse_args(["inference", "--model", "x.m"])
+    # reference defaults: temp 0.8, topp 0.9, port 9990 (app.cpp:23-40)
+    assert args.temperature == 0.8
+    assert args.topp == 0.9
+    assert args.port == 9990
+    assert args.mesh == "auto"
+
+
+def test_cli_info(tmp_path, capsys):
+    mpath, tpath, cfg = make_tiny_files(tmp_path)
+    assert main(["info", "--model", mpath]) == 0
+    out = capsys.readouterr().out
+    assert "dim=64" in out and "layers=2" in out and "Q40" in out
+
+
+def test_cli_inference_generates(tmp_path, capsys):
+    mpath, tpath, cfg = make_tiny_files(tmp_path)
+    rc = main([
+        "inference", "--model", mpath, "--tokenizer", tpath,
+        "--prompt", "hello", "--steps", "6", "--temperature", "0", "--seed", "1",
+        "--no-mesh",
+    ])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "Decode:" in err and "tok/s" in err
+
+
+def test_cli_inference_missing_prompt_errors(tmp_path, capsys):
+    mpath, tpath, _ = make_tiny_files(tmp_path)
+    assert main(["inference", "--model", mpath, "--tokenizer", tpath]) == 1

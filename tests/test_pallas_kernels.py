@@ -1,0 +1,55 @@
+"""Pallas kernel equivalence tests (interpret mode on CPU).
+
+The analog of the reference's nn-cpu-ops-test.cpp: every fused kernel is
+checked against the pure-jnp reference implementation with calibrated
+tolerances (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dllama_tpu.ops.quant import QTensor
+from dllama_tpu.ops.pallas.q40_matmul import q40_matmul, supported
+
+
+@pytest.mark.parametrize(
+    "m,k,n",
+    [
+        (1, 256, 256),  # decode GEMV shape (row-padded to 8 inside)
+        (8, 512, 384),
+        (16, 1024, 512),
+        (128, 256, 1280),  # prefill chunk
+        (3, 512, 256),  # odd batch -> pad path
+    ],
+)
+def test_q40_matmul_matches_dequant_dot(rng, m, k, n):
+    x = jnp.asarray(rng.standard_normal((m, k)), jnp.float32)
+    w = QTensor.quantize(rng.standard_normal((k, n)).astype(np.float32) * 0.1)
+    assert supported(x.shape, w)
+    got = q40_matmul(x, w, interpret=True)
+    want = jnp.dot(x, w.dequantize(jnp.float32))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-2, rtol=2e-2)
+
+
+def test_q40_matmul_batched_lead_dims(rng):
+    x = jnp.asarray(rng.standard_normal((2, 4, 256)), jnp.bfloat16)
+    w = QTensor.quantize(rng.standard_normal((256, 256)).astype(np.float32) * 0.1)
+    got = q40_matmul(x, w, interpret=True)
+    assert got.shape == (2, 4, 256)
+    assert got.dtype == jnp.bfloat16
+    want = jnp.dot(x, w.dequantize(jnp.bfloat16), preferred_element_type=jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), atol=8e-2, rtol=8e-2
+    )
+
+
+def test_q40_matmul_exact_on_roundtrip_values(rng):
+    """Inputs already on the Q40 grid -> kernel must be exact vs dequant-dot
+    (same accumulation dtype), like the reference's epsilon-0 identity cases."""
+    w0 = rng.standard_normal((128, 256)).astype(np.float32)
+    w = QTensor.quantize(w0)
+    x = jnp.eye(128, dtype=jnp.float32)
+    got = q40_matmul(x, w, interpret=True)
+    want = w.dequantize(jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=0, rtol=0)

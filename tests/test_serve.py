@@ -1,0 +1,170 @@
+"""HTTP API server tests: OpenAI contract, SSE streaming, prefix cache.
+
+End-to-end over a real socket with a tiny on-disk model — the analog of the
+reference's api-client example against dllama-api, but automated."""
+
+import http.client
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from dllama_tpu.models.config import LlamaConfig
+from dllama_tpu.models.formats import save_model, tensor_plan
+from dllama_tpu.tokenizer.tokenizer import Tokenizer
+
+
+def make_tiny_files(tmp_path, seed=0):
+    vocab = [bytes([i]) for i in range(256)]
+    scores = [0.0] * 256
+    for piece, score in {b"he": 1.0, b"ll": 2.0, b"hello": 4.0}.items():
+        vocab.append(piece)
+        scores.append(score)
+    bos_id = len(vocab)
+    vocab += [b"<s>", b"</s>"]
+    scores += [0.0, 0.0]
+    tok = Tokenizer(
+        vocab, scores, bos_id, [bos_id + 1],
+        chat_template="...<|start_header_id|>...",
+    )
+    tpath = str(tmp_path / "tok.t")
+    tok.save(tpath)
+
+    cfg = LlamaConfig(
+        dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+        vocab_size=len(vocab), seq_len=512,
+    )
+    rng = np.random.default_rng(seed)
+    tensors = {}
+    for name, shape, ft in tensor_plan(cfg):
+        if name.endswith(("rms_att", "rms_ffn")) or name == "final_norm":
+            tensors[name] = np.ones(shape, np.float32)
+        else:
+            tensors[name] = (rng.standard_normal(shape) * 0.05).astype(np.float32)
+    mpath = str(tmp_path / "model.m")
+    save_model(mpath, cfg, tensors)
+    return mpath, tpath, cfg
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    from dllama_tpu.engine.loader import load_model
+    from dllama_tpu.serve.api import make_server
+
+    tmp_path = tmp_path_factory.mktemp("serve")
+    mpath, tpath, cfg = make_tiny_files(tmp_path)
+    loaded = load_model(mpath, tpath, mesh=None)
+    httpd, api = make_server(loaded, host="127.0.0.1", port=0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    yield httpd.server_address[1], api
+    httpd.shutdown()
+
+
+def post(port, path, body):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", path, json.dumps(body), {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def test_models_endpoint(server):
+    port, _ = server
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", "/v1/models")
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    conn.close()
+    assert resp.status == 200
+    assert body["object"] == "list"
+    assert body["data"][0]["id"] == "dllama-tpu"
+
+
+def test_chat_completion_contract(server):
+    port, _ = server
+    status, data = post(port, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 8, "temperature": 0.0,
+    })
+    assert status == 200
+    body = json.loads(data)
+    assert body["object"] == "chat.completion"
+    choice = body["choices"][0]
+    assert choice["message"]["role"] == "assistant"
+    assert choice["finish_reason"] in ("stop", "length")
+    assert body["usage"]["completion_tokens"] <= 8
+    assert body["usage"]["total_tokens"] == body["usage"]["prompt_tokens"] + body["usage"]["completion_tokens"]
+
+
+def test_chat_completion_deterministic_with_temp0(server):
+    port, _ = server
+    req = {"messages": [{"role": "user", "content": "abc"}], "max_tokens": 6, "temperature": 0.0}
+    _, d1 = post(port, "/v1/chat/completions", req)
+    _, d2 = post(port, "/v1/chat/completions", req)
+    assert json.loads(d1)["choices"][0]["message"] == json.loads(d2)["choices"][0]["message"]
+
+
+def test_prefix_cache_reuses_kv(server):
+    port, api = server
+    first = {"messages": [{"role": "user", "content": "one"}], "max_tokens": 4, "temperature": 0.0}
+    _, d1 = post(port, "/v1/chat/completions", first)
+    reply = json.loads(d1)["choices"][0]["message"]["content"]
+    cached_pos = api.cache.pos
+    assert cached_pos > 0
+    assert api.cache.messages[-1] == ("assistant", reply)
+
+    # extending the conversation must resolve to a delta (start_pos == cached)
+    extended = {
+        "messages": first["messages"]
+        + [{"role": "assistant", "content": reply}, {"role": "user", "content": "two"}],
+        "max_tokens": 4,
+        "temperature": 0.0,
+    }
+    delta, start_pos, add_bos = api.cache.resolve(
+        [(m["role"], str(m["content"])) for m in extended["messages"]]
+    )
+    assert start_pos == cached_pos and not add_bos
+    assert [r for r, _ in delta] == ["user"]
+    status, d2 = post(port, "/v1/chat/completions", extended)
+    assert status == 200
+    # a fresh unrelated conversation resets the cache
+    _, _ = post(port, "/v1/chat/completions", {"messages": [{"role": "user", "content": "zzz"}], "max_tokens": 2})
+    assert api.cache.messages[0] == ("user", "zzz")
+
+
+def test_streaming_sse(server):
+    port, _ = server
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request(
+        "POST", "/v1/chat/completions",
+        json.dumps({"messages": [{"role": "user", "content": "hi"}], "max_tokens": 5,
+                    "temperature": 0.0, "stream": True}),
+        {"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type").startswith("text/event-stream")
+    raw = resp.read().decode()
+    conn.close()
+    events = [line[6:] for line in raw.splitlines() if line.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+    text = "".join(c["choices"][0]["delta"].get("content", "") for c in chunks)
+    # streamed text == non-streamed text for the same deterministic request
+    _, d = post(port, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hi"}], "max_tokens": 5, "temperature": 0.0,
+    })
+    assert text == json.loads(d)["choices"][0]["message"]["content"]
+
+
+def test_bad_requests(server):
+    port, _ = server
+    status, data = post(port, "/v1/chat/completions", {"messages": []})
+    assert status == 400
+    status, _ = post(port, "/nope", {})
+    assert status == 404
