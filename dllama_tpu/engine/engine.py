@@ -88,19 +88,20 @@ class InferenceEngine:
             self.cache = shardings.put_cache(self.cache)
             self.rope_cache = shardings.put_replicated(self.rope_cache)
 
+        attn_fn = shardings.attn_fn(batch) if shardings is not None else None
         donate = (1,) if donate_cache else ()
-        self._step = jax.jit(partial(self._step_impl, cfg), donate_argnums=donate)
+        self._step = jax.jit(partial(self._step_impl, cfg, attn_fn), donate_argnums=donate)
         self._decode_n = jax.jit(
-            partial(self._decode_n_impl, cfg), static_argnums=(5,), donate_argnums=donate
+            partial(self._decode_n_impl, cfg, attn_fn), static_argnums=(5,), donate_argnums=donate
         )
 
     @staticmethod
-    def _step_impl(cfg, params, cache, tokens, pos, rope_cache):
-        logits, cache = forward(cfg, params, tokens, pos, cache, rope_cache)
+    def _step_impl(cfg, attn_fn, params, cache, tokens, pos, rope_cache):
+        logits, cache = forward(cfg, params, tokens, pos, cache, rope_cache, attn_fn)
         return logits[:, -1], cache
 
     @staticmethod
-    def _decode_n_impl(cfg, params, cache, token, pos, rope_cache, n):
+    def _decode_n_impl(cfg, attn_fn, params, cache, token, pos, rope_cache, n):
         """n greedy decode steps fused into one device program (lax.scan) —
         no host roundtrip per token. The whole reference decode loop
         (dllama.cpp:69-88: control packet + forward + sample per token)
@@ -108,7 +109,7 @@ class InferenceEngine:
 
         def body(carry, _):
             token, cache, p = carry
-            logits, cache = forward(cfg, params, token, p, cache, rope_cache)
+            logits, cache = forward(cfg, params, token, p, cache, rope_cache, attn_fn)
             nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
             return (nxt, cache, p + 1), nxt[:, 0]
 

@@ -52,7 +52,7 @@ class KVCache:
         return self.k.shape[3]
 
 
-def _layer(cfg: LlamaConfig, x, lp, k_cache, v_cache, rope, pos_base):
+def _layer(cfg: LlamaConfig, x, lp, k_cache, v_cache, rope, pos_base, attn_fn):
     b, t, d = x.shape
     # --- attention block (reference "att" segment, llm.cpp:198-312)
     h = rms_norm(x, lp["rms_att"], cfg.norm_epsilon)
@@ -67,7 +67,7 @@ def _layer(cfg: LlamaConfig, x, lp, k_cache, v_cache, rope, pos_base):
     v_cache = jax.lax.dynamic_update_slice(
         v_cache, v.transpose(0, 2, 1, 3).astype(v_cache.dtype), (0, 0, pos_base, 0)
     )
-    att = gqa_attention(q, k_cache, v_cache, pos_base).reshape(b, t, d)
+    att = attn_fn(q, k_cache, v_cache, pos_base).reshape(b, t, d)
     x = x + matmul(att, lp["wo"])
     # --- feed-forward block (reference "ff" segment, llm.cpp:314-385)
     h = rms_norm(x, lp["rms_ffn"], cfg.norm_epsilon)
@@ -84,8 +84,12 @@ def forward(
     pos_base: jax.Array,  # scalar i32
     cache: KVCache,
     rope_cache: jax.Array,  # [seq, head_size/2, 2]
+    attn_fn=None,  # (q, k_cache, v_cache, pos) -> out; default full-cache GQA.
+    # A sequence-parallel mesh passes the shard_map'd LSE-merge attention here
+    # (parallel/ring_attention.sp_cache_attention).
 ) -> tuple[jax.Array, KVCache]:
     """Returns (logits f32 [B, T, vocab], updated cache)."""
+    attn_fn = attn_fn or gqa_attention
     x = params["embedding"][tokens]  # [B, T, D]
     t = tokens.shape[1]
     rope = jax.lax.dynamic_slice_in_dim(rope_cache, pos_base, t, axis=0)
@@ -93,7 +97,7 @@ def forward(
     def scan_fn(carry, xs):
         x = carry
         lp, kc, vc = xs
-        x, kc, vc = _layer(cfg, x, lp, kc, vc, rope, pos_base)
+        x, kc, vc = _layer(cfg, x, lp, kc, vc, rope, pos_base, attn_fn)
         return x, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(scan_fn, x, (params["layers"], cache.k, cache.v))

@@ -106,5 +106,15 @@ class LlamaShardings:
     def put_replicated(self, x):
         return jax.device_put(x, self._named(P()))
 
+    def attn_fn(self, batch: int):
+        """shard_map'd sequence-parallel attention when sp > 1, else None
+        (plain full-cache GQA; XLA handles tp head sharding by itself)."""
+        if self.mesh.shape["sp"] == 1:
+            return None
+        from dllama_tpu.parallel.ring_attention import make_sp_attention
+
+        dp = "dp" if batch % self.mesh.shape["dp"] == 0 else None
+        return make_sp_attention(self.mesh, dp)
+
     def tokens_spec(self) -> P:
         return P("dp", None)
