@@ -93,11 +93,14 @@ class LlamaShardings:
             is_leaf=lambda x: isinstance(x, P),
         )
 
+    def _batch_axis(self, batch: int) -> str | None:
+        # batch shards over dp only when divisible (a single sequence stays
+        # replicated over dp)
+        return "dp" if batch % self.mesh.shape["dp"] == 0 else None
+
     def cache_spec(self, batch: int) -> P:
-        # [n_layers, batch, n_kv_heads, seq, head_size]; batch shards over dp
-        # only when divisible (a single sequence stays replicated over dp)
-        dp = "dp" if batch % self.mesh.shape["dp"] == 0 else None
-        return P(None, dp, "tp", "sp", None)
+        # [n_layers, batch, n_kv_heads, seq, head_size]
+        return P(None, self._batch_axis(batch), "tp", "sp", None)
 
     def put_cache(self, cache: KVCache) -> KVCache:
         s = self._named(self.cache_spec(batch=cache.k.shape[1]))
@@ -113,8 +116,7 @@ class LlamaShardings:
             return None
         from dllama_tpu.parallel.ring_attention import make_sp_attention
 
-        dp = "dp" if batch % self.mesh.shape["dp"] == 0 else None
-        return make_sp_attention(self.mesh, dp)
+        return make_sp_attention(self.mesh, self._batch_axis(batch))
 
     def tokens_spec(self) -> P:
         return P("dp", None)

