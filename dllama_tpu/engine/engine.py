@@ -72,6 +72,7 @@ class InferenceEngine:
         max_prefill_chunk: int = 128,
         shardings=None,
         donate_cache: bool = True,
+        attn_impl: str = "auto",  # 'auto' | 'jnp' | 'flash' (Pallas online-softmax)
     ):
         self.cfg = cfg
         self.params = params
@@ -89,6 +90,17 @@ class InferenceEngine:
             self.rope_cache = shardings.put_replicated(self.rope_cache)
 
         attn_fn = shardings.attn_fn(batch) if shardings is not None else None
+        if attn_fn is None and attn_impl != "jnp":
+            # Pallas flash attention: default on real TPU, opt-in elsewhere.
+            # (sp > 1 already routed to the shard_map'd sequence-parallel path.)
+            from dllama_tpu.ops.pallas.flash_attention import flash_gqa_attention, supported
+
+            on_tpu = jax.devices()[0].platform == "tpu"
+            if supported((cfg.n_heads, cfg.head_size), self.seq_len) and (
+                attn_impl == "flash" or on_tpu
+            ):
+                # off-TPU the Mosaic kernel can't lower; run the interpreter
+                attn_fn = partial(flash_gqa_attention, interpret=not on_tpu)
         donate = (1,) if donate_cache else ()
         self._step = jax.jit(partial(self._step_impl, cfg, attn_fn), donate_argnums=donate)
         self._decode_n = jax.jit(

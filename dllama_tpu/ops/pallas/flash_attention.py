@@ -1,0 +1,142 @@
+"""Flash-style causal GQA attention over the KV cache — Pallas TPU kernel.
+
+The reference computes attention per head with an explicit scores buffer of
+size seqLen (multiheadAtt_F32, nn-cpu-ops.cpp:752-787): scores → softmax →
+weighted sum, all materialized. On TPU that buffer would round-trip HBM; this
+kernel is the online-softmax (flash) formulation instead — the KV cache is
+streamed tile-by-tile through VMEM while a running (max, sum, acc) state stays
+resident, so nothing of size S ever leaves the chip.
+
+Layout: queries are folded to [B*Hq, T, hd] and the grid walks
+(head, q_tile, kv_tile) with the kv sweep innermost ("arbitrary" — it carries
+the accumulator). GQA is handled in the k/v index map: query head h reads
+cache head h // group, so no materialized repeat_kv.
+
+Causality follows gqa_attention's fixed-size-cache masking (ops/layers.py):
+query t sees cache slots s <= pos_base + t, which also masks the unwritten
+tail of the ring buffer.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dllama_tpu.ops.pallas.tiling import pick_tile as _pick_tile
+
+_NEG_INF = -1e30  # large-finite: keeps fully-masked tiles NaN-free
+
+
+def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref, *, scale, tq, ts):
+    iq = pl.program_id(1)
+    ks = pl.program_id(2)
+
+    @pl.when(ks == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[:].astype(jnp.float32)  # [tq, hd]
+    k = k_ref[:].astype(jnp.float32)  # [ts, hd]
+    v = v_ref[:].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    s = s * scale  # [tq, ts]
+
+    # causal mask against absolute cache positions (query row r is token
+    # pos_base + iq*tq + r; padded tail rows are discarded by the wrapper)
+    qpos = pos_ref[0] + iq * tq + jax.lax.broadcasted_iota(jnp.int32, (tq, ts), 0)
+    span = ks * ts + jax.lax.broadcasted_iota(jnp.int32, (tq, ts), 1)
+    mask = span <= qpos
+    s = jnp.where(mask, s, _NEG_INF)
+
+    m_prev = m_ref[:][:, :1]  # replicated across lanes; take one
+    l_prev = l_ref[:][:, :1]
+    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_cur)
+    p = jnp.where(mask, jnp.exp(s - m_cur), 0.0)  # [tq, ts]
+    l_cur = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot(p, v, preferred_element_type=jnp.float32)
+    m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_cur, l_ref.shape)
+
+    @pl.when(ks == pl.num_programs(2) - 1)
+    def _():
+        l = l_ref[:][:, :1]
+        out_ref[:] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("group", "interpret"))
+def _flash_folded(q, k, v, pos, *, group: int, interpret: bool):
+    """q[BHq, Tp, hd] x cache[BHkv, S, hd] -> [BHq, Tp, hd] f32."""
+    bhq, tp, hd = q.shape
+    s = k.shape[1]
+    tq = _pick_tile(tp, (128, 64, 32, 16, 8))
+    ts = _pick_tile(s, (512, 256, 128, 64))
+    grid = (bhq, tp // tq, s // ts)
+    return pl.pallas_call(
+        functools.partial(_kernel, scale=1.0 / math.sqrt(hd), tq=tq, ts=ts),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # pos: i32[1]
+            pl.BlockSpec((None, tq, hd), lambda h, i, ks: (h, i, 0)),
+            pl.BlockSpec((None, ts, hd), lambda h, i, ks: (h // group, ks, 0)),
+            pl.BlockSpec((None, ts, hd), lambda h, i, ks: (h // group, ks, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, tq, hd), lambda h, i, ks: (h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bhq, tp, hd), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((tq, hd), jnp.float32),
+            pltpu.VMEM((tq, 128), jnp.float32),
+            pltpu.VMEM((tq, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bhq * tp * s * hd,
+            bytes_accessed=(bhq * tp * hd * 2) * q.dtype.itemsize
+            + 2 * (bhq // group) * s * hd * k.dtype.itemsize,
+            transcendentals=bhq * tp * s,
+        ),
+        interpret=interpret,
+    )(pos, q, k, v)
+
+
+def flash_gqa_attention(
+    q: jax.Array,  # [B, T, Hq, hd]
+    k_cache: jax.Array,  # [B, Hkv, S, hd]
+    v_cache: jax.Array,  # [B, Hkv, S, hd]
+    pos_base: jax.Array,  # scalar i32
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Drop-in for ops.layers.gqa_attention (same signature/semantics)."""
+    b, t, hq, hd = q.shape
+    hkv, s = k_cache.shape[1], k_cache.shape[2]
+    group = hq // hkv
+    qf = q.transpose(0, 2, 1, 3).reshape(b * hq, t, hd)
+    pad = (-t) % 8
+    if pad:
+        qf = jnp.pad(qf, ((0, 0), (0, pad), (0, 0)))
+    out = _flash_folded(
+        qf,
+        k_cache.reshape(b * hkv, s, hd),
+        v_cache.reshape(b * hkv, s, hd),
+        jnp.reshape(pos_base, (1,)).astype(jnp.int32),
+        group=group,
+        interpret=interpret,
+    )
+    if pad:
+        out = out[:, :t]
+    return out.reshape(b, hq, t, hd).transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def supported(q_shape: tuple[int, ...], cache_seq_len: int) -> bool:
+    """Tileability check for the engine's attention dispatcher."""
+    return cache_seq_len % 64 == 0 and q_shape[-1] >= 8

@@ -24,14 +24,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dllama_tpu.ops.pallas.tiling import pick_tile as _pick_tile
 from dllama_tpu.ops.quant import Q_BLOCK, QTensor
-
-
-def _pick_tile(dim: int, candidates: tuple[int, ...]) -> int | None:
-    for c in candidates:
-        if dim % c == 0:
-            return c
-    return None
 
 
 def _kernel(x_ref, packed_ref, scales_ref, out_ref, acc_ref, *, tk: int, tn: int):
@@ -60,9 +54,9 @@ def q40_matmul_2d(x: jax.Array, packed: jax.Array, scales: jax.Array, *, interpr
     """x[m, k] @ dequant(packed, scales)[k, n] -> f32[m, n]."""
     m, k = x.shape
     n = packed.shape[1]
-    tm = _pick_tile(m, (256, 128, 64, 32, 16, 8)) or m
-    tn = _pick_tile(n, (512, 256, 128)) or n
-    tk = _pick_tile(k, (512, 256, 128, 64, 32)) or k
+    tm = _pick_tile(m, (256, 128, 64, 32, 16, 8))
+    tn = _pick_tile(n, (512, 256, 128))
+    tk = _pick_tile(k, (512, 256, 128, 64, 32))
     assert k % Q_BLOCK == 0 and tk % Q_BLOCK == 0, (k, tk)
 
     grid = (m // tm, n // tn, k // tk)

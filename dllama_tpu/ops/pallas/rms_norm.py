@@ -1,0 +1,59 @@
+"""Fused RMSNorm Pallas kernel.
+
+The reference splits this into two ops — OP_INV_RMS then OP_RMS_NORM
+(nn-cpu-ops.cpp:108-183) — because its executor has no fusion. XLA usually
+fuses the jnp version (ops/layers.rms_norm) into neighbors on its own; this
+kernel exists for the cases where it doesn't (norm feeding a Pallas matmul,
+which XLA treats as an opaque call and won't fuse across) and as the
+single-pass reference for kernel-equivalence tests: one VMEM-resident tile,
+f32 accumulation, rsqrt, weight multiply, one HBM read + one write per row.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from dllama_tpu.ops.pallas.tiling import pick_tile as _pick_tile
+
+
+def _kernel(x_ref, w_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    o_ref[:] = (x * inv * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
+def _rms_norm_2d(x: jax.Array, w: jax.Array, *, eps: float, interpret: bool) -> jax.Array:
+    rows, d = x.shape
+    tr = _pick_tile(rows, (256, 128, 64, 32, 16, 8))
+    return pl.pallas_call(
+        functools.partial(_kernel, eps=eps),
+        grid=(rows // tr,),
+        in_specs=[
+            pl.BlockSpec((tr, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tr, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+        interpret=interpret,
+    )(x, w.reshape(1, d))
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float, *, interpret: bool = False) -> jax.Array:
+    """Drop-in for ops.layers.rms_norm: y = x * w / rms(x), any leading dims."""
+    *lead, d = x.shape
+    m = 1
+    for s in lead:
+        m *= s
+    x2 = x.reshape(m, d)
+    pad = (-m) % 8
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    out = _rms_norm_2d(x2, weight, eps=eps, interpret=interpret)
+    if pad:
+        out = out[:m]
+    return out.reshape(*lead, d)

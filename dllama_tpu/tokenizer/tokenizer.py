@@ -39,6 +39,7 @@ class Tokenizer:
         eos_ids: list[int],
         chat_template: str | None = None,
         max_token_length: int | None = None,
+        special_ids: list[int] | None = None,
     ):
         self.vocab = vocab
         self.scores = scores
@@ -46,10 +47,19 @@ class Tokenizer:
         self.eos_ids = list(eos_ids)
         self.chat_template = chat_template
         self.max_token_length = max_token_length or max((len(v) for v in vocab), default=0)
-        # regular/special split mirrors tokenizer.cpp:166-181 (bos splits them)
-        self.regular_vocab_size = bos_id if bos_id >= 0 else len(vocab)
-        self._regular_index = {v: i for i, v in enumerate(vocab[: self.regular_vocab_size])}
-        self._special_ids = list(range(self.regular_vocab_size, len(vocab)))
+        # regular/special split (tokenizer.cpp:166-181 role). When not given
+        # explicitly: HF/llama3 layouts put all specials in a tail starting at
+        # bos; sentencepiece-style vocabs put bos/eos at the *head* with the
+        # whole merge vocabulary after them, so there only bos/eos are special.
+        if special_ids is None:
+            if bos_id >= 0 and 2 * bos_id >= len(vocab):
+                special_ids = list(range(bos_id, len(vocab)))
+            else:
+                special_ids = [i for i in {bos_id, *self.eos_ids} if 0 <= i < len(vocab)]
+        self._special_ids = sorted(set(special_ids))
+        special = set(self._special_ids)
+        self.regular_vocab_size = len(vocab) - len(special)
+        self._regular_index = {v: i for i, v in enumerate(vocab) if i not in special}
         self._utf8 = codecs.getincrementaldecoder("utf-8")("replace")
 
     # ------------------------------------------------------------------ file io

@@ -15,11 +15,14 @@ import argparse
 import json
 import os
 import sys
-import time
 
-from dllama_tpu.models.formats import tensor_plan, write_header, write_tensor
 from dllama_tpu.ops.quant import parse_float_type
-from dllama_tpu.tools.converter_core import hf_config_to_llama, hf_tensor_for
+from dllama_tpu.tools.converter_core import (
+    default_output_name,
+    hf_config_to_llama,
+    hf_tensor_for,
+    write_model,
+)
 
 
 class SafetensorsDir:
@@ -81,22 +84,11 @@ def convert_hf(model_dir: str, weight_type_name: str, output: str | None = None,
     if max_seq_len:
         cfg = cfg.clamp_seq_len(max_seq_len)
     if output is None:
-        base = os.path.basename(os.path.normpath(model_dir)).lower().replace(" ", "-")
-        output = f"dllama_model_{base}_{weight_type_name.lower()}.m"
+        output = default_output_name(model_dir, weight_type_name)
 
     src = SafetensorsDir(model_dir)
-    plan = tensor_plan(cfg)
-    t0 = time.time()
-    with open(output, "wb") as f:
-        write_header(f, cfg)
-        for i, (name, shape, ft) in enumerate(plan):
-            x = hf_tensor_for(name, cfg, src.get)
-            if tuple(x.shape) != tuple(shape):
-                raise ValueError(f"{name}: expected shape {shape}, got {x.shape}")
-            nbytes = write_tensor(f, x, ft)
-            print(f"💾 [{i + 1}/{len(plan)}] {name} {tuple(shape)} -> {nbytes} bytes", flush=True)
+    write_model(cfg, output, lambda name: hf_tensor_for(name, cfg, src.get))
     src.close()
-    print(f"✅ Created {output} ({os.path.getsize(output) / 1e9:.2f} GB, {time.time() - t0:.1f}s)")
     return output
 
 

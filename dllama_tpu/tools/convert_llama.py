@@ -21,8 +21,8 @@ from pathlib import Path
 import numpy as np
 
 from dllama_tpu.models.config import ArchType, HiddenAct, LlamaConfig, RopeType
-from dllama_tpu.models.formats import tensor_plan, write_header, write_tensor
 from dllama_tpu.ops.quant import parse_float_type
+from dllama_tpu.tools.converter_core import default_output_name, write_model
 
 # `.m` plan short name -> (Meta name template, shard concat axis or None)
 META_NAME_MAP = {
@@ -119,23 +119,15 @@ def convert_llama(model_dir: str, weight_type_name: str, output: str | None = No
     hidden_dim = derive_hidden_dim(params, ckpt.w1_shard_rows(), ckpt.n_shards)
     cfg = meta_params_to_config(params, hidden_dim, weight_type)
     if output is None:
-        base = os.path.basename(os.path.normpath(model_dir)).lower().replace(" ", "-")
-        output = f"dllama_model_{base}_{weight_type_name.lower()}.m"
+        output = default_output_name(model_dir, weight_type_name)
 
-    plan = tensor_plan(cfg)
-    with open(output, "wb") as f:
-        write_header(f, cfg)
-        for i, (name, shape, ft) in enumerate(plan):
-            parts = name.split(".")
-            layer = int(parts[1]) if len(parts) == 3 else None
-            short = parts[-1] if len(parts) == 3 else name
-            x = ckpt.get(short, layer)
-            if tuple(x.shape) != tuple(shape):
-                raise ValueError(f"{name}: expected shape {shape}, got {x.shape}")
-            nbytes = write_tensor(f, x, ft)
-            print(f"💾 [{i + 1}/{len(plan)}] {name} {tuple(shape)} -> {nbytes} bytes", flush=True)
-    print(f"✅ Created {output} ({os.path.getsize(output) / 1e9:.2f} GB)")
-    return output
+    def get_tensor(name: str) -> np.ndarray:
+        parts = name.split(".")
+        layer = int(parts[1]) if len(parts) == 3 else None
+        short = parts[-1] if len(parts) == 3 else name
+        return ckpt.get(short, layer)
+
+    return write_model(cfg, output, get_tensor)
 
 
 def main(argv=None) -> int:

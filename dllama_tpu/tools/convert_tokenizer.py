@@ -19,6 +19,7 @@ import argparse
 import base64
 import json
 import os
+import re
 import struct
 import sys
 
@@ -77,14 +78,37 @@ def convert_hf_tokenizer(dir_path: str) -> Tokenizer:
         added_ids.add(added["id"])
     vocab_size = max(id_to_token) + 1
 
+    # Two families of HF BPE tokenizer.json: GPT-2 *byte-level* (Llama-3 etc.,
+    # tokens are printable-codepoint aliases of raw bytes) and *metaspace*
+    # sentencepiece-style (Mistral, Llama-2-HF: U+2581 word boundary + <0xXX>
+    # byte-fallback pieces). Distinguish via the pre_tokenizer/decoder config.
+    def _component_types(section) -> list[str]:
+        if not isinstance(section, dict):
+            return []
+        subs = section.get("pretokenizers") or section.get("decoders") or []
+        return [section.get("type", "")] + [s.get("type", "") for s in subs if isinstance(s, dict)]
+
+    kinds = _component_types(tok_json.get("pre_tokenizer")) + _component_types(tok_json.get("decoder"))
+    byte_level = "ByteLevel" in kinds
+    if not byte_level and "Metaspace" not in kinds and not any(
+        "▁" in t for t in id_to_token.values()
+    ):
+        byte_level = True  # no metaspace evidence anywhere: treat as byte-level
+
     decoder = byte_decoder()
+    byte_fallback = re.compile(r"<0x[0-9A-Fa-f]{2}>")
     vocab: list[bytes] = []
     scores: list[float] = []
     for i in range(vocab_size):
         token = id_to_token.get(i)
         if token is None:
             raise ValueError(f"vocabulary has a hole at id {i}")
-        raw = token.encode("utf-8") if i in added_ids else token_str_to_bytes(token, decoder)
+        if i in added_ids:
+            raw = token.encode("utf-8")
+        elif byte_level:
+            raw = token_str_to_bytes(token, decoder)
+        else:
+            raw = sentencepiece_piece_to_bytes(token, 6 if byte_fallback.fullmatch(token) else 1)
         vocab.append(raw)
         scores.append(-float(i))
 
@@ -121,16 +145,19 @@ def convert_hf_tokenizer(dir_path: str) -> Tokenizer:
             eos_ids.append(tid)
 
     return Tokenizer(
-        vocab, scores, bos_id, eos_ids, chat_template=tok_config.get("chat_template")
+        vocab, scores, bos_id, eos_ids,
+        chat_template=tok_config.get("chat_template"),
+        special_ids=sorted(added_ids | {bos_id, *eos_ids}),
     )
 
 
 # ------------------------------------------------------------------ llama2 (sentencepiece)
 
 
-def parse_sentencepiece_model(path: str) -> list[tuple[str, float]]:
+def parse_sentencepiece_model(path: str) -> list[tuple[str, float, int]]:
     """Minimal protobuf reader for sentencepiece ModelProto: extracts the
-    repeated `pieces` field (#1), each {piece: string #1, score: float #2}.
+    repeated `pieces` field (#1), each {piece: string #1, score: float #2,
+    type: enum #3 (NORMAL=1, UNKNOWN=2, CONTROL=3, USER_DEFINED=4, BYTE=6)}.
     Avoids the sentencepiece dependency entirely."""
     with open(path, "rb") as f:
         data = f.read()
@@ -159,7 +186,7 @@ def parse_sentencepiece_model(path: str) -> list[tuple[str, float]]:
             raise ValueError(f"unsupported wire type {wire}")
         return i
 
-    pieces: list[tuple[str, float]] = []
+    pieces: list[tuple[str, float, int]] = []
     i = 0
     while i < len(data):
         tag, i = read_varint(data, i)
@@ -167,7 +194,7 @@ def parse_sentencepiece_model(path: str) -> list[tuple[str, float]]:
         if field == 1 and wire == 2:  # repeated SentencePiece
             n, i = read_varint(data, i)
             sub, j = data[i : i + n], 0
-            piece, score = "", 0.0
+            piece, score, ptype = "", 0.0, 1  # type defaults to NORMAL
             while j < len(sub):
                 tag2, j = read_varint(sub, j)
                 f2, w2 = tag2 >> 3, tag2 & 7
@@ -178,9 +205,11 @@ def parse_sentencepiece_model(path: str) -> list[tuple[str, float]]:
                 elif f2 == 2 and w2 == 5:
                     score = struct.unpack("<f", sub[j : j + 4])[0]
                     j += 4
+                elif f2 == 3 and w2 == 0:
+                    ptype, j = read_varint(sub, j)
                 else:
                     j = skip_field(sub, j, w2)
-            pieces.append((piece, score))
+            pieces.append((piece, score, ptype))
             i += n
         else:
             i = skip_field(data, i, wire)
@@ -203,12 +232,25 @@ LLAMA2_CHAT_TEMPLATE = (
 )
 
 
+def sentencepiece_piece_to_bytes(piece: str, ptype: int) -> bytes:
+    """Piece string -> raw bytes: BYTE-type '<0xXX>' fallback pieces become the
+    literal byte (so byte-level seeding in Tokenizer.encode covers all input);
+    metaspace U+2581 becomes an ordinary space; everything else is UTF-8."""
+    if ptype == 6 and re.fullmatch(r"<0x[0-9A-Fa-f]{2}>", piece):
+        return bytes([int(piece[3:5], 16)])
+    return piece.replace("\u2581", " ").encode("utf-8")
+
+
 def convert_llama2_tokenizer(dir_path: str) -> Tokenizer:
     pieces = parse_sentencepiece_model(os.path.join(dir_path, "tokenizer.model"))
-    vocab = [p.replace("\u2581", " ").encode("utf-8") for p, _ in pieces]
-    scores = [s for _, s in pieces]
+    vocab = [sentencepiece_piece_to_bytes(p, t) for p, _, t in pieces]
+    scores = [s for _, s, _ in pieces]
+    # specials: CONTROL (<s>, </s>), UNKNOWN (<unk>), USER_DEFINED pieces \u2014
+    # everything else (incl. BYTE fallbacks) stays in the merge vocabulary
+    special_ids = [i for i, (_, _, t) in enumerate(pieces) if t in (2, 3, 4)]
     bos_id, eos_id = 1, 2  # sentencepiece llama2 convention (<s>, </s>)
-    return Tokenizer(vocab, scores, bos_id, [eos_id], chat_template=LLAMA2_CHAT_TEMPLATE)
+    return Tokenizer(vocab, scores, bos_id, [eos_id],
+                     chat_template=LLAMA2_CHAT_TEMPLATE, special_ids=special_ids)
 
 
 # ------------------------------------------------------------------ llama3 (tiktoken)
@@ -255,7 +297,8 @@ def convert_llama3_tokenizer(model_path: str) -> Tokenizer:
         scores.append(-float(n_base + i))
     bos_id, eos_id, chat_eos_id = n_base, n_base + 1, n_base + 9
     return Tokenizer(vocab, scores, bos_id, [eos_id, chat_eos_id],
-                     chat_template=LLAMA3_CHAT_TEMPLATE)
+                     chat_template=LLAMA3_CHAT_TEMPLATE,
+                     special_ids=list(range(n_base, len(vocab))))
 
 
 # ------------------------------------------------------------------ cli

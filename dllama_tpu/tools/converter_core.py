@@ -112,3 +112,33 @@ def hf_tensor_for(name: str, cfg: LlamaConfig, get) -> np.ndarray:
         except KeyError:
             return get(HF_NAME_MAP["embedding"])  # tied embeddings
     return get(HF_NAME_MAP[name])
+
+
+def default_output_name(model_dir: str, weight_type_name: str) -> str:
+    import os
+
+    base = os.path.basename(os.path.normpath(model_dir)).lower().replace(" ", "-")
+    return f"dllama_model_{base}_{weight_type_name.lower()}.m"
+
+
+def write_model(cfg: LlamaConfig, output: str, get_tensor) -> str:
+    """Stream the full tensor plan to `output`: header, then each tensor from
+    ``get_tensor(plan_name) -> np.ndarray f32``, shape-checked and quantized
+    per the plan. Shared by the HF and Meta converter CLIs."""
+    import os
+    import time
+
+    from dllama_tpu.models.formats import tensor_plan, write_header, write_tensor
+
+    plan = tensor_plan(cfg)
+    t0 = time.time()
+    with open(output, "wb") as f:
+        write_header(f, cfg)
+        for i, (name, shape, ft) in enumerate(plan):
+            x = get_tensor(name)
+            if tuple(x.shape) != tuple(shape):
+                raise ValueError(f"{name}: expected shape {shape}, got {x.shape}")
+            nbytes = write_tensor(f, x, ft)
+            print(f"💾 [{i + 1}/{len(plan)}] {name} {tuple(shape)} -> {nbytes} bytes", flush=True)
+    print(f"✅ Created {output} ({os.path.getsize(output) / 1e9:.2f} GB, {time.time() - t0:.1f}s)")
+    return output

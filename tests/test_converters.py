@@ -259,9 +259,10 @@ def test_parse_sentencepiece_model(tmp_path):
         body += bytes([0x18, ptype])  # field 3, wire 0
         return bytes([0x0A, len(body)]) + body  # outer field 1, wire 2
 
-    pieces = [(b"<unk>", 0.0), (b"<s>", 0.0), (b"</s>", 0.0),
-              ("▁hello".encode(), -1.5), (b"x", -2.25)]
-    blob = b"".join(sp_piece(p, s) for p, s in pieces)
+    pieces = [(b"<unk>", 0.0, 2), (b"<s>", 0.0, 3), (b"</s>", 0.0, 3),
+              ("▁hello".encode(), -1.5, 1), (b"x", -2.25, 1),
+              (b"<0x0A>", -3.0, 6), (b"<0x68>", -3.5, 6)]
+    blob = b"".join(sp_piece(p, s, t) for p, s, t in pieces)
     # trailing unknown field (trainer_spec, field 2 wire 2) must be skipped
     blob += bytes([0x12, 3]) + b"abc"
     d = tmp_path / "sptok"
@@ -270,11 +271,19 @@ def test_parse_sentencepiece_model(tmp_path):
         f.write(blob)
 
     parsed = convert_tokenizer.parse_sentencepiece_model(str(d / "tokenizer.model"))
-    assert [p for p, _ in parsed] == ["<unk>", "<s>", "</s>", "▁hello", "x"]
-    assert parsed[3][1] == -1.5
+    assert [p for p, _, _ in parsed] == [
+        "<unk>", "<s>", "</s>", "▁hello", "x", "<0x0A>", "<0x68>"
+    ]
+    assert parsed[3][1] == -1.5 and parsed[0][2] == 2 and parsed[5][2] == 6
 
     tok = convert_tokenizer.convert_llama2_tokenizer(str(d))
     assert tok.vocab[3] == b" hello" and tok.bos_id == 1
+    # BYTE fallback pieces become raw bytes in the merge vocabulary, so any
+    # byte sequence tokenizes (the '<0x0A>' literal-string bug regression)
+    assert tok.vocab[5] == b"\n" and tok.vocab[6] == b"h"
+    assert tok.encode("h\n", add_bos=False) == [6, 5]
+    # control/unknown pieces are special, not merge candidates
+    assert tok.regular_vocab_size == len(tok.vocab) - 3
 
 
 def test_convert_llama3_tokenizer(tmp_path):
@@ -296,3 +305,37 @@ def test_convert_tokenizer_cli(tmp_path, monkeypatch):
     assert convert_tokenizer.main(["llama3", str(model), "--name", "test"]) == 0
     tok = Tokenizer.load(str(tmp_path / "dllama_tokenizer_test.t"))
     assert len(tok.vocab) == 16 + 256
+
+
+def test_convert_hf_tokenizer_metaspace_style(tmp_path):
+    """Mistral/Llama-2-HF layout: BPE tokenizer.json with Metaspace + byte
+    fallback and specials at the *head* — must not go through the GPT-2 byte
+    decoder, and the head specials must not truncate the merge vocabulary."""
+    vocab = {"<unk>": 0, "<s>": 1, "</s>": 2, "<0x0A>": 3, "h": 4, "i": 5,
+             "▁": 6, "hi": 7, "▁hi": 8}
+    tok_json = {
+        "model": {"type": "BPE", "vocab": vocab, "merges": ["h i", "▁ hi"]},
+        "pre_tokenizer": {"type": "Metaspace"},
+        "decoder": {"type": "Sequence", "decoders": [
+            {"type": "Replace"}, {"type": "ByteFallback"}]},
+        "added_tokens": [
+            {"id": 0, "content": "<unk>"},
+            {"id": 1, "content": "<s>"},
+            {"id": 2, "content": "</s>"},
+        ],
+    }
+    d = tmp_path / "mstok"
+    d.mkdir()
+    with open(d / "tokenizer.json", "w") as f:
+        json.dump(tok_json, f)
+    with open(d / "tokenizer_config.json", "w") as f:
+        json.dump({"bos_token": "<s>", "eos_token": "</s>"}, f)
+
+    tok = convert_tokenizer.convert_hf_tokenizer(str(d))
+    assert tok.bos_id == 1 and tok.eos_ids == [2]
+    assert tok.vocab[6] == b" " and tok.vocab[8] == b" hi"  # metaspace -> space
+    assert tok.vocab[3] == b"\n"  # byte fallback -> raw byte
+    # head specials stay special; the rest is mergeable
+    assert tok.regular_vocab_size == len(tok.vocab) - 3
+    assert tok.encode(" hi", add_bos=False) == [8]
+    assert tok.encode("hi\n", add_bos=False) == [7, 3]

@@ -53,3 +53,89 @@ def test_q40_matmul_exact_on_roundtrip_values(rng):
     got = q40_matmul(x, w, interpret=True)
     want = w.dequantize(jnp.float32)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=0, rtol=0)
+
+
+# ---------------------------------------------------------------- flash attn
+
+
+@pytest.mark.parametrize(
+    "b,t,hq,hkv,hd,s,pos",
+    [
+        (1, 1, 8, 4, 64, 256, 0),  # decode at start
+        (1, 1, 8, 4, 64, 256, 200),  # decode deep in the cache
+        (1, 16, 8, 8, 64, 128, 0),  # MHA prefill chunk
+        (2, 64, 8, 2, 128, 256, 64),  # GQA batched prefill mid-sequence
+        (1, 3, 4, 4, 64, 128, 5),  # odd T -> row-pad path
+    ],
+)
+def test_flash_attention_matches_jnp(rng, b, t, hq, hkv, hd, s, pos):
+    from dllama_tpu.ops.layers import gqa_attention
+    from dllama_tpu.ops.pallas.flash_attention import flash_gqa_attention
+
+    q = jnp.asarray(rng.standard_normal((b, t, hq, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, hkv, s, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, hkv, s, hd)), jnp.float32)
+    got = flash_gqa_attention(q, k, v, jnp.int32(pos), interpret=True)
+    want = gqa_attention(q, k, v, jnp.int32(pos))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_bf16_io(rng):
+    from dllama_tpu.ops.layers import gqa_attention
+    from dllama_tpu.ops.pallas.flash_attention import flash_gqa_attention
+
+    q = jnp.asarray(rng.standard_normal((1, 8, 8, 64)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((1, 4, 128, 64)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((1, 4, 128, 64)), jnp.bfloat16)
+    got = flash_gqa_attention(q, k, v, jnp.int32(32), interpret=True)
+    assert got.dtype == jnp.bfloat16
+    want = gqa_attention(q, k, v, jnp.int32(32))
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), atol=3e-2, rtol=3e-2
+    )
+
+
+def test_flash_attention_in_model_forward(rng):
+    """Full forward with the Pallas attn_fn vs the jnp default — end-to-end
+    parity, the analog of swapping kernels under the reference executor."""
+    from dllama_tpu.models.config import LlamaConfig
+    from dllama_tpu.models.llama import KVCache, forward, random_params
+    from dllama_tpu.ops.layers import build_rope_cache
+    from dllama_tpu.ops.pallas.flash_attention import flash_gqa_attention
+    from functools import partial
+
+    cfg = LlamaConfig(dim=128, hidden_dim=256, n_layers=2, n_heads=4, n_kv_heads=2,
+                      vocab_size=256, seq_len=64)
+    params = random_params(cfg, seed=1, dtype=jnp.float32, quantize=False)
+    rope = build_rope_cache(cfg)
+    toks = jnp.asarray(rng.integers(0, 256, (1, 8)), jnp.int32)
+
+    cache0 = KVCache.create(cfg, 1, jnp.float32)
+    ref_logits, _ = forward(cfg, params, toks, jnp.int32(0), cache0, rope)
+    cache1 = KVCache.create(cfg, 1, jnp.float32)
+    got_logits, _ = forward(
+        cfg, params, toks, jnp.int32(0), cache1, rope,
+        attn_fn=partial(flash_gqa_attention, interpret=True),
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_logits), np.asarray(ref_logits), atol=1e-4, rtol=1e-4
+    )
+
+
+# ------------------------------------------------------------------ rms norm
+
+
+@pytest.mark.parametrize("shape", [(1, 1, 256), (2, 16, 512), (5, 384)])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_rms_norm_pallas_matches_jnp(rng, shape, dtype):
+    from dllama_tpu.ops.layers import rms_norm as rms_ref
+    from dllama_tpu.ops.pallas.rms_norm import rms_norm as rms_pallas
+
+    x = jnp.asarray(rng.standard_normal(shape), dtype)
+    w = jnp.asarray(rng.standard_normal(shape[-1]) * 0.5 + 1.0, jnp.float32)
+    got = rms_pallas(x, w, 1e-5, interpret=True)
+    want = rms_ref(x, w, 1e-5)
+    assert got.dtype == want.dtype
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), atol=2e-2, rtol=2e-2
+    )
