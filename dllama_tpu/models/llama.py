@@ -77,6 +77,30 @@ def _layer(cfg: LlamaConfig, x, lp, k_cache, v_cache, rope, pos_base, attn_fn):
     return x, k_cache, v_cache
 
 
+def run_layers(
+    cfg: LlamaConfig,
+    layer_params: dict,  # stacked [L, ...] leaves
+    x: jax.Array,  # [B, T, D]
+    pos_base: jax.Array,
+    k_cache: jax.Array,  # [L, B, Hkv, S, hd]
+    v_cache: jax.Array,
+    rope: jax.Array,  # [T, head_size/2, 2] rows for these positions
+    attn_fn=None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Scan the decoder layers (any contiguous stack — the full model, or one
+    pipeline stage's slice). Returns (x, k_cache, v_cache)."""
+    attn_fn = attn_fn or gqa_attention
+
+    def scan_fn(carry, xs):
+        x = carry
+        lp, kc, vc = xs
+        x, kc, vc = _layer(cfg, x, lp, kc, vc, rope, pos_base, attn_fn)
+        return x, (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(scan_fn, x, (layer_params, k_cache, v_cache))
+    return x, k_new, v_new
+
+
 def forward(
     cfg: LlamaConfig,
     params: dict,
@@ -89,18 +113,12 @@ def forward(
     # (parallel/ring_attention.sp_cache_attention).
 ) -> tuple[jax.Array, KVCache]:
     """Returns (logits f32 [B, T, vocab], updated cache)."""
-    attn_fn = attn_fn or gqa_attention
     x = params["embedding"][tokens]  # [B, T, D]
     t = tokens.shape[1]
     rope = jax.lax.dynamic_slice_in_dim(rope_cache, pos_base, t, axis=0)
-
-    def scan_fn(carry, xs):
-        x = carry
-        lp, kc, vc = xs
-        x, kc, vc = _layer(cfg, x, lp, kc, vc, rope, pos_base, attn_fn)
-        return x, (kc, vc)
-
-    x, (k_new, v_new) = jax.lax.scan(scan_fn, x, (params["layers"], cache.k, cache.v))
+    x, k_new, v_new = run_layers(
+        cfg, params["layers"], x, pos_base, cache.k, cache.v, rope, attn_fn
+    )
     x = rms_norm(x, params["final_norm"], cfg.norm_epsilon)
     logits = matmul(x, params["wcls"]).astype(jnp.float32)
     return logits, KVCache(k_new, v_new)
