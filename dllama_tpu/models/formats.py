@@ -73,9 +73,29 @@ def tensor_plan(config: LlamaConfig) -> list[tuple[str, tuple[int, int] | tuple[
             (f"layers.{layer}.wk", (config.kv_dim, config.dim), wt),
             (f"layers.{layer}.wv", (config.kv_dim, config.dim), wt),
             (f"layers.{layer}.wo", (config.dim, config.dim), wt),
-            (f"layers.{layer}.w1", (config.hidden_dim, config.dim), wt),
-            (f"layers.{layer}.w2", (config.dim, config.hidden_dim), wt),
-            (f"layers.{layer}.w3", (config.hidden_dim, config.dim), wt),
+        ]
+        if config.n_experts:
+            # MoE extension: the reference header carries N_EXPERTS
+            # (llm.hpp:17-18) and its HF converter emits expert tensors
+            # (convert-hf.py:66-73), but its runtime never reads them; this
+            # is the layout our converter writes — router gate then
+            # expert-stacked w1/w2/w3 blobs.
+            plan += [
+                (f"layers.{layer}.moe_gate", (config.n_experts, config.dim), FloatType.F32),
+                (f"layers.{layer}.moe_w1",
+                 (config.n_experts, config.hidden_dim, config.dim), wt),
+                (f"layers.{layer}.moe_w2",
+                 (config.n_experts, config.dim, config.hidden_dim), wt),
+                (f"layers.{layer}.moe_w3",
+                 (config.n_experts, config.hidden_dim, config.dim), wt),
+            ]
+        else:
+            plan += [
+                (f"layers.{layer}.w1", (config.hidden_dim, config.dim), wt),
+                (f"layers.{layer}.w2", (config.dim, config.hidden_dim), wt),
+                (f"layers.{layer}.w3", (config.hidden_dim, config.dim), wt),
+            ]
+        plan += [
             (f"layers.{layer}.rms_att", (config.dim,), FloatType.F32),
             (f"layers.{layer}.rms_ffn", (config.dim,), FloatType.F32),
         ]
@@ -158,6 +178,17 @@ def _load_matmul(raw: np.ndarray, shape: tuple[int, int], ft: FloatType, dtype, 
     return jnp.asarray(decode_dense(raw, shape, ft).T.astype(dtype))
 
 
+def _load_expert_matmul(raw: np.ndarray, shape: tuple[int, int, int], ft: FloatType, dtype, dequantize: bool):
+    """File [E, out, in] blob -> expert-stacked x@W operand [E, in, out]."""
+    e, n_out, k_in = shape
+    per = ft.nbytes(n_out * k_in)
+    leaves = [
+        _load_matmul(raw[i * per : (i + 1) * per], (n_out, k_in), ft, dtype, dequantize)
+        for i in range(e)
+    ]
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *leaves)
+
+
 def load_params(
     path: str,
     config: LlamaConfig,
@@ -189,6 +220,11 @@ def load_params(
             _, _, short = name.split(".")
             if short in ("rms_att", "rms_ffn"):
                 leaf = jnp.asarray(decode_dense(raw, shape, ft))
+            elif short == "moe_gate":
+                # router stays f32; file [E, dim] -> h@gate operand [dim, E]
+                leaf = jnp.asarray(decode_dense(raw, shape, ft).T.copy())
+            elif short.startswith("moe_"):
+                leaf = _load_expert_matmul(raw, shape, ft, dtype, dequantize)
             else:
                 leaf = _load_matmul(raw, shape, ft, dtype, dequantize)
             layer_acc.setdefault(short, []).append(leaf)

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from dllama_tpu.models.config import LlamaConfig
-from dllama_tpu.ops.layers import activation, apply_rope, gqa_attention, rms_norm
+from dllama_tpu.ops.layers import activation, apply_rope, gqa_attention, moe_ffn, rms_norm
 from dllama_tpu.ops.matmul import matmul
 
 
@@ -69,11 +69,16 @@ def _layer(cfg: LlamaConfig, x, lp, k_cache, v_cache, rope, pos_base, attn_fn):
     )
     att = attn_fn(q, k_cache, v_cache, pos_base).reshape(b, t, d)
     x = x + matmul(att, lp["wo"])
-    # --- feed-forward block (reference "ff" segment, llm.cpp:314-385)
+    # --- feed-forward block (reference "ff" segment, llm.cpp:314-385);
+    # sparse-MoE variant when the header carries N_EXPERTS (llm.hpp:17-18 —
+    # a key the reference parses but never executes)
     h = rms_norm(x, lp["rms_ffn"], cfg.norm_epsilon)
-    gate = activation(matmul(h, lp["w1"]).astype(jnp.float32), cfg.hidden_act).astype(x.dtype)
-    up = matmul(h, lp["w3"])
-    x = x + matmul(gate * up, lp["w2"])
+    if "moe_gate" in lp:
+        x = x + moe_ffn(cfg, h, lp["moe_gate"], lp["moe_w1"], lp["moe_w2"], lp["moe_w3"])
+    else:
+        gate = activation(matmul(h, lp["w1"]).astype(jnp.float32), cfg.hidden_act).astype(x.dtype)
+        up = matmul(h, lp["w3"])
+        x = x + matmul(gate * up, lp["w2"])
     return x, k_cache, v_cache
 
 
@@ -141,20 +146,33 @@ def random_params(cfg: LlamaConfig, seed: int = 0, dtype=jnp.bfloat16, quantize:
         leaves = [fn() for _ in range(cfg.n_layers)]
         return jax.tree.map(lambda *xs: jnp.stack(xs, 0), *leaves)
 
+    layers: dict = {
+        "wq": stack(lambda: w(cfg.dim, cfg.dim)),
+        "wk": stack(lambda: w(cfg.dim, cfg.kv_dim)),
+        "wv": stack(lambda: w(cfg.dim, cfg.kv_dim)),
+        "wo": stack(lambda: w(cfg.dim, cfg.dim)),
+        "rms_att": stack(lambda: jnp.ones((cfg.dim,), jnp.float32)),
+        "rms_ffn": stack(lambda: jnp.ones((cfg.dim,), jnp.float32)),
+    }
+    if cfg.n_experts:
+        def expert_stack(k, n):
+            leaves = [w(k, n) for _ in range(cfg.n_experts)]
+            return jax.tree.map(lambda *xs: jnp.stack(xs, 0), *leaves)
+
+        layers["moe_gate"] = stack(
+            lambda: jnp.asarray(rng.standard_normal((cfg.dim, cfg.n_experts)), jnp.float32)
+        )
+        layers["moe_w1"] = stack(lambda: expert_stack(cfg.dim, cfg.hidden_dim))
+        layers["moe_w2"] = stack(lambda: expert_stack(cfg.hidden_dim, cfg.dim))
+        layers["moe_w3"] = stack(lambda: expert_stack(cfg.dim, cfg.hidden_dim))
+    else:
+        layers["w1"] = stack(lambda: w(cfg.dim, cfg.hidden_dim))
+        layers["w2"] = stack(lambda: w(cfg.hidden_dim, cfg.dim))
+        layers["w3"] = stack(lambda: w(cfg.dim, cfg.hidden_dim))
     params = {
         "embedding": jnp.asarray(rng.standard_normal((cfg.vocab_size, cfg.dim)) * 0.02, dtype),
         "final_norm": jnp.ones((cfg.dim,), jnp.float32),
         "wcls": w(cfg.dim, cfg.vocab_size),
-        "layers": {
-            "wq": stack(lambda: w(cfg.dim, cfg.dim)),
-            "wk": stack(lambda: w(cfg.dim, cfg.kv_dim)),
-            "wv": stack(lambda: w(cfg.dim, cfg.kv_dim)),
-            "wo": stack(lambda: w(cfg.dim, cfg.dim)),
-            "w1": stack(lambda: w(cfg.dim, cfg.hidden_dim)),
-            "w2": stack(lambda: w(cfg.hidden_dim, cfg.dim)),
-            "w3": stack(lambda: w(cfg.dim, cfg.hidden_dim)),
-            "rms_att": stack(lambda: jnp.ones((cfg.dim,), jnp.float32)),
-            "rms_ffn": stack(lambda: jnp.ones((cfg.dim,), jnp.float32)),
-        },
+        "layers": layers,
     }
     return params

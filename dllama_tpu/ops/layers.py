@@ -86,6 +86,46 @@ def apply_rope(x: jax.Array, rope: jax.Array) -> jax.Array:
     return jnp.stack([r0, r1], axis=-1).reshape(b, t, h, hs).astype(x.dtype)
 
 
+def moe_ffn(
+    cfg: LlamaConfig,
+    h: jax.Array,  # [B, T, D] (already rms-normed)
+    gate: jax.Array,  # router [D, E] f32
+    w1, w2, w3,  # expert stacks: [E, D, F], [E, F, D], [E, D, F] (QTensor or dense)
+) -> jax.Array:
+    """Mixtral-style sparse MoE FFN: top-k router (softmax over the top-k
+    logits), SwiGLU experts, probability-weighted combine.
+
+    The reference *parses* N_EXPERTS from the header and its converter emits
+    expert tensors, but the runtime has no MoE graph (SURVEY.md §2.4 — EP row);
+    this is the capability it never shipped. Compute is dense over all experts
+    (every expert runs on every token, combine weights zero the unrouted ones):
+    static shapes, no gather/scatter, and expert-axis sharding ('ep') turns the
+    expert einsums into psum-reduced partials under GSPMD. For E >> k a
+    sort-based dispatch kernel is the known next optimization.
+    """
+    from dllama_tpu.ops.quant import QTensor
+
+    e, k = cfg.n_experts, cfg.n_active_experts
+    logits = jnp.einsum(
+        "btd,de->bte", h.astype(jnp.float32), gate.astype(jnp.float32)
+    )
+    topv, topi = jax.lax.top_k(logits, k)
+    probs = jax.nn.softmax(topv, axis=-1)  # [B, T, k]
+    weights = jnp.sum(
+        jax.nn.one_hot(topi, e, dtype=probs.dtype) * probs[..., None], axis=-2
+    )  # [B, T, E]
+
+    def dense(w):
+        return w.dequantize(h.dtype) if isinstance(w, QTensor) else w.astype(h.dtype)
+
+    g = jnp.einsum("btd,edf->btef", h, dense(w1))
+    up = jnp.einsum("btd,edf->btef", h, dense(w3))
+    act = activation(g.astype(jnp.float32), cfg.hidden_act).astype(h.dtype)
+    y = jnp.einsum("btef,efd->bted", act * up, dense(w2))
+    out = jnp.einsum("bted,bte->btd", y.astype(jnp.float32), weights)
+    return out.astype(h.dtype)
+
+
 def gqa_attention(
     q: jax.Array,  # [B, T, Hq, hd]
     k_cache: jax.Array,  # [B, Hkv, S, hd]

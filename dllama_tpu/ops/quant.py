@@ -187,16 +187,18 @@ class QTensor:
         return cls(*children)
 
     @property
-    def shape(self) -> tuple[int, int]:
-        return (self.packed.shape[0] * 2, self.packed.shape[1])
+    def shape(self) -> tuple[int, ...]:
+        """Logical [..., k, n] (leading axes = layer/expert stacking)."""
+        *lead, kh, n = self.packed.shape
+        return (*lead, kh * 2, n)
 
     @property
     def k(self) -> int:
-        return self.packed.shape[0] * 2
+        return self.packed.shape[-2] * 2
 
     @property
     def n(self) -> int:
-        return self.packed.shape[1]
+        return self.packed.shape[-1]
 
     @classmethod
     def quantize(cls, w) -> "QTensor":
@@ -218,14 +220,14 @@ class QTensor:
         return cls(jnp.asarray(packed), jnp.asarray(scales))
 
     def dequantize(self, dtype=jnp.float32) -> jax.Array:
-        """Pure-jnp reference dequant -> [k, n] (the XLA fallback path)."""
-        k, n = self.shape
-        p = self.packed.reshape(k // Q_BLOCK, Q_BLOCK // 2, n)
+        """Pure-jnp reference dequant -> [..., k, n] (the XLA fallback path)."""
+        *lead, k, n = self.shape
+        p = self.packed.reshape(*lead, k // Q_BLOCK, Q_BLOCK // 2, n)
         lo = (p & 0x0F).astype(jnp.int8) - 8
         hi = (p >> 4).astype(jnp.int8) - 8
-        codes = jnp.concatenate([lo, hi], axis=1).astype(jnp.float32)
-        w = codes * self.scales[:, None, :].astype(jnp.float32)
-        return w.reshape(k, n).astype(dtype)
+        codes = jnp.concatenate([lo, hi], axis=-2).astype(jnp.float32)
+        w = codes * self.scales.reshape(*lead, k // Q_BLOCK, 1, n).astype(jnp.float32)
+        return w.reshape(*lead, k, n).astype(dtype)
 
 
 def quantize_q80_jnp(x: jax.Array) -> tuple[jax.Array, jax.Array]:

@@ -34,6 +34,13 @@ LAYER_SPECS = {
     "w2": _COL_SHARD,
     "rms_att": P(None, None),
     "rms_ffn": P(None, None),
+    # MoE (expert axis on 'ep'; per-expert in/out dims keep the tp pattern):
+    # leaves are [L, E, in, out] operands, gate is [L, dim, E] replicated —
+    # the all-experts einsum psums over ep under GSPMD.
+    "moe_gate": P(None, None, None),
+    "moe_w1": P(None, "ep", None, "tp"),
+    "moe_w3": P(None, "ep", None, "tp"),
+    "moe_w2": P(None, "ep", "tp", None),
 }
 
 
@@ -63,9 +70,10 @@ class LlamaShardings:
 
         def expand(spec, leaf):
             if isinstance(leaf, QTensor):
-                if spec == _COL_SHARD and leaf.scales.shape[-2] % tp != 0:
-                    # col-sharded Q40 splits the 32-elem quant-block axis: the
-                    # contraction dim must hold tp whole blocks
+                axes = tuple(spec)
+                if len(axes) >= 2 and axes[-2] == "tp" and leaf.scales.shape[-2] % tp != 0:
+                    # 'tp' on the contraction dim splits the 32-elem quant-block
+                    # axis: it must hold tp whole blocks (col-shard, moe_w2)
                     raise ValueError(
                         f"Q40 col-shard needs in_dim % (32*tp) == 0; "
                         f"got {leaf.scales.shape[-2] * 32} with tp={tp}"

@@ -34,7 +34,11 @@ def permute_rope(w: np.ndarray, n_heads: int) -> np.ndarray:
 
 def hf_config_to_llama(config: Mapping, weight_type: FloatType) -> LlamaConfig:
     """HF config.json -> LlamaConfig (mirrors convert-hf.py:152-195)."""
-    arch = {"llama": ArchType.LLAMA, "mistral": ArchType.LLAMA}.get(config["model_type"])
+    arch = {
+        "llama": ArchType.LLAMA,
+        "mistral": ArchType.LLAMA,
+        "mixtral": ArchType.LLAMA,
+    }.get(config["model_type"])
     if arch is None:
         raise ValueError(f"unsupported arch type: {config['model_type']}")
     act = {"gelu": HiddenAct.GELU, "silu": HiddenAct.SILU}.get(config["hidden_act"])
@@ -87,6 +91,12 @@ HF_NAME_MAP = {
     "rms_ffn": "model.layers.{l}.post_attention_layernorm.weight",
     "final_norm": "model.norm.weight",
     "wcls": "lm_head.weight",
+    # Mixtral-style sparse MoE (convert-hf.py:66-73 wrote these tensors too,
+    # but the reference runtime never consumed them)
+    "moe_gate": "model.layers.{l}.block_sparse_moe.gate.weight",
+    "moe_w1": "model.layers.{l}.block_sparse_moe.experts.{e}.w1.weight",
+    "moe_w2": "model.layers.{l}.block_sparse_moe.experts.{e}.w2.weight",
+    "moe_w3": "model.layers.{l}.block_sparse_moe.experts.{e}.w3.weight",
 }
 
 
@@ -99,6 +109,14 @@ def hf_tensor_for(name: str, cfg: LlamaConfig, get) -> np.ndarray:
     parts = name.split(".")
     if len(parts) == 3:
         _, layer, short = parts
+        if short.startswith("moe_") and short != "moe_gate":
+            return np.stack(
+                [
+                    get(HF_NAME_MAP[short].format(l=layer, e=e))
+                    for e in range(cfg.n_experts)
+                ],
+                axis=0,
+            )
         hf_name = HF_NAME_MAP[short].format(l=layer)
         x = get(hf_name)
         if short == "wq":

@@ -1,0 +1,156 @@
+"""Sparse-MoE tests: routing math vs a per-token reference loop, `.m` format
+roundtrip, and expert-parallel ('ep') sharded execution on the virtual mesh.
+
+The reference parses N_EXPERTS/N_ACTIVE_EXPERTS from the header (llm.hpp:17-18)
+and its converter writes expert tensors, but buildLlmNet has no MoE path
+(SURVEY.md §2.4) — these tests cover the capability it never shipped.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dllama_tpu.models import formats
+from dllama_tpu.models.config import LlamaConfig
+from dllama_tpu.models.llama import KVCache, forward, random_params
+from dllama_tpu.ops.layers import build_rope_cache, moe_ffn
+from dllama_tpu.ops.quant import FloatType
+
+
+def moe_cfg(weight_type=FloatType.F32, experts=4, active=2):
+    return LlamaConfig(dim=64, hidden_dim=96, n_layers=2, n_heads=4, n_kv_heads=2,
+                       vocab_size=128, seq_len=32, n_experts=experts,
+                       n_active_experts=active, weight_type=weight_type)
+
+
+def naive_moe(h, gate, w1, w2, w3, k):
+    """Per-token loop reference: route, run only the chosen experts, combine."""
+    b, t, d = h.shape
+    out = np.zeros_like(h, dtype=np.float64)
+    for bi in range(b):
+        for ti in range(t):
+            x = h[bi, ti]
+            logits = x @ gate  # [E]
+            top = np.argsort(-logits)[:k]
+            p = np.exp(logits[top] - logits[top].max())
+            p /= p.sum()
+            for w, e in zip(p, top):
+                g = x @ w1[e]
+                u = x @ w3[e]
+                silu = g / (1.0 + np.exp(-g)) * u
+                out[bi, ti] += w * (silu @ w2[e])
+    return out
+
+
+def test_moe_ffn_matches_naive_loop(rng):
+    cfg = moe_cfg()
+    b, t = 2, 3
+    h = rng.standard_normal((b, t, cfg.dim)).astype(np.float32)
+    gate = rng.standard_normal((cfg.dim, cfg.n_experts)).astype(np.float32)
+    w1 = rng.standard_normal((cfg.n_experts, cfg.dim, cfg.hidden_dim)).astype(np.float32) * 0.1
+    w2 = rng.standard_normal((cfg.n_experts, cfg.hidden_dim, cfg.dim)).astype(np.float32) * 0.1
+    w3 = rng.standard_normal((cfg.n_experts, cfg.dim, cfg.hidden_dim)).astype(np.float32) * 0.1
+
+    got = moe_ffn(cfg, jnp.asarray(h), jnp.asarray(gate), jnp.asarray(w1),
+                  jnp.asarray(w2), jnp.asarray(w3))
+    want = naive_moe(h, gate, w1, w2, w3, cfg.n_active_experts)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-4, rtol=1e-4)
+
+
+def test_moe_top1_selects_single_expert(rng):
+    """top-1 routing must equal the argmax expert's SwiGLU output exactly
+    (softmax over one logit == 1)."""
+    cfg = moe_cfg(experts=3, active=1)
+    h = jnp.asarray(rng.standard_normal((1, 2, cfg.dim)), jnp.float32)
+    gate = jnp.asarray(rng.standard_normal((cfg.dim, 3)), jnp.float32)
+    ws = [jnp.asarray(rng.standard_normal(s), jnp.float32) * 0.1
+          for s in [(3, cfg.dim, cfg.hidden_dim), (3, cfg.hidden_dim, cfg.dim),
+                    (3, cfg.dim, cfg.hidden_dim)]]
+    got = np.asarray(moe_ffn(cfg, h, gate, *ws))
+    for ti in range(2):
+        x = np.asarray(h)[0, ti]
+        e = int(np.argmax(x @ np.asarray(gate)))
+        g = x @ np.asarray(ws[0])[e]
+        u = x @ np.asarray(ws[2])[e]
+        want = (g / (1 + np.exp(-g)) * u) @ np.asarray(ws[1])[e]
+        np.testing.assert_allclose(got[0, ti], want, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("wt", [FloatType.F32, FloatType.Q40])
+def test_moe_format_roundtrip_forward(tmp_path, rng, wt):
+    """save_model -> load_params -> forward must equal forward on params built
+    directly from the same tensors (loader mapping: transposes + expert stack)."""
+    cfg = moe_cfg(weight_type=wt)
+    plan = formats.tensor_plan(cfg)
+    names = [n for n, _, _ in plan]
+    assert any("moe_gate" in n for n in names) and not any(".w1" in n for n in names)
+    tensors = {n: (rng.standard_normal(s) * 0.1).astype(np.float32) for n, s, _ in plan}
+    path = str(tmp_path / "moe.m")
+    formats.save_model(path, cfg, tensors)
+
+    cfg2, hs = formats.read_header(path)
+    assert cfg2.n_experts == cfg.n_experts and cfg2.n_active_experts == cfg.n_active_experts
+    params = formats.load_params(path, cfg2, hs, dtype=jnp.float32)
+
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 4)), jnp.int32)
+    rope = build_rope_cache(cfg)
+    logits, _ = forward(cfg, params, toks, jnp.int32(0), KVCache.create(cfg, 1, jnp.float32), rope)
+    assert logits.shape == (1, 4, cfg.vocab_size)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+    if wt == FloatType.F32:
+        # exact parity against directly-constructed params
+        direct = {
+            "embedding": jnp.asarray(tensors["embedding"]),
+            "final_norm": jnp.asarray(tensors["final_norm"]),
+            "wcls": jnp.asarray(tensors["wcls"].T.copy()),
+            "layers": {},
+        }
+        L = cfg.n_layers
+        stack = lambda short, tr: jnp.stack(
+            [jnp.asarray(tr(tensors[f"layers.{l}.{short}"])) for l in range(L)], 0
+        )
+        for short in ("wq", "wk", "wv", "wo"):
+            direct["layers"][short] = stack(short, lambda x: x.T.copy())
+        for short in ("rms_att", "rms_ffn"):
+            direct["layers"][short] = stack(short, lambda x: x)
+        direct["layers"]["moe_gate"] = stack("moe_gate", lambda x: x.T.copy())
+        for short in ("moe_w1", "moe_w2", "moe_w3"):
+            direct["layers"][short] = stack(short, lambda x: np.swapaxes(x, 1, 2).copy())
+        want, _ = forward(cfg, direct, toks, jnp.int32(0), KVCache.create(cfg, 1, jnp.float32), rope)
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(want), atol=1e-5, rtol=1e-5)
+
+
+def test_moe_expert_parallel_matches_single_device(rng):
+    """ep=2 x tp=2 sharded forward == single-device forward."""
+    from dllama_tpu.engine.engine import InferenceEngine
+    from dllama_tpu.parallel.mesh import MeshConfig, make_mesh
+    from dllama_tpu.parallel.sharding import LlamaShardings
+
+    cfg = moe_cfg()
+    params = random_params(cfg, seed=5, dtype=jnp.float32, quantize=False)
+    toks = np.asarray(rng.integers(0, cfg.vocab_size, (1, 6)), np.int32)
+
+    ref = InferenceEngine(cfg, params, cache_dtype=jnp.float32, attn_impl="jnp")
+    ref_logits = np.asarray(ref.prefill(toks))
+
+    mesh = make_mesh(MeshConfig(ep=2, tp=2), devices=jax.devices()[:4])
+    sh = LlamaShardings(mesh, cfg)
+    eng = InferenceEngine(cfg, params, cache_dtype=jnp.float32, shardings=sh, attn_impl="jnp")
+    got_logits = np.asarray(eng.prefill(toks))
+    np.testing.assert_allclose(got_logits, ref_logits, atol=1e-4, rtol=1e-4)
+
+
+def test_hf_moe_tensor_stacking():
+    from dllama_tpu.tools.converter_core import hf_tensor_for
+
+    cfg = moe_cfg(experts=2)
+    store = {}
+    for e in range(2):
+        store[f"model.layers.0.block_sparse_moe.experts.{e}.w1.weight"] = np.full(
+            (cfg.hidden_dim, cfg.dim), float(e), np.float32
+        )
+    x = hf_tensor_for("layers.0.moe_w1", cfg, store.__getitem__)
+    assert x.shape == (2, cfg.hidden_dim, cfg.dim)
+    assert x[1].min() == 1.0 and x[0].max() == 0.0
