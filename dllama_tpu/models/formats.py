@@ -107,14 +107,22 @@ def tensor_plan(config: LlamaConfig) -> list[tuple[str, tuple[int, int] | tuple[
 
 
 def write_tensor(f, x: np.ndarray, float_type: FloatType) -> int:
-    """Serialize a tensor in the reference byte format (writer.py:29-107)."""
+    """Serialize a tensor in the reference byte format (writer.py:29-107).
+
+    Q40 quantization runs in C++ when the native library is available
+    (bit-identical to quantize_q40_np; tests/test_native.py pins it)."""
     flat = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
     if float_type == FloatType.F32:
         buf = flat.tobytes()
     elif float_type == FloatType.F16:
         buf = flat.astype(np.float16).tobytes()
     elif float_type == FloatType.Q40:
-        packed, scales = quantize_q40_np(flat)
+        from dllama_tpu.utils import native
+
+        if native.available():
+            packed, scales = native.quantize_q40(flat)
+        else:
+            packed, scales = quantize_q40_np(flat)
         rec = np.zeros((packed.shape[0], 2 + Q_BLOCK // 2), dtype=np.uint8)
         rec[:, :2] = scales.reshape(-1, 1).view(np.uint8)
         rec[:, 2:] = packed

@@ -85,7 +85,8 @@ def quantize_q40_np(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     gmin = g.min(axis=-1)
     deltas = np.where(-gmin > gmax, gmin, gmax) / -8.0
     deltas16 = deltas.astype(np.float16)
-    inv = np.where(deltas != 0, 1.0 / deltas, 0.0)
+    with np.errstate(divide="ignore"):
+        inv = np.where(deltas != 0, 1.0 / deltas, 0.0)
     q = np.clip(g * inv[..., None] + 8.5, 0, 15).astype(np.uint8)
     packed = q[..., : Q_BLOCK // 2] | (q[..., Q_BLOCK // 2 :] << 4)
     return packed, deltas16
@@ -108,7 +109,8 @@ def quantize_q80_np(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     absmax = np.abs(g).max(axis=-1)
     deltas = absmax / 127.0
     deltas16 = deltas.astype(np.float16)
-    inv = np.where(deltas != 0, 1.0 / deltas, 0.0)
+    with np.errstate(divide="ignore"):
+        inv = np.where(deltas != 0, 1.0 / deltas, 0.0)
     codes = np.round(g * inv[..., None]).astype(np.int8)
     return codes, deltas16
 
