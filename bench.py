@@ -104,6 +104,14 @@ def main():
         "setup_s": round(t_setup, 1),
         "compile_s": round(t_prefill_compile + t_decode_compile, 1),
     }
+    # bytes/token is part of the benchmark contract (SURVEY.md §5.1/§6): on
+    # one chip it's 0; multi-chip runs report the analytic ICI payload.
+    from dllama_tpu.utils.profiling import collective_bytes_per_token
+
+    n_dev = jax.device_count()
+    result["kb_per_token_per_chip"] = round(
+        collective_bytes_per_token(cfg, tp=n_dev)["kb_per_token_per_chip"], 1
+    )
     print(json.dumps(result))
 
 

@@ -51,6 +51,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=9990, help="HTTP port (serve mode)")
     p.add_argument("--host", default="127.0.0.1", help="HTTP bind address (serve mode)")
     p.add_argument("--kernels", choices=["auto", "pallas", "xla"], default="auto")
+    p.add_argument("--trace", metavar="DIR", help="write a jax.profiler trace (XProf/TensorBoard)")
+    p.add_argument("--report", action="store_true",
+                   help="print memory + per-token latency + collective-payload report")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -103,15 +106,33 @@ def cmd_inference(args) -> int:
     max_tokens = min(args.steps, m.engine.seq_len - len(prompt_tokens))
     stats = GenerationStats()
 
+    from dllama_tpu.utils import profiling
+
+    timer = profiling.TokenTimer()
     tok.reset_decoder()
-    for t in m.engine.generate(
-        prompt_tokens, max_tokens, sampler, stop_fn=tok.is_eos, stats=stats
-    ):
-        piece = tok.decode(t)
-        if piece:
-            print(piece, end="", flush=True)
+    with profiling.trace(args.trace):
+        timer.start()
+        for t in m.engine.generate(
+            prompt_tokens, max_tokens, sampler, stop_fn=tok.is_eos, stats=stats
+        ):
+            timer.stop()
+            piece = tok.decode(t)
+            if piece:
+                print(piece, end="", flush=True)
+            timer.start()
     print()
     print(stats.summary(), file=sys.stderr)
+    if args.report:
+        print(profiling.memory_report(m.config, m.engine.params, m.engine.cache), file=sys.stderr)
+        print(f"⏱  {timer.summary()}", file=sys.stderr)
+        shape = dict(m.shardings.mesh.shape) if m.shardings else {}
+        tp, sp = shape.get("tp", 1), shape.get("sp", 1)
+        est = profiling.collective_bytes_per_token(m.config, tp=tp, sp=sp)
+        print(
+            f"🔗 est. inter-chip payload: {est['kb_per_token_per_chip']:.0f} kB/token/chip "
+            f"(tp={tp} sp={sp})",
+            file=sys.stderr,
+        )
     return 0
 
 

@@ -1,0 +1,118 @@
+"""Profiling / tracing / observability.
+
+The reference's instrumentation (SURVEY.md §5.1/§5.5): DEBUG_BENCHMARK
+per-step μs prints (nn-executor.cpp:100-124), per-token console lines with
+elapsed ms + net bytes (dllama.cpp:54-87), network byte counters
+(nn-network.cpp:483-492) and the memory report (nn-core.cpp:152-166). TPU
+equivalents here:
+
+* :func:`trace` — jax.profiler device traces (view in XProf/TensorBoard); the
+  idiomatic replacement for hand-timed executor steps.
+* :class:`TokenTimer` — host-side per-token latency recorder with the
+  reference's report shape (avg/p50/p90 ms/token, tok/s).
+* :func:`collective_bytes_per_token` — analytic per-token inter-chip payload
+  for a given mesh, the ICI analog of the reference's sentBytes/recvBytes
+  (its Fig. 6 "sync payload per token" table is the contract this reproduces).
+* :func:`memory_report` — params/cache HBM accounting.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None):
+    """jax.profiler.trace wrapper; no-op when log_dir is falsy."""
+    if not log_dir:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+@dataclass
+class TokenTimer:
+    """Per-token wall-clock recorder (dllama.cpp:82-104 report shape)."""
+
+    ms: list[float] = field(default_factory=list)
+    _t0: float = 0.0
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self) -> float:
+        dt = (time.perf_counter() - self._t0) * 1000.0
+        self.ms.append(dt)
+        return dt
+
+    @contextlib.contextmanager
+    def token(self):
+        self.start()
+        yield
+        self.stop()
+
+    def summary(self) -> str:
+        if not self.ms:
+            return "no tokens timed"
+        a = np.asarray(self.ms)
+        return (
+            f"{len(a)} tokens: avg {a.mean():.2f} ms/token "
+            f"(p50 {np.percentile(a, 50):.2f}, p90 {np.percentile(a, 90):.2f}, "
+            f"max {a.max():.2f}), {1000.0 / a.mean():.1f} tok/s"
+        )
+
+
+def collective_bytes_per_token(cfg, tp: int = 1, sp: int = 1, exchange_bytes: float = 2.0) -> dict:
+    """Analytic inter-chip payload per decoded token, per chip.
+
+    Mirrors the reference's measured sync payload (report.pdf Fig. 6; its Q80
+    wire format is exchange_bytes≈1.06 per element — 34 bytes per 32 values;
+    bf16 collectives are 2.0). Tensor-parallel Llama moves, per layer:
+
+      attention out: all-gather of the wo partial sums — dim elements, each
+      chip sends its 1/tp slice to tp-1 peers and receives the tp-1 others;
+      ffn out: same for w2 partials.
+
+    The logits gather moves vocab/tp elements once per token. sp>1 adds the
+    decode-path query broadcast + LSE merge of the sequence-parallel
+    attention (head_size+2 floats per kv head) — negligible, counted anyway.
+    Reported bytes are sent+received per chip, matching the reference's
+    sentBytes/recvBytes counters (nn-network.cpp:483-492).
+    """
+    per_chip = 0.0
+    if tp > 1:
+        # each sync: send (tp-1) copies of the 1/tp slice, receive tp-1 slices
+        per_layer = 2 * 2 * (cfg.dim / tp) * (tp - 1) * exchange_bytes
+        per_chip += cfg.n_layers * per_layer
+        per_chip += 2 * (cfg.vocab_size / tp) * (tp - 1) * 4.0 / tp  # f32 logits gather
+    if sp > 1:
+        per_chip += 2 * cfg.n_layers * (cfg.n_kv_heads * (cfg.head_size + 2)) * 4.0 * (sp - 1) / sp
+    return {
+        "bytes_per_token_per_chip": per_chip,
+        "kb_per_token_per_chip": per_chip / 1024.0,
+        "tp": tp,
+        "sp": sp,
+        "exchange_bytes_per_elem": exchange_bytes,
+    }
+
+
+def params_nbytes(params) -> int:
+    return sum(
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(params) if hasattr(x, "size")
+    )
+
+
+def memory_report(cfg, params, cache) -> str:
+    """HBM accounting (nn-core.cpp:152-166 role)."""
+    pb = params_nbytes(params)
+    cb = cache.k.size * cache.k.dtype.itemsize + cache.v.size * cache.v.dtype.itemsize
+    return (
+        f"💿 params {pb / 1e9:.2f} GB, kv-cache {cb / 1e9:.2f} GB "
+        f"(seq {cache.seq_len}, batch {cache.k.shape[1]}), total {(pb + cb) / 1e9:.2f} GB"
+    )

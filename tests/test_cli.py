@@ -37,3 +37,19 @@ def test_cli_inference_generates(tmp_path, capsys):
 def test_cli_inference_missing_prompt_errors(tmp_path, capsys):
     mpath, tpath, _ = make_tiny_files(tmp_path)
     assert main(["inference", "--model", mpath, "--tokenizer", tpath]) == 1
+
+
+def test_cli_inference_report_and_trace(tmp_path, capsys):
+    mpath, tpath, cfg = make_tiny_files(tmp_path)
+    trace_dir = str(tmp_path / "trace")
+    rc = main([
+        "inference", "--model", mpath, "--tokenizer", tpath,
+        "--prompt", "hello", "--steps", "4", "--temperature", "0", "--seed", "1",
+        "--no-mesh", "--report", "--trace", trace_dir,
+    ])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "params" in err and "kv-cache" in err  # memory report
+    assert "ms/token" in err and "kB/token/chip" in err
+    import os
+    assert os.path.isdir(trace_dir) and os.listdir(trace_dir)  # profiler wrote
