@@ -1,0 +1,57 @@
+"""OpenAI-compatible API client — the reference's examples/chat-api-client.js
+in stdlib Python, against `python -m dllama_tpu serve`.
+
+Usage: python examples/api_client.py [--port 9990] [--stream] "your message"
+"""
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("message", nargs="?", default="What is the capital of France?")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9990)
+    p.add_argument("--stream", action="store_true")
+    p.add_argument("--max-tokens", type=int, default=128)
+    args = p.parse_args()
+
+    body = {
+        "model": "dllama-tpu",
+        "messages": [
+            {"role": "system", "content": "You are a helpful assistant."},
+            {"role": "user", "content": args.message},
+        ],
+        "temperature": 0.7,
+        "max_tokens": args.max_tokens,
+        "stream": args.stream,
+    }
+    req = urllib.request.Request(
+        f"http://{args.host}:{args.port}/v1/chat/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as r:
+        if not args.stream:
+            out = json.load(r)
+            print(out["choices"][0]["message"]["content"])
+            print(f"usage: {out.get('usage')}", file=sys.stderr)
+            return 0
+        for raw in r:
+            line = raw.decode().strip()
+            if not line.startswith("data:"):
+                continue
+            payload = line[5:].strip()
+            if payload == "[DONE]":
+                break
+            delta = json.loads(payload)["choices"][0]["delta"]
+            print(delta.get("content", ""), end="", flush=True)
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,17 @@
+#!/bin/sh
+# Multi-chip without a pod — the analog of the reference's examples/n-workers.sh
+# (which spawns W localhost worker processes under `screen`). On TPU the mesh
+# lives in one process, so simulation is just XLA's host-device splitting:
+# 8 virtual devices, tensor-parallel over 'tp' (or any --mesh spec).
+#
+# Usage: sh examples/simulate_multichip.sh model.m tokenizer.t "prompt" [mesh]
+set -e
+MODEL=${1:?model.m}
+TOK=${2:?tokenizer.t}
+PROMPT=${3:-"Hello"}
+MESH=${4:-tp=8}
+
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -m dllama_tpu inference \
+    --model "$MODEL" --tokenizer "$TOK" --prompt "$PROMPT" \
+    --mesh "$MESH" --steps 32 --temperature 0 --report

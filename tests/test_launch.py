@@ -1,0 +1,67 @@
+"""Model-zoo launcher tests (tools/launch.py) — registry integrity and the
+download path exercised offline via file:// URLs."""
+
+import os
+
+import pytest
+
+from dllama_tpu.tools import launch
+
+
+def test_registry_matches_reference_zoo():
+    # the six models of the reference zoo (launch.py:15-46), incl. multipart
+    names = set(launch.MODELS)
+    assert {
+        "llama3_2_1b_instruct_q40", "llama3_2_3b_instruct_q40",
+        "llama3_1_8b_instruct_q40", "llama3_3_70b_instruct_q40",
+        "llama3_1_405b_instruct_q40", "deepseek_r1_distill_llama_8b_q40",
+    } == names
+    assert len(launch.MODELS["llama3_1_405b_instruct_q40"].model_urls) == 56
+    assert len(launch.MODELS["llama3_3_70b_instruct_q40"].model_urls) == 11
+    assert launch._parts(3) == ["aa", "ab", "ac"]
+    for m in launch.MODELS.values():
+        assert all(u.startswith("https://") for u in m.model_urls)
+
+
+def test_download_multipart_concatenates(tmp_path, capsys):
+    parts = [tmp_path / f"part{i}" for i in range(3)]
+    for i, p in enumerate(parts):
+        p.write_bytes(bytes([i]) * 10)
+    out = str(tmp_path / "joined.bin")
+    launch.download_file([f"file://{p}" for p in parts], out)
+    assert open(out, "rb").read() == b"\x00" * 10 + b"\x01" * 10 + b"\x02" * 10
+    # second call skips (resume semantics)
+    launch.download_file([f"file://{parts[0]}"], out)
+    assert "skipping" in capsys.readouterr().out
+    assert os.path.getsize(out) == 30
+
+
+def test_download_failure_is_clean(tmp_path):
+    out = str(tmp_path / "x.bin")
+    with pytest.raises(SystemExit, match="download failed"):
+        launch.download_file([f"file://{tmp_path}/missing"], out)
+    assert not os.path.exists(out) and not os.path.exists(out + ".part")
+
+
+def test_cli_list_and_run(capsys):
+    assert launch.main(["list"]) == 0
+    out = capsys.readouterr().out
+    assert "llama3_1_8b_instruct_q40" in out and "238.0 GB" in out
+    assert launch.main(["run", "llama3_2_1b_instruct_q40", "--dir", "m"]) == 0
+    out = capsys.readouterr().out
+    assert "-m dllama_tpu chat" in out and "m/dllama_model_llama3_2_1b_instruct_q40.m" in out
+    assert "--max-seq-len 4096" in out
+
+
+def test_examples_determinism_runs():
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=os.getcwd())
+    r = subprocess.run(
+        [sys.executable, "examples/determinism.py"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "deterministic" in r.stdout
