@@ -106,6 +106,11 @@ class InferenceEngine:
         self._decode_n = jax.jit(
             partial(self._decode_n_impl, cfg, attn_fn), static_argnums=(5,), donate_argnums=donate
         )
+        self._decode_sample_n = jax.jit(
+            partial(self._decode_sample_n_impl, cfg, attn_fn),
+            static_argnums=(6,),
+            donate_argnums=donate,
+        )
 
     @staticmethod
     def _step_impl(cfg, attn_fn, params, cache, tokens, pos, rope_cache):
@@ -126,6 +131,25 @@ class InferenceEngine:
             return (nxt, cache, p + 1), nxt[:, 0]
 
         (_, cache, _), toks = jax.lax.scan(body, (token, cache, pos), None, length=n)
+        return toks, cache
+
+    @staticmethod
+    def _decode_sample_n_impl(cfg, attn_fn, params, cache, token, pos, rope_cache, key, n,
+                              temperature, topp):
+        """n *sampled* decode steps fused on device — the sampler runs inside
+        the scan (branchless in temperature/topp, sampling.sample_logits), so
+        non-greedy generation also avoids the per-token host roundtrip the
+        reference's decode loop pays (dllama.cpp:69-88)."""
+        from dllama_tpu.engine.sampling import sample_logits
+
+        def body(carry, _):
+            token, cache, p, key = carry
+            logits, cache = forward(cfg, params, token, p, cache, rope_cache, attn_fn)
+            key, sub = jax.random.split(key)
+            nxt = sample_logits(logits[:, -1], sub, temperature, topp)[:, None]
+            return (nxt, cache, p + 1, key), nxt[:, 0]
+
+        (_, cache, _, _), toks = jax.lax.scan(body, (token, cache, pos, key), None, length=n)
         return toks, cache
 
     # ------------------------------------------------------------------ core
@@ -179,6 +203,26 @@ class InferenceEngine:
         self.pos += n
         return np.asarray(toks)
 
+    def decode_sample_n(self, token: np.ndarray, n: int, sampler: Sampler) -> np.ndarray:
+        """Fused n-step sampled decode on device; returns tokens [n, B].
+        Advances the sampler's PRNG key once per call."""
+        if self.pos + n > self.seq_len:
+            raise ValueError(f"position {self.pos}+{n} exceeds seq_len {self.seq_len}")
+        sampler.key, sub = jax.random.split(sampler.key)
+        toks, self.cache = self._decode_sample_n(
+            self.params,
+            self.cache,
+            jnp.asarray(token, jnp.int32).reshape(self.batch, 1),
+            jnp.int32(self.pos),
+            self.rope_cache,
+            sub,
+            n,
+            jnp.float32(sampler.temperature),
+            jnp.float32(sampler.topp),
+        )
+        self.pos += n
+        return np.asarray(toks)
+
     # ------------------------------------------------------------- generation
 
     def generate(
@@ -188,12 +232,15 @@ class InferenceEngine:
         sampler: Sampler,
         stop_fn: Callable[[int], bool] | None = None,
         stats: GenerationStats | None = None,
+        chunk: int = 8,
     ) -> Iterator[int]:
-        """Greedy host loop: prefill the prompt, then decode token by token.
-
-        Yields each generated token id; stops at max_tokens, seq_len, or when
-        `stop_fn(token)` returns True (EOS detection lives in the tokenizer
-        layer, as in the reference).
+        """Host generation loop: prefill the prompt, then decode in fused
+        device chunks of up to `chunk` tokens (sampling included on device —
+        one host roundtrip per chunk instead of per token; chunk=1 recovers
+        token-at-a-time). Yields each token id; stops at max_tokens, seq_len,
+        or when `stop_fn(token)` returns True. On an early stop mid-chunk the
+        engine position is rewound so the KV cache stays prefix-consistent
+        (cache rows past pos are masked, so over-decoded rows are harmless).
         """
         assert self.batch == 1, "generate() drives a single sequence; use step() for batches"
         t0 = time.perf_counter()
@@ -206,16 +253,28 @@ class InferenceEngine:
             stats.prefill_s += t1 - t0
 
         produced = 0
-        while True:
-            yield token
-            produced += 1
-            if produced >= max_tokens or self.pos >= self.seq_len:
-                break
-            if stop_fn is not None and stop_fn(token):
-                break
+        yield token
+        produced += 1
+        if stop_fn is not None and stop_fn(token):
+            return
+        while produced < max_tokens and self.pos < self.seq_len:
+            c = min(chunk, max_tokens - produced, self.seq_len - self.pos)
+            start_pos = self.pos
             t2 = time.perf_counter()
-            logits = self.decode_step(np.array([[token]]))
-            token = int(sampler(logits)[0])
+            toks = self.decode_sample_n(np.array([[token]]), c, sampler)
             if stats is not None:
-                stats.decode_tokens += 1
+                stats.decode_tokens += c
                 stats.decode_s += time.perf_counter() - t2
+            for i in range(c):
+                token = int(toks[i, 0])
+                yield token
+                produced += 1
+                stopped = stop_fn is not None and stop_fn(token)
+                if stopped or produced >= max_tokens:
+                    if i + 1 < c:
+                        # rewind over-decoded rows (valid prefix ends after
+                        # the row written when sampling this token)
+                        self.reset(start_pos + i + 1)
+                    if stopped:
+                        return
+                    break

@@ -9,31 +9,38 @@ reproducible, but not bit-identical to the C++ RNG.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 
 
-@partial(jax.jit, static_argnames=("temperature", "topp"))
-def sample(logits: jax.Array, key: jax.Array, temperature: float = 0.8, topp: float = 0.9) -> jax.Array:
-    """logits f32 [B, V] -> tokens i32 [B]."""
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits.astype(jnp.float32) / temperature
-    if 0.0 < topp < 1.0:
-        probs = jax.nn.softmax(logits, axis=-1)
-        sorted_probs = jnp.sort(probs, axis=-1, descending=True)
-        cum = jnp.cumsum(sorted_probs, axis=-1)
-        # keep tokens while the cumulative mass *before* them is < topp
-        # (i.e. include the token that first crosses topp, like sample_topp's
-        # break-after-include, tokenizer.cpp:389-395)
-        keep_sorted = (cum - sorted_probs) < topp
-        threshold = jnp.min(
-            jnp.where(keep_sorted, sorted_probs, jnp.inf), axis=-1, keepdims=True
-        )
-        logits = jnp.where(probs >= threshold, logits, -jnp.inf)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+def sample_logits(logits: jax.Array, key: jax.Array, temperature, topp) -> jax.Array:
+    """logits f32 [B, V] -> tokens i32 [B]. Branchless in temperature/topp so
+    both can be *traced* scalars — the fused decode loop and the API server
+    never recompile when a request changes sampling params."""
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temperature = jnp.asarray(temperature, jnp.float32)
+    topp = jnp.asarray(topp, jnp.float32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    probs = jax.nn.softmax(scaled, axis=-1)
+    sorted_probs = jnp.sort(probs, axis=-1, descending=True)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    # keep tokens while the cumulative mass *before* them is < topp
+    # (i.e. include the token that first crosses topp, like sample_topp's
+    # break-after-include, tokenizer.cpp:389-395)
+    keep_sorted = (cum - sorted_probs) < topp
+    threshold = jnp.min(
+        jnp.where(keep_sorted, sorted_probs, jnp.inf), axis=-1, keepdims=True
+    )
+    use_topp = (topp > 0.0) & (topp < 1.0)
+    masked = jnp.where(use_topp & (probs < threshold), -jnp.inf, scaled)
+    sampled = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature == 0.0, greedy, sampled)
+
+
+@jax.jit
+def sample(logits: jax.Array, key: jax.Array, temperature=0.8, topp=0.9) -> jax.Array:
+    return sample_logits(logits, key, temperature, topp)
 
 
 class Sampler:

@@ -88,3 +88,53 @@ def test_sample_distribution_roughly_matches():
     toks = np.asarray(sample(logits, keys, temperature=1.0, topp=0.0))
     freq = np.bincount(toks, minlength=4) / len(toks)
     np.testing.assert_allclose(freq, probs, atol=0.05)
+
+
+def test_decode_sample_n_greedy_matches_decode_greedy_n():
+    """temp=0 through the fused sampled path == the greedy fused path."""
+    e1, e2 = make_engine(), make_engine()
+    p = np.array([[1, 2, 3]], np.int32)
+    l1, l2 = e1.prefill(p), e2.prefill(p)
+    first = np.asarray(jnp.argmax(l1, -1)).astype(np.int32)
+    s = Sampler(temperature=0.0, topp=0.9, seed=3)
+    got = e1.decode_sample_n(first, 6, s)
+    want = e2.decode_greedy_n(first, 6)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_decode_sample_n_reproducible_with_seed():
+    e1, e2 = make_engine(), make_engine()
+    p = np.array([[1, 2, 3]], np.int32)
+    e1.prefill(p), e2.prefill(p)
+    a = e1.decode_sample_n(np.array([[5]]), 8, Sampler(0.9, 0.9, seed=11))
+    b = e2.decode_sample_n(np.array([[5]]), 8, Sampler(0.9, 0.9, seed=11))
+    np.testing.assert_array_equal(a, b)
+    c = e1.decode_sample_n(np.array([[5]]), 8, Sampler(0.9, 0.9, seed=12))
+    assert not np.array_equal(a, c)  # different seed, different tokens
+
+
+def test_generate_chunked_equals_unchunked_greedy():
+    sampler = Sampler(temperature=0.0, topp=0.9, seed=0)
+    outs = []
+    for chunk in (1, 4, 64):
+        e = make_engine()
+        outs.append(list(e.generate([1, 2, 3], 10, sampler, chunk=chunk)))
+    assert outs[0] == outs[1] == outs[2]
+
+
+def test_generate_chunked_stop_rewinds_position():
+    """When stop_fn fires mid-chunk, pos must rewind to the valid prefix so a
+    chat continuation prefills from the right row."""
+    e = make_engine()
+    sampler = Sampler(temperature=0.0, topp=0.9, seed=0)
+    ref = make_engine()
+    full = list(ref.generate([1, 2, 3], 10, sampler, chunk=1))
+    stop_idx = 4  # stop on the 5th generated token, mid-chunk for chunk=8
+    seen = iter(range(len(full)))
+
+    e2 = make_engine()
+    got = list(e2.generate([1, 2, 3], 10, sampler, chunk=8,
+                           stop_fn=lambda t: next(seen) >= stop_idx))
+    assert got == full[: stop_idx + 1]
+    # valid rows: 3 prompt rows + stop_idx decode-written rows
+    assert e2.pos == 3 + stop_idx
