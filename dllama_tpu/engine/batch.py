@@ -1,0 +1,167 @@
+"""Continuous-batching engine: independent sequences sharing one compiled step.
+
+The reference's API server is single-request, blocking (dllama-api.cpp:522-533
+— SURVEY.md §7.4.6 calls this out as the tier to replace). This engine keeps
+B cache *slots*, each with its own position, so requests can join (prefill one
+slot while others hold), decode together in fused chunks, and leave at EOS —
+the scheduling core of continuous batching. Mechanics:
+
+* positions are an i32[B] vector: rope rows gathered per row, KV writes are
+  per-row scatters, the causal mask is per-row (models/llama.forward).
+* an `active` bool[B] masks cache writes: a prefill touches only the joining
+  slot; finished slots stay frozen while others decode.
+* sampling params are per-slot vectors (sampling.sample_logits broadcasts),
+  so mixed-temperature batches share one compiled decode graph.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dllama_tpu.engine.sampling import sample_logits
+from dllama_tpu.models.config import LlamaConfig
+from dllama_tpu.models.llama import KVCache, forward
+
+
+class BatchEngine:
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        params,
+        n_slots: int = 4,
+        cache_dtype=jnp.bfloat16,
+        max_seq_len: int | None = None,
+        max_prefill_chunk: int = 128,
+        seed: int = 0,
+    ):
+        from dllama_tpu.ops.layers import build_rope_cache
+
+        self.cfg = cfg
+        self.params = params
+        self.n_slots = n_slots
+        self.seq_len = min(max_seq_len or cfg.seq_len, cfg.seq_len)
+        self.max_prefill_chunk = max_prefill_chunk
+        self.rope_cache = build_rope_cache(cfg, self.seq_len)
+        self.cache = KVCache.create(cfg, n_slots, cache_dtype, self.seq_len)
+        self.pos = np.zeros(n_slots, np.int32)  # next cache row per slot
+        self.active = np.zeros(n_slots, bool)  # slot is decoding
+        self.last_token = np.zeros(n_slots, np.int32)
+        self.temperature = np.zeros(n_slots, np.float32)
+        self.topp = np.full(n_slots, 0.9, np.float32)
+        self.key = jax.random.PRNGKey(seed)
+
+        self._prefill_step = jax.jit(partial(self._prefill_impl, cfg), donate_argnums=(1,))
+        self._decode = jax.jit(
+            partial(self._decode_impl, cfg), static_argnums=(8,), donate_argnums=(1,)
+        )
+
+    # ------------------------------------------------------------- jitted fns
+
+    @staticmethod
+    def _prefill_impl(cfg, params, cache, tokens, pos_vec, active, rope):
+        logits, cache = forward(cfg, params, tokens, pos_vec, cache, rope, active=active)
+        return logits[:, -1], cache
+
+    @staticmethod
+    def _decode_impl(cfg, params, cache, tokens, pos_vec, active, key, temps, topps, n, rope):
+        def body(carry, _):
+            tok, cache, p, key = carry
+            logits, cache = forward(cfg, params, tok, p, cache, rope, active=jnp.asarray(active))
+            key, sub = jax.random.split(key)
+            nxt = sample_logits(logits[:, -1], sub, temps, topps)[:, None]
+            nxt = jnp.where(active[:, None], nxt, tok)  # frozen slots keep token
+            return (nxt, cache, p + active.astype(jnp.int32), key), nxt[:, 0]
+
+        (_, cache, _, _), toks = jax.lax.scan(
+            body, (tokens, cache, pos_vec, key), None, length=n
+        )
+        return toks, cache
+
+    # ------------------------------------------------------------------- api
+
+    def free_slot(self) -> int | None:
+        idle = np.flatnonzero(~self.active)
+        return int(idle[0]) if idle.size else None
+
+    def add(self, slot: int, prompt_tokens: list[int], temperature: float = 0.8,
+            topp: float = 0.9, start_pos: int = 0) -> int:
+        """Prefill `prompt_tokens` into `slot` (rows from start_pos — pass a
+        cached-prefix length to reuse earlier rows, NaiveCache-style) and
+        sample the first token. Other slots are untouched (masked writes)."""
+        assert not self.active[slot], f"slot {slot} is busy"
+        n = len(prompt_tokens)
+        if n == 0:
+            raise ValueError("prompt must be non-empty")
+        if start_pos + n >= self.seq_len:
+            raise ValueError(f"prompt ({start_pos}+{n}) exceeds seq_len {self.seq_len}")
+        self.pos[slot] = start_pos
+        onehot = np.zeros(self.n_slots, bool)
+        onehot[slot] = True
+        toks = np.asarray(prompt_tokens, np.int32)
+        logits = None
+        off = 0
+        while off < n:
+            # power-of-two widths: at most log2(max_chunk)+1 compiled variants
+            # (same policy as InferenceEngine.prefill)
+            c = min(self.max_prefill_chunk, 1 << (n - off - 1).bit_length())
+            while c > n - off:
+                c //= 2
+            chunk = np.zeros((self.n_slots, c), np.int32)
+            chunk[slot] = toks[off : off + c]
+            # rope/cache row indexing needs every row's pos valid; frozen rows
+            # pass their current pos (writes masked anyway)
+            pos_vec = jnp.asarray(self.pos, jnp.int32)
+            logits, self.cache = self._prefill_step(
+                self.params, self.cache,
+                jnp.asarray(chunk),
+                pos_vec,
+                jnp.asarray(onehot),
+                self.rope_cache,
+            )
+            self.pos[slot] += c
+            off += c
+
+        self.key, sub = jax.random.split(self.key)
+        first = int(np.asarray(sample_logits(logits, sub, jnp.float32(temperature), jnp.float32(topp)))[slot])
+        self.active[slot] = True
+        self.last_token[slot] = first
+        self.temperature[slot] = temperature
+        self.topp[slot] = topp
+        return first
+
+    def decode(self, n: int) -> np.ndarray:
+        """n fused decode steps across all active slots; returns tokens [n, B]
+        (frozen slots repeat their last token — callers track per-slot state)."""
+        if not self.active.any():
+            raise ValueError("no active slots")
+        room = self.seq_len - int(self.pos[self.active].max())
+        n = min(n, room)
+        if n <= 0:
+            raise ValueError("active slot at seq_len; release it first")
+        self.key, sub = jax.random.split(self.key)
+        toks, self.cache = self._decode(
+            self.params, self.cache,
+            jnp.asarray(self.last_token[:, None]),
+            jnp.asarray(self.pos, jnp.int32),
+            jnp.asarray(self.active),
+            sub,
+            jnp.asarray(self.temperature),
+            jnp.asarray(self.topp),
+            n,
+            self.rope_cache,
+        )
+        toks = np.asarray(toks)
+        self.pos[self.active] += n
+        self.last_token[self.active] = toks[-1, self.active]
+        return toks
+
+    def release(self, slot: int, keep_rows: int | None = None) -> None:
+        """Free a slot. keep_rows rewinds pos to the valid prefix (mid-chunk
+        stop), preserving the slot's cache for NaiveCache-style reuse."""
+        self.active[slot] = False
+        if keep_rows is not None:
+            self.pos[slot] = keep_rows

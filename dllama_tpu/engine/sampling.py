@@ -16,11 +16,16 @@ import jax.numpy as jnp
 def sample_logits(logits: jax.Array, key: jax.Array, temperature, topp) -> jax.Array:
     """logits f32 [B, V] -> tokens i32 [B]. Branchless in temperature/topp so
     both can be *traced* scalars — the fused decode loop and the API server
-    never recompile when a request changes sampling params."""
+    never recompile when a request changes sampling params. Either may also be
+    an [B] vector (per-slot params in the continuous-batching engine)."""
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     temperature = jnp.asarray(temperature, jnp.float32)
     topp = jnp.asarray(topp, jnp.float32)
+    if temperature.ndim == 1:
+        temperature = temperature[:, None]
+    if topp.ndim == 1:
+        topp = topp[:, None]
     scaled = logits / jnp.maximum(temperature, 1e-6)
     probs = jax.nn.softmax(scaled, axis=-1)
     sorted_probs = jnp.sort(probs, axis=-1, descending=True)
@@ -35,7 +40,10 @@ def sample_logits(logits: jax.Array, key: jax.Array, temperature, topp) -> jax.A
     use_topp = (topp > 0.0) & (topp < 1.0)
     masked = jnp.where(use_topp & (probs < threshold), -jnp.inf, scaled)
     sampled = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
-    return jnp.where(temperature == 0.0, greedy, sampled)
+    t_is_zero = temperature == 0.0
+    if t_is_zero.ndim == 2:
+        t_is_zero = t_is_zero[:, 0]
+    return jnp.where(t_is_zero, greedy, sampled)
 
 
 @jax.jit

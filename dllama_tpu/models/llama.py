@@ -52,7 +52,23 @@ class KVCache:
         return self.k.shape[3]
 
 
-def _layer(cfg: LlamaConfig, x, lp, k_cache, v_cache, rope, pos_base, attn_fn):
+def _cache_update(cache, new, pos_base, active):
+    """Write [B, H, T, hd] rows at pos (scalar, or [B] per-row scatter); rows
+    with active==False keep their old contents (continuous batching: frozen
+    finished slots, masked prefill of a single slot)."""
+    new = new.astype(cache.dtype)
+    if jnp.ndim(pos_base) == 1:
+        upd = jax.vmap(
+            lambda c, n, p: jax.lax.dynamic_update_slice(c, n, (0, p, 0))
+        )(cache, new, pos_base)
+    else:
+        upd = jax.lax.dynamic_update_slice(cache, new, (0, 0, pos_base, 0))
+    if active is not None:
+        upd = jnp.where(active[:, None, None, None], upd, cache)
+    return upd
+
+
+def _layer(cfg: LlamaConfig, x, lp, k_cache, v_cache, rope, pos_base, attn_fn, active=None):
     b, t, d = x.shape
     # --- attention block (reference "att" segment, llm.cpp:198-312)
     h = rms_norm(x, lp["rms_att"], cfg.norm_epsilon)
@@ -61,12 +77,8 @@ def _layer(cfg: LlamaConfig, x, lp, k_cache, v_cache, rope, pos_base, attn_fn):
     v = matmul(h, lp["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.head_size)
     q = apply_rope(q, rope)
     k = apply_rope(k, rope)
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k.transpose(0, 2, 1, 3).astype(k_cache.dtype), (0, 0, pos_base, 0)
-    )
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v.transpose(0, 2, 1, 3).astype(v_cache.dtype), (0, 0, pos_base, 0)
-    )
+    k_cache = _cache_update(k_cache, k.transpose(0, 2, 1, 3), pos_base, active)
+    v_cache = _cache_update(v_cache, v.transpose(0, 2, 1, 3), pos_base, active)
     att = attn_fn(q, k_cache, v_cache, pos_base).reshape(b, t, d)
     x = x + matmul(att, lp["wo"])
     # --- feed-forward block (reference "ff" segment, llm.cpp:314-385);
@@ -86,11 +98,12 @@ def run_layers(
     cfg: LlamaConfig,
     layer_params: dict,  # stacked [L, ...] leaves
     x: jax.Array,  # [B, T, D]
-    pos_base: jax.Array,
+    pos_base: jax.Array,  # scalar, or [B] per-row positions
     k_cache: jax.Array,  # [L, B, Hkv, S, hd]
     v_cache: jax.Array,
-    rope: jax.Array,  # [T, head_size/2, 2] rows for these positions
+    rope: jax.Array,  # [T, head_size/2, 2] rope rows (or [B, T, ...] per-row)
     attn_fn=None,
+    active: jax.Array | None = None,  # [B] bool: rows allowed to write cache
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Scan the decoder layers (any contiguous stack — the full model, or one
     pipeline stage's slice). Returns (x, k_cache, v_cache)."""
@@ -99,7 +112,7 @@ def run_layers(
     def scan_fn(carry, xs):
         x = carry
         lp, kc, vc = xs
-        x, kc, vc = _layer(cfg, x, lp, kc, vc, rope, pos_base, attn_fn)
+        x, kc, vc = _layer(cfg, x, lp, kc, vc, rope, pos_base, attn_fn, active)
         return x, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(scan_fn, x, (layer_params, k_cache, v_cache))
@@ -116,13 +129,23 @@ def forward(
     attn_fn=None,  # (q, k_cache, v_cache, pos) -> out; default full-cache GQA.
     # A sequence-parallel mesh passes the shard_map'd LSE-merge attention here
     # (parallel/ring_attention.sp_cache_attention).
+    active: jax.Array | None = None,  # [B] bool cache-write mask (batch mode)
 ) -> tuple[jax.Array, KVCache]:
-    """Returns (logits f32 [B, T, vocab], updated cache)."""
+    """Returns (logits f32 [B, T, vocab], updated cache).
+
+    pos_base may be a scalar (all rows at one position — the single-sequence
+    fast path) or an i32[B] vector giving each row its own position
+    (continuous batching; rope rows are then gathered per row)."""
     x = params["embedding"][tokens]  # [B, T, D]
     t = tokens.shape[1]
-    rope = jax.lax.dynamic_slice_in_dim(rope_cache, pos_base, t, axis=0)
+    pos_base = jnp.asarray(pos_base, jnp.int32)
+    if pos_base.ndim == 1:
+        idx = pos_base[:, None] + jnp.arange(t, dtype=jnp.int32)[None]  # [B, T]
+        rope = rope_cache[jnp.clip(idx, 0, rope_cache.shape[0] - 1)]
+    else:
+        rope = jax.lax.dynamic_slice_in_dim(rope_cache, pos_base, t, axis=0)
     x, k_new, v_new = run_layers(
-        cfg, params["layers"], x, pos_base, cache.k, cache.v, rope, attn_fn
+        cfg, params["layers"], x, pos_base, cache.k, cache.v, rope, attn_fn, active
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_epsilon)
     logits = matmul(x, params["wcls"]).astype(jnp.float32)

@@ -74,12 +74,17 @@ def apply_rope(x: jax.Array, rope: jax.Array) -> jax.Array:
     """Rotate interleaved pairs: x[..., 2i], x[..., 2i+1] by angle pos*freq_i.
 
     x: [B, T, H, head_size]; rope: [T, head_size/2, 2] rows already gathered
-    for the absolute positions of the T tokens.
+    for the absolute positions of the T tokens — or [B, T, head_size/2, 2]
+    when rows differ per sequence (continuous batching: per-slot positions).
     """
     b, t, h, hs = x.shape
     xf = x.astype(jnp.float32).reshape(b, t, h, hs // 2, 2)
-    cos = rope[None, :, None, :, 0]
-    sin = rope[None, :, None, :, 1]
+    if rope.ndim == 4:  # per-row rope rows
+        cos = rope[:, :, None, :, 0]
+        sin = rope[:, :, None, :, 1]
+    else:
+        cos = rope[None, :, None, :, 0]
+        sin = rope[None, :, None, :, 1]
     x0, x1 = xf[..., 0], xf[..., 1]
     r0 = x0 * cos - x1 * sin
     r1 = x0 * sin + x1 * cos
@@ -130,14 +135,15 @@ def gqa_attention(
     q: jax.Array,  # [B, T, Hq, hd]
     k_cache: jax.Array,  # [B, Hkv, S, hd]
     v_cache: jax.Array,  # [B, Hkv, S, hd]
-    pos_base: jax.Array,  # scalar i32: absolute position of query 0
+    pos_base: jax.Array,  # i32 scalar, or [B] per-sequence positions
 ) -> jax.Array:
     """Causal GQA over the full KV cache (nn-cpu-ops.cpp:752-787 equivalent).
 
     Query t attends to cache slots s <= pos_base + t; unwritten future slots
     are masked out, so the cache can stay a fixed [S]-sized ring without
     dynamic shapes (XLA needs static shapes; the mask replaces the
-    reference's `t = 0..pos` loop bound).
+    reference's `t = 0..pos` loop bound). A vector pos_base gives each batch
+    row its own position (continuous batching).
     """
     b, t, hq, hd = q.shape
     hkv, s = k_cache.shape[1], k_cache.shape[2]
@@ -147,9 +153,14 @@ def gqa_attention(
     vf = v_cache.astype(jnp.float32)
     scores = jnp.einsum("bthgd,bhsd->bhgts", qf, kf) / math.sqrt(hd)
     spans = jax.lax.broadcasted_iota(jnp.int32, (t, s), 1)
-    limit = pos_base + jax.lax.broadcasted_iota(jnp.int32, (t, s), 0)
-    mask = spans <= limit
-    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    qoff = jax.lax.broadcasted_iota(jnp.int32, (t, s), 0)
+    pos_base = jnp.asarray(pos_base, jnp.int32)
+    if pos_base.ndim == 1:
+        mask = spans[None] <= pos_base[:, None, None] + qoff[None]  # [B, t, s]
+        mask = mask[:, None, None]
+    else:
+        mask = (spans <= pos_base + qoff)[None, None, None]
+    scores = jnp.where(mask, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgts,bhsd->bthgd", probs, vf)
     return out.reshape(b, t, hq, hd).astype(q.dtype)

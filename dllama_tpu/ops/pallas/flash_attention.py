@@ -32,7 +32,7 @@ from dllama_tpu.ops.pallas.tiling import pick_tile as _pick_tile
 _NEG_INF = -1e30  # large-finite: keeps fully-masked tiles NaN-free
 
 
-def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref, *, scale, tq, ts):
+def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref, *, scale, tq, ts, hq):
     iq = pl.program_id(1)
     ks = pl.program_id(2)
 
@@ -49,8 +49,11 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref, *, sca
     s = s * scale  # [tq, ts]
 
     # causal mask against absolute cache positions (query row r is token
-    # pos_base + iq*tq + r; padded tail rows are discarded by the wrapper)
-    qpos = pos_ref[0] + iq * tq + jax.lax.broadcasted_iota(jnp.int32, (tq, ts), 0)
+    # pos[b] + iq*tq + r, b = this head's batch row; padded tail rows are
+    # discarded by the wrapper)
+    qpos = pos_ref[pl.program_id(0) // hq] + iq * tq + jax.lax.broadcasted_iota(
+        jnp.int32, (tq, ts), 0
+    )
     span = ks * ts + jax.lax.broadcasted_iota(jnp.int32, (tq, ts), 1)
     mask = span <= qpos
     s = jnp.where(mask, s, _NEG_INF)
@@ -71,19 +74,20 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref, *, sca
         out_ref[:] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("group", "interpret"))
-def _flash_folded(q, k, v, pos, *, group: int, interpret: bool):
-    """q[BHq, Tp, hd] x cache[BHkv, S, hd] -> [BHq, Tp, hd] f32."""
+@functools.partial(jax.jit, static_argnames=("group", "hq", "interpret"))
+def _flash_folded(q, k, v, pos, *, group: int, hq: int, interpret: bool):
+    """q[BHq, Tp, hd] x cache[BHkv, S, hd] -> [BHq, Tp, hd] f32.
+    pos: i32[B] per-row base positions (replicated for the scalar case)."""
     bhq, tp, hd = q.shape
     s = k.shape[1]
     tq = _pick_tile(tp, (128, 64, 32, 16, 8))
     ts = _pick_tile(s, (512, 256, 128, 64))
     grid = (bhq, tp // tq, s // ts)
     return pl.pallas_call(
-        functools.partial(_kernel, scale=1.0 / math.sqrt(hd), tq=tq, ts=ts),
+        functools.partial(_kernel, scale=1.0 / math.sqrt(hd), tq=tq, ts=ts, hq=hq),
         grid=grid,
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # pos: i32[1]
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # pos: i32[B]
             pl.BlockSpec((None, tq, hd), lambda h, i, ks: (h, i, 0)),
             pl.BlockSpec((None, ts, hd), lambda h, i, ks: (h // group, ks, 0)),
             pl.BlockSpec((None, ts, hd), lambda h, i, ks: (h // group, ks, 0)),
@@ -112,7 +116,7 @@ def flash_gqa_attention(
     q: jax.Array,  # [B, T, Hq, hd]
     k_cache: jax.Array,  # [B, Hkv, S, hd]
     v_cache: jax.Array,  # [B, Hkv, S, hd]
-    pos_base: jax.Array,  # scalar i32
+    pos_base: jax.Array,  # i32 scalar or [B] per-row positions
     *,
     interpret: bool = False,
 ) -> jax.Array:
@@ -124,12 +128,14 @@ def flash_gqa_attention(
     pad = (-t) % 8
     if pad:
         qf = jnp.pad(qf, ((0, 0), (0, pad), (0, 0)))
+    pos = jnp.broadcast_to(jnp.atleast_1d(jnp.asarray(pos_base, jnp.int32)), (b,))
     out = _flash_folded(
         qf,
         k_cache.reshape(b * hkv, s, hd),
         v_cache.reshape(b * hkv, s, hd),
-        jnp.reshape(pos_base, (1,)).astype(jnp.int32),
+        pos,
         group=group,
+        hq=hq,
         interpret=interpret,
     )
     if pad:
