@@ -50,6 +50,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dequantize", action="store_true", help="load Q40 weights as bf16 (faster prefill, 4x HBM)")
     p.add_argument("--port", type=int, default=9990, help="HTTP port (serve mode)")
     p.add_argument("--host", default="127.0.0.1", help="HTTP bind address (serve mode)")
+    p.add_argument("--slots", type=int, default=0,
+                   help="serve mode: continuous-batching slots (0 = single-request + prefix cache)")
     p.add_argument("--kernels", choices=["auto", "pallas", "xla"], default="auto")
     p.add_argument("--trace", metavar="DIR", help="write a jax.profiler trace (XProf/TensorBoard)")
     p.add_argument("--report", action="store_true",
@@ -213,6 +215,7 @@ def cmd_serve(args) -> int:
         m,
         host=args.host,
         port=args.port,
+        n_slots=args.slots,
         default_temperature=args.temperature,
         default_topp=args.topp,
         default_seed=args.seed,

@@ -113,13 +113,17 @@ class BatchEngine:
             chunk = np.zeros((self.n_slots, c), np.int32)
             chunk[slot] = toks[off : off + c]
             # rope/cache row indexing needs every row's pos valid; frozen rows
-            # pass their current pos (writes masked anyway)
-            pos_vec = jnp.asarray(self.pos, jnp.int32)
+            # pass their current pos (writes masked anyway).
+            # .copy() is load-bearing on every host->device handoff here:
+            # jnp.asarray can zero-copy ALIAS a numpy buffer on CPU, and this
+            # engine mutates pos/active/last_token in place after dispatching
+            # async device work — aliasing turns that into a read/write race.
+            pos_vec = jnp.asarray(self.pos.copy(), jnp.int32)
             logits, self.cache = self._prefill_step(
                 self.params, self.cache,
                 jnp.asarray(chunk),
                 pos_vec,
-                jnp.asarray(onehot),
+                jnp.asarray(onehot.copy()),
                 self.rope_cache,
             )
             self.pos[slot] += c
@@ -145,12 +149,12 @@ class BatchEngine:
         self.key, sub = jax.random.split(self.key)
         toks, self.cache = self._decode(
             self.params, self.cache,
-            jnp.asarray(self.last_token[:, None]),
-            jnp.asarray(self.pos, jnp.int32),
-            jnp.asarray(self.active),
+            jnp.asarray(self.last_token[:, None].copy()),
+            jnp.asarray(self.pos.copy(), jnp.int32),
+            jnp.asarray(self.active.copy()),
             sub,
-            jnp.asarray(self.temperature),
-            jnp.asarray(self.topp),
+            jnp.asarray(self.temperature.copy()),
+            jnp.asarray(self.topp.copy()),
             n,
             self.rope_cache,
         )

@@ -65,7 +65,8 @@ class PrefixCache:
 
 
 class ApiServer:
-    def __init__(self, loaded, default_temperature=0.8, default_topp=0.9, default_seed=None):
+    def __init__(self, loaded, default_temperature=0.8, default_topp=0.9, default_seed=None,
+                 scheduler=None):
         self.engine = loaded.engine
         self.tokenizer = loaded.tokenizer
         self.config = loaded.config
@@ -79,6 +80,9 @@ class ApiServer:
         self.cache = PrefixCache()
         self.lock = threading.Lock()
         self.model_name = "dllama-tpu"
+        # continuous-batching tier: a serve/scheduler.Scheduler over a
+        # BatchEngine — concurrent requests share the device, no global lock
+        self.scheduler = scheduler
 
     # ------------------------------------------------------------------ core
 
@@ -96,6 +100,11 @@ class ApiServer:
         extra_stops = body.get("stop") or []
         if isinstance(extra_stops, str):
             extra_stops = [extra_stops]
+
+        if self.scheduler is not None:
+            return self._complete_batched(
+                body, messages, temperature, topp, max_tokens, extra_stops, emit
+            )
 
         with self.lock:
             delta, start_pos, add_bos = self.cache.resolve(messages)
@@ -143,6 +152,72 @@ class ApiServer:
             self.cache.pos = self.engine.pos
             self.cache.bos_sent = True
 
+        return {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:16]}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": body.get("model", self.model_name),
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": content},
+                    "finish_reason": finish,
+                }
+            ],
+            "usage": {
+                "prompt_tokens": len(prompt_tokens),
+                "completion_tokens": n_generated,
+                "total_tokens": len(prompt_tokens) + n_generated,
+            },
+        }
+
+    def _complete_batched(self, body, messages, temperature, topp, max_tokens,
+                          extra_stops, emit) -> dict:
+        """Continuous-batching completion: submit to the scheduler, stream from
+        the per-request queue. No server-side prefix cache (slots are recycled
+        across conversations) and no per-request seed (the batch shares one
+        device PRNG stream); temperature=0 stays exactly reproducible."""
+        generated = self.template.generate(
+            [ChatItem(r, c) for r, c in messages], append_generation_prompt=True
+        )
+        prompt_tokens = self.tokenizer.encode(generated.content, add_bos=True)
+        budget = self.scheduler.engine.seq_len - len(prompt_tokens) - 1
+        if budget <= 0:
+            raise ApiError(400, "context window exhausted")
+        if max_tokens > 0:
+            budget = min(budget, max_tokens)
+
+        detector = EosDetector(
+            self.tokenizer.eos_ids,
+            self.stops + list(extra_stops),
+            padding_left=2,
+            padding_right=2,
+        )
+        decoder = self.tokenizer.make_stream_decoder()
+        req = self.scheduler.submit(
+            prompt_tokens, temperature, topp, budget, self.tokenizer.eos_ids
+        )
+        parts: list[str] = []
+        n_generated = 0
+        try:
+            for t in req.tokens():
+                n_generated += 1
+                res = detector.append(t, decoder.decode(t))
+                text = detector.get_delta()
+                if text:
+                    parts.append(text)
+                    if emit is not None:
+                        emit(text)
+                    detector.reset()
+                if res == EosResult.EOS:
+                    break
+        finally:
+            self.scheduler.cancel(req)
+        # scheduler reasons: stop/length pass through; a cancel here means the
+        # stream ended on a string stop-sequence -> "stop"
+        finish = req.finish_reason if req.finish_reason in ("stop", "length") else "stop"
+
+        content = "".join(parts)
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:16]}",
             "object": "chat.completion",
@@ -261,26 +336,47 @@ class _Handler(BaseHTTPRequestHandler):
         chunk(b"")  # terminating zero-length chunk
 
 
-def make_server(loaded, host="127.0.0.1", port=0, **defaults) -> tuple[ThreadingHTTPServer, ApiServer]:
+def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) -> tuple[ThreadingHTTPServer, ApiServer]:
+    """n_slots > 0 enables the continuous-batching tier: a BatchEngine with
+    that many cache slots behind a Scheduler (concurrent requests share the
+    device). n_slots == 0 keeps the single-engine tier with the NaiveCache
+    prefix reuse (the reference server's semantics)."""
+    scheduler = None
+    if n_slots > 0:
+        from dllama_tpu.engine.batch import BatchEngine
+        from dllama_tpu.serve.scheduler import Scheduler
+
+        be = BatchEngine(
+            loaded.config,
+            loaded.engine.params,
+            n_slots=n_slots,
+            cache_dtype=loaded.engine.cache.k.dtype,
+            max_seq_len=loaded.engine.seq_len,
+        )
+        scheduler = Scheduler(be)
     api = ApiServer(
         loaded,
         default_temperature=defaults.get("default_temperature", 0.8),
         default_topp=defaults.get("default_topp", 0.9),
         default_seed=defaults.get("default_seed"),
+        scheduler=scheduler,
     )
     handler = type("Handler", (_Handler,), {"api": api})
     httpd = ThreadingHTTPServer((host, port), handler)
     return httpd, api
 
 
-def run_server(loaded, host="127.0.0.1", port=9990, **defaults) -> int:
-    httpd, _ = make_server(loaded, host, port, **defaults)
-    log.info("serving on http://%s:%d (POST /v1/chat/completions)", host, httpd.server_address[1])
-    print(f"🚀 http://{host}:{httpd.server_address[1]}/v1/chat/completions")
+def run_server(loaded, host="127.0.0.1", port=9990, n_slots: int = 0, **defaults) -> int:
+    httpd, api = make_server(loaded, host, port, n_slots=n_slots, **defaults)
+    mode = f"continuous batching, {n_slots} slots" if n_slots else "single-request + prefix cache"
+    log.info("serving on http://%s:%d (%s)", host, httpd.server_address[1], mode)
+    print(f"🚀 http://{host}:{httpd.server_address[1]}/v1/chat/completions ({mode})")
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
+        if api.scheduler is not None:
+            api.scheduler.shutdown()
         httpd.server_close()
     return 0

@@ -1,0 +1,156 @@
+"""Continuous-batching scheduler: one worker thread drives a BatchEngine,
+request threads stream tokens from per-request queues.
+
+This is the serving tier above the reference's single-request blocking server
+(dllama-api.cpp:522-533): requests join a running batch whenever a slot is
+free (masked single-slot prefill), decode together in fused device chunks,
+and leave at EOS/budget — other requests never wait for a whole completion,
+only for chunk boundaries.
+
+Token-level stops (EOS ids, budget) are handled here; *string* stop sequences
+need decoded text, so the request handler runs its EosDetector on the stream
+and calls cancel() — generation overruns by at most one chunk.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from dataclasses import dataclass, field
+
+from dllama_tpu.engine.batch import BatchEngine
+
+log = logging.getLogger("dllama_tpu.serve")
+
+_END = object()  # sentinel on the token queue; payload = finish reason
+
+
+@dataclass
+class Request:
+    prompt: list[int]
+    temperature: float
+    topp: float
+    max_tokens: int
+    eos_ids: frozenset[int]
+    out: queue.Queue = field(default_factory=queue.Queue)
+    produced: int = 0
+    slot: int = -1
+    finish_reason: str | None = None
+    cancelled: threading.Event = field(default_factory=threading.Event)
+
+    def tokens(self):
+        """Blocking iterator over generated tokens (ends on EOS/budget/cancel)."""
+        while True:
+            item = self.out.get()
+            if item is _END or isinstance(item, Exception):
+                if isinstance(item, Exception):
+                    raise item
+                return
+            yield item
+
+
+class Scheduler:
+    def __init__(self, engine: BatchEngine, chunk: int = 4, admit_timeout: float = 0.05):
+        self.engine = engine
+        self.chunk = chunk
+        self.admit_timeout = admit_timeout
+        self.pending: queue.Queue[Request] = queue.Queue()
+        self.slots: dict[int, Request] = {}
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, name="dllama-scheduler", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------- api
+
+    def submit(self, prompt, temperature, topp, max_tokens, eos_ids) -> Request:
+        req = Request(list(prompt), float(temperature), float(topp), int(max_tokens),
+                      frozenset(eos_ids))
+        self.pending.put(req)
+        self._wake.set()
+        return req
+
+    def cancel(self, req: Request) -> None:
+        req.cancelled.set()
+        self._wake.set()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=10)
+
+    # ------------------------------------------------------------------ loop
+
+    def _finish(self, req: Request, reason: str, keep_rows: int | None = None) -> None:
+        if req.slot >= 0:
+            self.engine.release(req.slot, keep_rows)
+            self.slots.pop(req.slot, None)
+            req.slot = -1
+        req.finish_reason = req.finish_reason or reason
+        req.out.put(_END)
+
+    def _emit(self, req: Request, token: int, row_at_emit: int) -> bool:
+        """Queue one token; returns True when the request just finished."""
+        req.out.put(int(token))
+        req.produced += 1
+        if token in req.eos_ids:
+            self._finish(req, "stop", keep_rows=row_at_emit)
+            return True
+        if req.produced >= req.max_tokens:
+            self._finish(req, "length", keep_rows=row_at_emit)
+            return True
+        return False
+
+    def _admit(self) -> None:
+        while not self.pending.empty():
+            slot = self.engine.free_slot()
+            if slot is None:
+                return
+            try:
+                req = self.pending.get_nowait()
+            except queue.Empty:
+                return
+            if req.cancelled.is_set():
+                req.finish_reason = "cancelled"
+                req.out.put(_END)
+                continue
+            try:
+                first = self.engine.add(slot, req.prompt, req.temperature, req.topp)
+            except Exception as e:  # bad request (too long, …) — fail just this one
+                log.exception("prefill failed")
+                req.out.put(e)
+                continue
+            req.slot = slot
+            self.slots[slot] = req
+            self._emit(req, first, int(self.engine.pos[slot]))
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._admit()
+            for slot, req in list(self.slots.items()):
+                if req.cancelled.is_set():
+                    self._finish(req, "cancelled", keep_rows=int(self.engine.pos[slot]))
+                elif int(self.engine.pos[slot]) >= self.engine.seq_len:
+                    self._finish(req, "length")
+            if not self.slots:
+                self._wake.wait(timeout=self.admit_timeout)
+                self._wake.clear()
+                continue
+            start_rows = {s: int(self.engine.pos[s]) for s in self.slots}
+            try:
+                toks = self.engine.decode(self.chunk)
+            except Exception as e:
+                log.exception("decode failed; failing all in-flight requests")
+                for req in list(self.slots.values()):
+                    req.out.put(e)
+                    self._finish(req, "error")
+                continue
+            n = toks.shape[0]
+            for slot, req in list(self.slots.items()):
+                for i in range(n):
+                    # row written when sampling token i: start + i (+1 = prefix len)
+                    if self._emit(req, toks[i, slot], start_rows[slot] + i + 1):
+                        break
+        for req in list(self.slots.values()):
+            self._finish(req, "shutdown")

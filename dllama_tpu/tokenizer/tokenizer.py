@@ -216,6 +216,11 @@ class Tokenizer:
 
     # ------------------------------------------------------------------ decode
 
+    def make_stream_decoder(self) -> "StreamDecoder":
+        """A decoder with its own UTF-8 state — one per concurrent request
+        (the Tokenizer's built-in decode() state is single-stream)."""
+        return StreamDecoder(self)
+
     def reset_decoder(self) -> None:
         self._utf8.reset()
 
@@ -224,14 +229,7 @@ class Tokenizer:
         it forms complete UTF-8, buffering partial sequences across tokens.
         (The reference's heuristic only buffers pieces *ending* in continuation
         bytes; an incremental decoder handles every split point.)"""
-        if token == self.bos_id:
-            return None
-        if self.is_eos(token):
-            rest = self._utf8.decode(b"", final=True)
-            self._utf8.reset()
-            return rest or None
-        out = self._utf8.decode(self.vocab[token])
-        return out or None
+        return _decode_streaming(self, self._utf8, token)
 
     def decode_all(self, tokens: list[int]) -> str:
         self.reset_decoder()
@@ -242,3 +240,28 @@ class Tokenizer:
 
     def piece(self, token: int) -> str:
         return self.vocab[token].decode("utf-8", errors="replace")
+
+
+def _decode_streaming(tok: Tokenizer, utf8, token: int) -> str | None:
+    if token == tok.bos_id:
+        return None
+    if tok.is_eos(token):
+        rest = utf8.decode(b"", final=True)
+        utf8.reset()
+        return rest or None
+    out = utf8.decode(tok.vocab[token])
+    return out or None
+
+
+class StreamDecoder:
+    """Per-stream incremental UTF-8 decode state over a shared Tokenizer."""
+
+    def __init__(self, tok: Tokenizer):
+        self._tok = tok
+        self._utf8 = codecs.getincrementaldecoder("utf-8")("replace")
+
+    def decode(self, token: int) -> str | None:
+        return _decode_streaming(self._tok, self._utf8, token)
+
+    def reset(self) -> None:
+        self._utf8.reset()
