@@ -37,6 +37,7 @@ class BatchEngine:
         max_seq_len: int | None = None,
         max_prefill_chunk: int = 128,
         seed: int = 0,
+        shardings=None,  # parallel/sharding.LlamaShardings: multi-chip serving
     ):
         from dllama_tpu.ops.layers import build_rope_cache
 
@@ -47,6 +48,10 @@ class BatchEngine:
         self.max_prefill_chunk = max_prefill_chunk
         self.rope_cache = build_rope_cache(cfg, self.seq_len)
         self.cache = KVCache.create(cfg, n_slots, cache_dtype, self.seq_len)
+        if shardings is not None:
+            self.params = shardings.put_params(self.params)
+            self.cache = shardings.put_cache(self.cache)
+            self.rope_cache = shardings.put_replicated(self.rope_cache)
         self.pos = np.zeros(n_slots, np.int32)  # next cache row per slot
         self.active = np.zeros(n_slots, bool)  # slot is decoding
         self.last_token = np.zeros(n_slots, np.int32)

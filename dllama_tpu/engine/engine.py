@@ -169,6 +169,49 @@ class InferenceEngine:
         """Rewind to `pos` (prefix-cache reuse keeps cache contents ≤ pos valid)."""
         self.pos = pos
 
+    # ------------------------------------------------------------- checkpoint
+
+    def _session_fingerprint(self) -> str:
+        c = self.cfg
+        return (
+            f"{c.dim}:{c.n_layers}:{c.n_kv_heads}:{c.head_size}:"
+            f"{self.seq_len}:{self.batch}:{self.cache.k.dtype}"
+        )
+
+    def save_session(self, path: str) -> None:
+        """Persist the KV cache + position — resume a long conversation across
+        process restarts. The reference has no checkpointing at all (SURVEY.md
+        §5.4: its NaiveCache prefix reuse is in-memory only); this is the
+        durable version of that capability."""
+        import numpy as np
+
+        np.savez_compressed(
+            path,
+            fingerprint=self._session_fingerprint(),
+            pos=self.pos,
+            k=np.asarray(self.cache.k),
+            v=np.asarray(self.cache.v),
+        )
+
+    def load_session(self, path: str) -> None:
+        """Restore a saved session (re-places the cache with the current mesh
+        shardings, so a session saved single-chip resumes on a mesh and vice
+        versa — device placement is orthogonal to the session state)."""
+        import numpy as np
+
+        with np.load(path) as data:
+            fp = str(data["fingerprint"])
+            if fp != self._session_fingerprint():
+                raise ValueError(
+                    f"session file does not match this engine: {fp!r} != "
+                    f"{self._session_fingerprint()!r}"
+                )
+            cache = KVCache(jnp.asarray(data["k"]), jnp.asarray(data["v"]))
+            if self.shardings is not None:
+                cache = self.shardings.put_cache(cache)
+            self.cache = cache
+            self.pos = int(data["pos"])
+
     def prefill(self, tokens: np.ndarray) -> jax.Array:
         """Chunked prefill; returns logits after the last token."""
         tokens = np.atleast_2d(np.asarray(tokens, dtype=np.int32))

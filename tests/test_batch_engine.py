@@ -127,3 +127,21 @@ def test_flash_attention_vector_pos(rng):
     got = flash_gqa_attention(q, k, v, pos, interpret=True)
     want = gqa_attention(q, k, v, pos)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_batch_engine_sharded_matches_unsharded():
+    """BatchEngine on a tp=2 x dp-style mesh == unsharded (multi-chip serving)."""
+    from dllama_tpu.parallel.mesh import MeshConfig, make_mesh
+    from dllama_tpu.parallel.sharding import LlamaShardings
+
+    be_ref = BatchEngine(CFG, PARAMS, n_slots=2, cache_dtype=jnp.float32)
+    mesh = make_mesh(MeshConfig(tp=2), devices=jax.devices()[:2])
+    sh = LlamaShardings(mesh, CFG)
+    be = BatchEngine(CFG, PARAMS, n_slots=2, cache_dtype=jnp.float32, shardings=sh)
+
+    p1, p2 = [1, 2, 3], [9, 8]
+    a = [be_ref.add(0, p1, temperature=0.0), be_ref.add(1, p2, temperature=0.0)]
+    b = [be.add(0, p1, temperature=0.0), be.add(1, p2, temperature=0.0)]
+    assert a == b
+    ta, tb = be_ref.decode(6), be.decode(6)
+    np.testing.assert_array_equal(ta, tb)

@@ -3,6 +3,7 @@
 import numpy as np
 import jax
 import jax.numpy as jnp
+import pytest
 
 from dllama_tpu.engine.engine import GenerationStats, InferenceEngine
 from dllama_tpu.engine.sampling import Sampler, sample
@@ -138,3 +139,39 @@ def test_generate_chunked_stop_rewinds_position():
     assert got == full[: stop_idx + 1]
     # valid rows: 3 prompt rows + stop_idx decode-written rows
     assert e2.pos == 3 + stop_idx
+
+
+def test_session_save_load_roundtrip(tmp_path):
+    """Checkpoint/resume: save mid-conversation, restore into a fresh engine,
+    continuation must match the uninterrupted run (SURVEY §5.4 upgrade)."""
+    sampler = Sampler(temperature=0.0, topp=0.9, seed=0)
+    ref = make_engine()
+    full = list(ref.generate([1, 2, 3], 10, sampler, chunk=1))
+
+    e1 = make_engine()
+    first5 = list(e1.generate([1, 2, 3], 5, sampler, chunk=1))
+    path = str(tmp_path / "session.npz")
+    e1.save_session(path)
+
+    e2 = make_engine()
+    e2.load_session(path)
+    assert e2.pos == e1.pos
+    # continue by feeding the last generated token
+    toks = e2.decode_greedy_n(np.array([full[4]]), 5)
+    assert first5 + [int(t) for t in toks[:, 0]] == full
+
+
+def test_session_fingerprint_mismatch(tmp_path):
+    e1 = make_engine()
+    path = str(tmp_path / "s.npz")
+    e1.save_session(path)
+    from dllama_tpu.engine.engine import InferenceEngine
+    from dllama_tpu.models.llama import random_params
+    import jax.numpy as jnp
+
+    other_cfg = LlamaConfig(dim=64, hidden_dim=128, n_layers=1, n_heads=4,
+                            n_kv_heads=2, vocab_size=64, seq_len=64)
+    e2 = InferenceEngine(other_cfg, random_params(other_cfg, 0, jnp.float32, False),
+                         cache_dtype=jnp.float32)
+    with pytest.raises(ValueError, match="does not match"):
+        e2.load_session(path)
