@@ -53,6 +53,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slots", type=int, default=0,
                    help="serve mode: continuous-batching slots (0 = single-request + prefix cache)")
     p.add_argument("--kernels", choices=["auto", "pallas", "xla"], default="auto")
+    p.add_argument("--distributed", action="store_true",
+                   help="multi-host: jax.distributed.initialize (run the same command on every host)")
+    p.add_argument("--coordinator", default=None, help="host:port rendezvous (omit on TPU pods)")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
     p.add_argument("--trace", metavar="DIR", help="write a jax.profiler trace (XProf/TensorBoard)")
     p.add_argument("--report", action="store_true",
                    help="print memory + per-token latency + collective-payload report")
@@ -66,6 +71,10 @@ def _load(args):
     from dllama_tpu.engine.loader import load_model
     from dllama_tpu.ops import matmul
 
+    if args.distributed:
+        from dllama_tpu.parallel.multihost import initialize
+
+        initialize(args.coordinator, args.num_processes, args.process_id)
     matmul.BACKEND = args.kernels
     return load_model(
         args.model,

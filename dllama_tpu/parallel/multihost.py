@@ -1,0 +1,60 @@
+"""Multi-host bootstrap: every host runs the same binary over one global mesh.
+
+The reference scales across machines with `dllama worker --port 9998` per node
+plus a root that dials them (app.cpp:262-321, nn-network.cpp:254-339). The
+TPU-native equivalent inverts the topology: there is no root/worker split —
+every host launches the SAME command, `jax.distributed` forms the global
+runtime (coordinator elected via --coordinator or TPU-pod metadata), and one
+Mesh spans all chips; GSPMD collectives over ICI/DCN replace the socket mesh.
+
+Weight loading on a multi-host mesh: each host mmaps the same `.m` file and
+materializes only the shards its local chips own
+(`jax.make_array_from_callback`) — the root→worker weight shipping protocol
+(nn-network.cpp:775-869) becomes local file reads.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import numpy as np
+
+log = logging.getLogger("dllama_tpu")
+
+
+def initialize(
+    coordinator: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """jax.distributed.initialize with optional explicit rendezvous.
+
+    On Cloud TPU pods all three args are discovered from metadata — run the
+    same command on every host with no flags. Elsewhere (CPU/GPU fleets or
+    manual TPU setups) pass --coordinator host:port --num-processes N
+    --process-id I per host.
+    """
+    kwargs = {}
+    if coordinator:
+        kwargs["coordinator_address"] = coordinator
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    log.info(
+        "distributed: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+
+
+def device_put_sharded(x, sharding):
+    """Place a host-resident array with `sharding`, working on multi-host
+    meshes: each process materializes only its addressable shards from its own
+    full host copy (every host loads the same file — no weight shipping)."""
+    if jax.process_count() > 1:
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+    return jax.device_put(x, sharding)

@@ -93,9 +93,11 @@ class LlamaShardings:
         }
 
     def put_params(self, params):
+        from dllama_tpu.parallel.multihost import device_put_sharded
+
         specs = self.param_spec_tree(params)
         return jax.tree.map(
-            lambda x, s: jax.device_put(x, self._named(s)),
+            lambda x, s: device_put_sharded(x, self._named(s)),
             params,
             specs,
             is_leaf=lambda x: isinstance(x, P),
@@ -111,11 +113,15 @@ class LlamaShardings:
         return P(None, self._batch_axis(batch), "tp", "sp", None)
 
     def put_cache(self, cache: KVCache) -> KVCache:
+        from dllama_tpu.parallel.multihost import device_put_sharded
+
         s = self._named(self.cache_spec(batch=cache.k.shape[1]))
-        return KVCache(jax.device_put(cache.k, s), jax.device_put(cache.v, s))
+        return KVCache(device_put_sharded(cache.k, s), device_put_sharded(cache.v, s))
 
     def put_replicated(self, x):
-        return jax.device_put(x, self._named(P()))
+        from dllama_tpu.parallel.multihost import device_put_sharded
+
+        return device_put_sharded(x, self._named(P()))
 
     def attn_fn(self, batch: int):
         """shard_map'd sequence-parallel attention when sp > 1, else None
