@@ -73,6 +73,7 @@ class InferenceEngine:
         shardings=None,
         donate_cache: bool = True,
         attn_impl: str = "auto",  # 'auto' | 'jnp' | 'flash' (Pallas online-softmax)
+        layer_unroll: int | bool = 1,  # lax.scan unroll over layers
     ):
         self.cfg = cfg
         self.params = params
@@ -102,23 +103,28 @@ class InferenceEngine:
                 # off-TPU the Mosaic kernel can't lower; run the interpreter
                 attn_fn = partial(flash_gqa_attention, interpret=not on_tpu)
         donate = (1,) if donate_cache else ()
-        self._step = jax.jit(partial(self._step_impl, cfg, attn_fn), donate_argnums=donate)
+        self._step = jax.jit(
+            partial(self._step_impl, cfg, attn_fn, layer_unroll), donate_argnums=donate
+        )
         self._decode_n = jax.jit(
-            partial(self._decode_n_impl, cfg, attn_fn), static_argnums=(5,), donate_argnums=donate
+            partial(self._decode_n_impl, cfg, attn_fn, layer_unroll),
+            static_argnums=(5,),
+            donate_argnums=donate,
         )
         self._decode_sample_n = jax.jit(
-            partial(self._decode_sample_n_impl, cfg, attn_fn),
+            partial(self._decode_sample_n_impl, cfg, attn_fn, layer_unroll),
             static_argnums=(6,),
             donate_argnums=donate,
         )
 
     @staticmethod
-    def _step_impl(cfg, attn_fn, params, cache, tokens, pos, rope_cache):
-        logits, cache = forward(cfg, params, tokens, pos, cache, rope_cache, attn_fn)
+    def _step_impl(cfg, attn_fn, unroll, params, cache, tokens, pos, rope_cache):
+        logits, cache = forward(cfg, params, tokens, pos, cache, rope_cache, attn_fn,
+                                unroll=unroll)
         return logits[:, -1], cache
 
     @staticmethod
-    def _decode_n_impl(cfg, attn_fn, params, cache, token, pos, rope_cache, n):
+    def _decode_n_impl(cfg, attn_fn, unroll, params, cache, token, pos, rope_cache, n):
         """n greedy decode steps fused into one device program (lax.scan) —
         no host roundtrip per token. The whole reference decode loop
         (dllama.cpp:69-88: control packet + forward + sample per token)
@@ -126,7 +132,8 @@ class InferenceEngine:
 
         def body(carry, _):
             token, cache, p = carry
-            logits, cache = forward(cfg, params, token, p, cache, rope_cache, attn_fn)
+            logits, cache = forward(cfg, params, token, p, cache, rope_cache, attn_fn,
+                                    unroll=unroll)
             nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
             return (nxt, cache, p + 1), nxt[:, 0]
 
@@ -134,8 +141,8 @@ class InferenceEngine:
         return toks, cache
 
     @staticmethod
-    def _decode_sample_n_impl(cfg, attn_fn, params, cache, token, pos, rope_cache, key, n,
-                              temperature, topp):
+    def _decode_sample_n_impl(cfg, attn_fn, unroll, params, cache, token, pos, rope_cache,
+                              key, n, temperature, topp):
         """n *sampled* decode steps fused on device — the sampler runs inside
         the scan (branchless in temperature/topp, sampling.sample_logits), so
         non-greedy generation also avoids the per-token host roundtrip the
@@ -144,7 +151,8 @@ class InferenceEngine:
 
         def body(carry, _):
             token, cache, p, key = carry
-            logits, cache = forward(cfg, params, token, p, cache, rope_cache, attn_fn)
+            logits, cache = forward(cfg, params, token, p, cache, rope_cache, attn_fn,
+                                    unroll=unroll)
             key, sub = jax.random.split(key)
             nxt = sample_logits(logits[:, -1], sub, temperature, topp)[:, None]
             return (nxt, cache, p + 1, key), nxt[:, 0]

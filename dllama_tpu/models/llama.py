@@ -104,9 +104,15 @@ def run_layers(
     rope: jax.Array,  # [T, head_size/2, 2] rope rows (or [B, T, ...] per-row)
     attn_fn=None,
     active: jax.Array | None = None,  # [B] bool: rows allowed to write cache
+    unroll: int | bool = 1,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Scan the decoder layers (any contiguous stack — the full model, or one
-    pipeline stage's slice). Returns (x, k_cache, v_cache)."""
+    pipeline stage's slice). Returns (x, k_cache, v_cache).
+
+    `unroll`: passed to lax.scan — unroll=True trades compile time for letting
+    XLA see every layer's weight slice statically (no per-iteration
+    dynamic-slice of the stacked params; matters when slices feed Pallas
+    custom calls that XLA would otherwise copy for)."""
     attn_fn = attn_fn or gqa_attention
 
     def scan_fn(carry, xs):
@@ -115,7 +121,9 @@ def run_layers(
         x, kc, vc = _layer(cfg, x, lp, kc, vc, rope, pos_base, attn_fn, active)
         return x, (kc, vc)
 
-    x, (k_new, v_new) = jax.lax.scan(scan_fn, x, (layer_params, k_cache, v_cache))
+    x, (k_new, v_new) = jax.lax.scan(
+        scan_fn, x, (layer_params, k_cache, v_cache), unroll=unroll
+    )
     return x, k_new, v_new
 
 
@@ -130,6 +138,7 @@ def forward(
     # A sequence-parallel mesh passes the shard_map'd LSE-merge attention here
     # (parallel/ring_attention.sp_cache_attention).
     active: jax.Array | None = None,  # [B] bool cache-write mask (batch mode)
+    unroll: int | bool = 1,  # lax.scan unroll over layers (see run_layers)
 ) -> tuple[jax.Array, KVCache]:
     """Returns (logits f32 [B, T, vocab], updated cache).
 
@@ -145,7 +154,8 @@ def forward(
     else:
         rope = jax.lax.dynamic_slice_in_dim(rope_cache, pos_base, t, axis=0)
     x, k_new, v_new = run_layers(
-        cfg, params["layers"], x, pos_base, cache.k, cache.v, rope, attn_fn, active
+        cfg, params["layers"], x, pos_base, cache.k, cache.v, rope, attn_fn, active,
+        unroll=unroll,
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_epsilon)
     logits = matmul(x, params["wcls"]).astype(jnp.float32)
