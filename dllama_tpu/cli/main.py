@@ -206,9 +206,12 @@ def cmd_chat(args) -> int:
             delta = detector.get_delta()
             if delta:
                 print(delta, end="", flush=True)
-                detector.reset()
             if res == EosResult.EOS:
                 break
+        else:
+            delta = detector.flush()
+            if delta:
+                print(delta, end="", flush=True)
         print()
     return 0
 

@@ -92,13 +92,15 @@ class InferenceEngine:
 
         attn_fn = shardings.attn_fn(batch) if shardings is not None else None
         if attn_fn is None and attn_impl != "jnp":
-            # Pallas flash attention: default on real TPU, opt-in elsewhere.
-            # (sp > 1 already routed to the shard_map'd sequence-parallel path.)
+            # Pallas flash attention: auto only for UNSHARDED engines on real
+            # TPU — pallas_call has no GSPMD partitioning rule, so under a tp
+            # mesh the auto path would all-gather the head-sharded cache per
+            # layer (ADVICE r1). attn_impl='flash' stays an explicit override.
             from dllama_tpu.ops.pallas.flash_attention import flash_gqa_attention, supported
 
             on_tpu = jax.devices()[0].platform == "tpu"
             if supported((cfg.n_heads, cfg.head_size), self.seq_len) and (
-                attn_impl == "flash" or on_tpu
+                attn_impl == "flash" or (on_tpu and shardings is None)
             ):
                 # off-TPU the Mosaic kernel can't lower; run the interpreter
                 attn_fn = partial(flash_gqa_attention, interpret=not on_tpu)
@@ -183,8 +185,27 @@ class InferenceEngine:
         c = self.cfg
         return (
             f"{c.dim}:{c.n_layers}:{c.n_kv_heads}:{c.head_size}:"
-            f"{self.seq_len}:{self.batch}:{self.cache.k.dtype}"
+            f"{self.seq_len}:{self.batch}:{self.cache.k.dtype}:{self._params_digest()}"
         )
+
+    def _params_digest(self) -> str:
+        """Cheap weight-identity hash so a session saved against one checkpoint
+        refuses to resume on a different model with the same geometry (ADVICE
+        r1): leaf shapes/dtypes plus a few sampled values from each of up to 8
+        leaves — O(bytes of a handful of scalars), not a full-weights hash."""
+        if not hasattr(self, "_digest"):
+            import hashlib
+
+            h = hashlib.sha1()
+            leaves = jax.tree.leaves(self.params)
+            for leaf in leaves:
+                h.update(f"{getattr(leaf, 'shape', ())}{getattr(leaf, 'dtype', '')}".encode())
+            step = max(1, len(leaves) // 8)
+            for leaf in leaves[::step]:
+                sample = np.asarray(jax.device_get(jnp.ravel(leaf)[:4]))
+                h.update(sample.tobytes())
+            self._digest = h.hexdigest()[:16]
+        return self._digest
 
     def save_session(self, path: str) -> None:
         """Persist the KV cache + position — resume a long conversation across

@@ -176,25 +176,25 @@ def decode_dense(raw: np.ndarray, shape: tuple, ft: FloatType) -> np.ndarray:
 
 
 def _load_matmul(raw: np.ndarray, shape: tuple[int, int], ft: FloatType, dtype, dequantize: bool):
-    """File [out, in] -> x@W operand: QTensor or dense [in, out]."""
+    """File [out, in] -> host-resident x@W operand: QTensor or dense [in, out]."""
     n_out, k_in = shape
     if ft == FloatType.Q40 and not dequantize:
         rec = raw.reshape(n_out * k_in // Q_BLOCK, 2 + Q_BLOCK // 2)
         scales = rec[:, :2].copy().view(np.float16)
         packed = rec[:, 2:]
-        return QTensor.from_file_layout(packed, scales, n_out, k_in)
-    return jnp.asarray(decode_dense(raw, shape, ft).T.astype(dtype))
+        return QTensor.from_file_layout(packed, scales, n_out, k_in, device=False)
+    return decode_dense(raw, shape, ft).T.astype(dtype, order="C")
 
 
 def _load_expert_matmul(raw: np.ndarray, shape: tuple[int, int, int], ft: FloatType, dtype, dequantize: bool):
-    """File [E, out, in] blob -> expert-stacked x@W operand [E, in, out]."""
+    """File [E, out, in] blob -> expert-stacked host x@W operand [E, in, out]."""
     e, n_out, k_in = shape
     per = ft.nbytes(n_out * k_in)
     leaves = [
         _load_matmul(raw[i * per : (i + 1) * per], (n_out, k_in), ft, dtype, dequantize)
         for i in range(e)
     ]
-    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *leaves)
+    return jax.tree.map(lambda *xs: np.stack(xs, axis=0), *leaves)
 
 
 def load_params(
@@ -211,26 +211,30 @@ def load_params(
     `lax.scan` over layers (one XLA while-loop instead of n_layers copies of
     the graph — the TPU analog of the reference's per-layer segment list).
 
-    `put(name, array)` lets the caller device_put each leaf with a sharding
-    (see parallel/sharding.py); default is plain host->default-device.
+    `put(name, leaf)` receives each finished leaf as a *host* (numpy-backed)
+    pytree and decides device placement — the shard-direct path passes
+    LlamaShardings.param_put so every tensor goes straight from the memmap to
+    its device shards (no whole-model staging on device 0; the reference's
+    analog is slice-then-ship, nn-network.cpp:775-869). Default: plain
+    host->default-device.
     """
-    put = put or (lambda name, x: x)
+    put = put or (lambda name, x: jax.tree.map(jnp.asarray, x))
     layer_acc: dict[str, list] = {}
     params: dict = {}
     for name, shape, ft, raw in iter_tensors(path, config, header_size):
         if name in ("embedding",):
-            params["embedding"] = put(name, jnp.asarray(decode_dense(raw, shape, ft).astype(dtype)))
+            params["embedding"] = put(name, decode_dense(raw, shape, ft).astype(dtype))
         elif name in ("final_norm",):
-            params["final_norm"] = put(name, jnp.asarray(decode_dense(raw, shape, ft)))
+            params["final_norm"] = put(name, decode_dense(raw, shape, ft))
         elif name == "wcls":
             params["wcls"] = put(name, _load_matmul(raw, shape, ft, dtype, dequantize))
         else:
             _, _, short = name.split(".")
             if short in ("rms_att", "rms_ffn"):
-                leaf = jnp.asarray(decode_dense(raw, shape, ft))
+                leaf = decode_dense(raw, shape, ft)
             elif short == "moe_gate":
                 # router stays f32; file [E, dim] -> h@gate operand [dim, E]
-                leaf = jnp.asarray(decode_dense(raw, shape, ft).T.copy())
+                leaf = decode_dense(raw, shape, ft).T.astype(np.float32, order="C")
             elif short.startswith("moe_"):
                 leaf = _load_expert_matmul(raw, shape, ft, dtype, dequantize)
             else:
@@ -239,7 +243,7 @@ def load_params(
 
     layers = {}
     for short, leaves in layer_acc.items():
-        stacked = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *leaves)
+        stacked = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *leaves)
         layers[short] = put(f"layers.{short}", stacked)
     params["layers"] = layers
     return params

@@ -213,12 +213,18 @@ class QTensor:
         return cls(jnp.asarray(packed), jnp.asarray(scales))
 
     @classmethod
-    def from_file_layout(cls, packed: np.ndarray, scales: np.ndarray, n_out: int, k_in: int) -> "QTensor":
-        """Build from the `.m` on-disk layout: blocks row-major over [n_out, k_in]."""
+    def from_file_layout(cls, packed: np.ndarray, scales: np.ndarray, n_out: int, k_in: int,
+                         device: bool = True) -> "QTensor":
+        """Build from the `.m` on-disk layout: blocks row-major over [n_out, k_in].
+
+        `device=False` keeps the leaves as host numpy arrays so the caller can
+        place each shard directly (shard-direct weight loading)."""
         packed = packed.reshape(n_out, k_in // Q_BLOCK, Q_BLOCK // 2)
         scales = scales.reshape(n_out, k_in // Q_BLOCK)
         packed = np.ascontiguousarray(np.transpose(packed, (1, 2, 0))).reshape(k_in // 2, n_out)
         scales = np.ascontiguousarray(np.transpose(scales, (1, 0))).astype(np.float32)
+        if not device:
+            return cls(packed, scales)
         return cls(jnp.asarray(packed), jnp.asarray(scales))
 
     def dequantize(self, dtype=jnp.float32) -> jax.Array:

@@ -54,6 +54,8 @@ def device_put_sharded(x, sharding):
     """Place a host-resident array with `sharding`, working on multi-host
     meshes: each process materializes only its addressable shards from its own
     full host copy (every host loads the same file — no weight shipping)."""
+    if isinstance(x, jax.Array) and x.sharding == sharding:
+        return x  # already placed (shard-direct load path); re-put is a no-op
     if jax.process_count() > 1:
         x = np.asarray(x)
         return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])

@@ -62,35 +62,60 @@ class LlamaShardings:
     def _named(self, spec: P) -> NamedSharding:
         return NamedSharding(self.mesh, spec)
 
+    def _expand(self, spec: P, leaf):
+        """Spec for one leaf (QTensor packed/scales share one spec — both are
+        [in?, out] shaped)."""
+        if isinstance(leaf, QTensor):
+            tp = self.mesh.shape["tp"]
+            axes = tuple(spec)
+            if len(axes) >= 2 and axes[-2] == "tp" and leaf.scales.shape[-2] % tp != 0:
+                # 'tp' on the contraction dim splits the 32-elem quant-block
+                # axis: it must hold tp whole blocks (col-shard, moe_w2)
+                raise ValueError(
+                    f"Q40 col-shard needs in_dim % (32*tp) == 0; "
+                    f"got {leaf.scales.shape[-2] * 32} with tp={tp}"
+                )
+            return QTensor(spec, spec)
+        return spec
+
+    def param_spec(self, name: str, leaf):
+        """Spec for a named param leaf ('embedding', 'wcls', 'layers.<short>')."""
+        if name == "embedding":
+            spec = P(None, None)  # replicated; vocab shard lives on wcls
+        elif name == "final_norm":
+            spec = P(None)
+        elif name == "wcls":
+            spec = P(None, "tp")
+        else:
+            spec = LAYER_SPECS[name.split(".")[-1]]
+        return self._expand(spec, leaf)
+
     def param_spec_tree(self, params) -> dict:
-        """A pytree of PartitionSpecs congruent with the params pytree
-        (QTensor packed/scales share one spec — both are [in?, out] shaped)."""
-
-        tp = self.mesh.shape["tp"]
-
-        def expand(spec, leaf):
-            if isinstance(leaf, QTensor):
-                axes = tuple(spec)
-                if len(axes) >= 2 and axes[-2] == "tp" and leaf.scales.shape[-2] % tp != 0:
-                    # 'tp' on the contraction dim splits the 32-elem quant-block
-                    # axis: it must hold tp whole blocks (col-shard, moe_w2)
-                    raise ValueError(
-                        f"Q40 col-shard needs in_dim % (32*tp) == 0; "
-                        f"got {leaf.scales.shape[-2] * 32} with tp={tp}"
-                    )
-                return QTensor(spec, spec)
-            return spec
-
-        layers = {
-            name: expand(LAYER_SPECS[name], leaf)
-            for name, leaf in params["layers"].items()
-        }
+        """A pytree of PartitionSpecs congruent with the params pytree."""
         return {
-            "embedding": P(None, None),  # replicated; vocab shard lives on wcls
-            "final_norm": P(None),
-            "wcls": expand(P(None, "tp"), params["wcls"]),
-            "layers": layers,
+            "embedding": self.param_spec("embedding", params["embedding"]),
+            "final_norm": self.param_spec("final_norm", params["final_norm"]),
+            "wcls": self.param_spec("wcls", params["wcls"]),
+            "layers": {
+                name: self.param_spec(f"layers.{name}", leaf)
+                for name, leaf in params["layers"].items()
+            },
         }
+
+    def param_put(self, name: str, leaf):
+        """Shard-direct placement of one host-resident param leaf: each device
+        receives only its shard — a model bigger than one chip's HBM never
+        materializes on a single device (the reference's slice-then-ship,
+        nn-network.cpp:775-869, without the wire)."""
+        from dllama_tpu.parallel.multihost import device_put_sharded
+
+        spec = self.param_spec(name, leaf)
+        return jax.tree.map(
+            lambda x, s: device_put_sharded(x, self._named(s)),
+            leaf,
+            spec,
+            is_leaf=lambda x: isinstance(x, P),
+        )
 
     def put_params(self, params):
         from dllama_tpu.parallel.multihost import device_put_sharded

@@ -141,10 +141,16 @@ class ApiServer:
                     parts.append(text)
                     if emit is not None:
                         emit(text)
-                    detector.reset()
                 if res == EosResult.EOS:
                     finish = "stop"
                     break
+            else:
+                # budget exhausted mid-held-prefix: the partial stop never completes
+                text = detector.flush()
+                if text:
+                    parts.append(text)
+                    if emit is not None:
+                        emit(text)
 
             content = "".join(parts)
             # cache the full conversation incl. the reply for the next turn
@@ -200,6 +206,7 @@ class ApiServer:
         parts: list[str] = []
         n_generated = 0
         try:
+            ended_on_eos = False
             for t in req.tokens():
                 n_generated += 1
                 res = detector.append(t, decoder.decode(t))
@@ -208,9 +215,15 @@ class ApiServer:
                     parts.append(text)
                     if emit is not None:
                         emit(text)
-                    detector.reset()
                 if res == EosResult.EOS:
+                    ended_on_eos = True
                     break
+            if not ended_on_eos:
+                text = detector.flush()
+                if text:
+                    parts.append(text)
+                    if emit is not None:
+                        emit(text)
         finally:
             self.scheduler.cancel(req)
         # scheduler reasons: stop/length pass through; a cancel here means the
@@ -352,6 +365,7 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
             n_slots=n_slots,
             cache_dtype=loaded.engine.cache.k.dtype,
             max_seq_len=loaded.engine.seq_len,
+            shardings=loaded.shardings,  # multi-chip serving keeps the mesh placement
         )
         scheduler = Scheduler(be)
     api = ApiServer(

@@ -99,55 +99,74 @@ class EosResult(Enum):
 
 
 class EosDetector:
-    """Streaming multi-stop-sequence matcher with MAYBE buffering.
+    """Streaming multi-stop-sequence matcher with hold-back buffering.
 
-    `padding_left/right` tolerate up to that many junk characters before/after
-    a stop string (the chat CLI uses left=2/right=2 for stray spaces and
-    newlines around e.g. "<|eot_id|>", dllama.cpp:140).
+    Guarantee (reference semantics, tokenizer.cpp:583-628, strengthened): no
+    character of a stop string — or of a buffer suffix that could still grow
+    into one — is ever returned by `get_delta()`. Held text is flushed as soon
+    as the partial match dies; on a full match the stop string and everything
+    after it are swallowed. Unlike the reference, the match is not anchored to
+    the last token boundary: a stop appearing anywhere in the stream fires, so
+    the `padding_left/right` junk-tolerance knobs are accepted for API
+    compatibility but no longer needed.
     """
 
     def __init__(self, stop_token_ids: list[int], stop_pieces: list[str], padding_left: int = 0, padding_right: int = 0):
         self.stop_token_ids = list(stop_token_ids)
-        self.stop_pieces = list(stop_pieces)
-        self.padding_left = padding_left
-        self.padding_right = padding_right
-        self.buffer = ""
-        self._eos_pos: int | None = None
+        self.stop_pieces = [s for s in stop_pieces if s]
+        self.buffer = ""  # held-back text: longest suffix that may be a stop prefix
+        self._delta: str | None = None
 
     def is_eos_token(self, token: int) -> bool:
         return token in self.stop_token_ids
 
     def append(self, token: int, piece: str | None) -> EosResult:
+        self._delta = None
+        if self.is_eos_token(token):
+            # the stop token's own text is never user content; held text is —
+            # its partial-stop suspicion died without a string match
+            self._delta = self.buffer or None
+            self.buffer = ""
+            return EosResult.EOS
         if piece:
             self.buffer += piece
-        if self.is_eos_token(token):
-            self._eos_pos = len(self.buffer)
-            return EosResult.EOS
-        self._eos_pos = None
+        if not self.buffer:
+            return EosResult.NOT_EOS
+
+        first = None  # earliest full stop match anywhere in held text
         for stop in self.stop_pieces:
-            if len(self.buffer) > len(stop) + self.padding_left + self.padding_right:
-                continue
-            for lo in range(self.padding_left + 1):
-                n = len(self.buffer) - lo
-                if n == 0 or n > len(stop) + self.padding_right:
-                    continue
-                n = min(n, len(stop))
-                if self.buffer[lo : lo + n] == stop[:n]:
-                    if n == len(stop):
-                        self._eos_pos = lo
-                        self.buffer = self.buffer[:lo]
-                        return EosResult.EOS
-                    return EosResult.MAYBE_EOS
+            i = self.buffer.find(stop)
+            if i >= 0 and (first is None or i < first):
+                first = i
+        if first is not None:
+            self._delta = self.buffer[:first] or None
+            self.buffer = ""
+            return EosResult.EOS
+
+        # hold the longest buffer suffix that is a proper prefix of any stop
+        hold = 0
+        for stop in self.stop_pieces:
+            for k in range(min(len(self.buffer), len(stop) - 1), hold, -1):
+                if self.buffer.endswith(stop[:k]):
+                    hold = k
+                    break
+        if hold:
+            self._delta = self.buffer[:-hold] or None
+            self.buffer = self.buffer[-hold:]
+            return EosResult.MAYBE_EOS
+        self._delta = self.buffer
+        self.buffer = ""
         return EosResult.NOT_EOS
 
     def get_delta(self) -> str | None:
-        """Text safe to emit now (everything before any detected stop)."""
-        if not self.buffer:
-            return None
-        if self._eos_pos == 0:
-            return None
-        return self.buffer
+        """Text cleared for emission by the last `append` (never a stop prefix)."""
+        return self._delta
+
+    def flush(self) -> str | None:
+        """End of stream: release held text (the partial match will never complete)."""
+        text, self.buffer, self._delta = self.buffer, "", None
+        return text or None
 
     def reset(self) -> None:
         self.buffer = ""
-        self._eos_pos = None
+        self._delta = None

@@ -28,6 +28,11 @@ class TokHeaderKey(IntEnum):
     CHAT_EOS_ID = 6
     CHAT_TEMPLATE = 7
     CHAT_STOP = 8
+    # dllama-tpu extension (>=100, like FloatType.BF16): byte length of an
+    # i32[] payload listing the special-token ids. Only written when the set
+    # differs from the layout heuristic, so typical files stay readable by the
+    # reference (its reader throws on unknown keys, tokenizer.cpp:122).
+    SPECIAL_IDS = 100
 
 
 class Tokenizer:
@@ -47,15 +52,9 @@ class Tokenizer:
         self.eos_ids = list(eos_ids)
         self.chat_template = chat_template
         self.max_token_length = max_token_length or max((len(v) for v in vocab), default=0)
-        # regular/special split (tokenizer.cpp:166-181 role). When not given
-        # explicitly: HF/llama3 layouts put all specials in a tail starting at
-        # bos; sentencepiece-style vocabs put bos/eos at the *head* with the
-        # whole merge vocabulary after them, so there only bos/eos are special.
+        # regular/special split (tokenizer.cpp:166-181 role).
         if special_ids is None:
-            if bos_id >= 0 and 2 * bos_id >= len(vocab):
-                special_ids = list(range(bos_id, len(vocab)))
-            else:
-                special_ids = [i for i in {bos_id, *self.eos_ids} if 0 <= i < len(vocab)]
+            special_ids = self._heuristic_special_ids(len(vocab), bos_id, self.eos_ids)
         self._special_ids = sorted(set(special_ids))
         special = set(self._special_ids)
         self.regular_vocab_size = len(vocab) - len(special)
@@ -64,6 +63,16 @@ class Tokenizer:
         self._native = None  # lazily-built native BPE handle (utils/native.py)
         self._native_tried = False
 
+    @staticmethod
+    def _heuristic_special_ids(vocab_len: int, bos_id: int, eos_ids: list[int]) -> list[int]:
+        """Layout guess for files without an explicit special set: HF/llama3
+        layouts put all specials in a tail starting at bos; sentencepiece-style
+        vocabs put bos/eos at the *head* with the whole merge vocabulary after
+        them, so there only bos/eos are special."""
+        if bos_id >= 0 and 2 * bos_id >= vocab_len:
+            return list(range(bos_id, vocab_len))
+        return [i for i in {bos_id, *eos_ids} if 0 <= i < vocab_len]
+
     # ------------------------------------------------------------------ file io
 
     @classmethod
@@ -71,6 +80,7 @@ class Tokenizer:
         with open(path, "rb") as f:
             magic = struct.unpack("<i", f.read(4))[0]
             chat_template = None
+            special_ids = None
             if magic == TOKENIZER_MAGIC_OLD:
                 vocab_size, max_token_length, bos_id, eos_id, _pad = struct.unpack(
                     "<IIiii", f.read(20)
@@ -83,12 +93,11 @@ class Tokenizer:
                 vocab_size = max_token_length = 0
                 bos_id = -1
                 eos_ids = []
-                chat_template_len = -1
                 # read the whole kv block first (like tokenizer.cpp:104-107);
                 # string payloads (CHAT_STOP, CHAT_TEMPLATE) follow the block
                 # and are skipped/read in key order afterwards.
                 kv = [struct.unpack("<ii", f.read(8)) for _ in range(n_kv)]
-                payload_skips = []
+                payloads = []  # (key, byte_len) in kv order — read after the block
                 for key, value in kv:
                     if key == TokHeaderKey.VERSION:
                         version = value
@@ -100,20 +109,22 @@ class Tokenizer:
                         bos_id = value
                     elif key in (TokHeaderKey.EOS_ID, TokHeaderKey.CHAT_EOS_ID):
                         eos_ids.append(value)
-                    elif key == TokHeaderKey.CHAT_TEMPLATE:
-                        chat_template_len = value
-                    elif key == TokHeaderKey.CHAT_STOP:
-                        payload_skips.append(value)  # legacy; ignored (tokenizer.cpp:121)
+                    elif key in (TokHeaderKey.CHAT_TEMPLATE, TokHeaderKey.CHAT_STOP,
+                                 TokHeaderKey.SPECIAL_IDS):
+                        payloads.append((key, value))
                     elif key == TokHeaderKey.PAD_ID:
                         pass
                     else:
                         raise ValueError(f"invalid tokenizer header key: {key}")
                 if version != 1:
                     raise ValueError("old tokenizer version, please regenerate your tokenizer")
-                for skip in payload_skips:
-                    f.seek(skip, 1)
-                if chat_template_len > 0:
-                    chat_template = f.read(chat_template_len).decode("utf-8")
+                for key, nbytes in payloads:
+                    if key == TokHeaderKey.CHAT_TEMPLATE and nbytes > 0:
+                        chat_template = f.read(nbytes).decode("utf-8")
+                    elif key == TokHeaderKey.SPECIAL_IDS:
+                        special_ids = list(struct.unpack(f"<{nbytes // 4}i", f.read(nbytes)))
+                    else:  # CHAT_STOP: legacy; ignored (tokenizer.cpp:121)
+                        f.seek(nbytes, 1)
             else:
                 raise ValueError("invalid tokenizer file")
 
@@ -123,7 +134,8 @@ class Tokenizer:
                 length = struct.unpack("<i", f.read(4))[0]
                 vocab.append(f.read(length))
                 scores.append(score)
-        return cls(vocab, scores, bos_id, eos_ids, chat_template, max_token_length)
+        return cls(vocab, scores, bos_id, eos_ids, chat_template, max_token_length,
+                   special_ids=special_ids)
 
     def save(self, path: str) -> None:
         """Write the v1 `.t` format (tokenizer-writer.py equivalent)."""
@@ -140,11 +152,19 @@ class Tokenizer:
         template = self.chat_template.encode("utf-8") if self.chat_template else b""
         if template:
             kv.append((TokHeaderKey.CHAT_TEMPLATE, len(template)))
+        specials = b""
+        if self._special_ids != sorted(
+            set(self._heuristic_special_ids(len(self.vocab), self.bos_id, self.eos_ids))
+        ):
+            # the load() heuristic would mis-derive the set — persist it
+            specials = struct.pack(f"<{len(self._special_ids)}i", *self._special_ids)
+            kv.append((TokHeaderKey.SPECIAL_IDS, len(specials)))
         with open(path, "wb") as f:
             f.write(struct.pack("<ii", TOKENIZER_MAGIC, 8 + len(kv) * 8))
             for k, v in kv:
                 f.write(struct.pack("<ii", int(k), int(v)))
             f.write(template)
+            f.write(specials)
             for score, piece in zip(self.scores, self.vocab):
                 f.write(struct.pack("<fi", score, len(piece)))
                 f.write(piece)

@@ -111,9 +111,10 @@ def test_frozen_slot_repeats_last_token():
     be.release(0)
     be.add(1, [3, 4], temperature=0.0)
     last0 = be.last_token[0]
+    pos0_before = int(be.pos[0])
     toks = be.decode(3)
     assert (toks[:, 0] == last0).all()  # frozen slot unchanged
-    assert be.pos[0] == be.pos[0]  # frozen pos not advanced by decode
+    assert be.pos[0] == pos0_before  # frozen pos not advanced by decode
 
 
 def test_flash_attention_vector_pos(rng):

@@ -15,8 +15,8 @@ TINY = LlamaConfig(
 )
 
 
-def make_engine(**kw):
-    params = random_params(TINY, seed=0, dtype=jnp.float32, quantize=False)
+def make_engine(seed=0, **kw):
+    params = random_params(TINY, seed=seed, dtype=jnp.float32, quantize=False)
     kw.setdefault("cache_dtype", jnp.float32)
     return InferenceEngine(TINY, params, **kw)
 
@@ -175,3 +175,18 @@ def test_session_fingerprint_mismatch(tmp_path):
                          cache_dtype=jnp.float32)
     with pytest.raises(ValueError, match="does not match"):
         e2.load_session(path)
+
+
+def test_session_fingerprint_rejects_different_weights(tmp_path):
+    """ADVICE r1: same geometry, different checkpoint -> load_session must
+    refuse (the KV cache would not match the weights)."""
+    e1 = make_engine(seed=0)
+    e1.prefill(np.array([[1, 2, 3]], dtype=np.int32))
+    path = str(tmp_path / "sess.npz")
+    e1.save_session(path)
+    e2 = make_engine(seed=1)  # same shapes, different weights
+    with pytest.raises(ValueError, match="does not match"):
+        e2.load_session(path)
+    e3 = make_engine(seed=0)
+    e3.load_session(path)  # same weights: accepted
+    assert e3.pos == e1.pos

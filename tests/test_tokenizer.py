@@ -127,6 +127,46 @@ def test_eos_detector_exact_and_partial():
     assert det.get_delta() == "a"
 
 
+def test_eos_detector_cross_token_stop_never_leaks():
+    """VERDICT round-1 repro: stop "<eos>" arriving as "<e" + "os>" must emit
+    nothing and terminate the stream (reference tokenizer.cpp:583-628)."""
+    det = EosDetector([42], ["<eos>"], padding_left=2, padding_right=2)
+    assert det.append(1, "<e") == EosResult.MAYBE_EOS
+    assert det.get_delta() is None  # partial stop prefix must be held, not emitted
+    assert det.append(2, "os>") == EosResult.EOS
+    assert det.get_delta() is None
+
+
+def test_eos_detector_held_text_flushes_when_match_dies():
+    det = EosDetector([42], ["<eos>"], padding_left=2, padding_right=2)
+    assert det.append(1, "abc<e") == EosResult.MAYBE_EOS
+    assert det.get_delta() == "abc"  # safe text streams immediately
+    assert det.append(2, "xyz") == EosResult.NOT_EOS
+    assert det.get_delta() == "<exyz"  # dead partial match flushes in full
+
+
+def test_eos_detector_flush_releases_partial_at_stream_end():
+    det = EosDetector([42], ["<eos>"])
+    assert det.append(1, "hi<e") == EosResult.MAYBE_EOS
+    assert det.get_delta() == "hi"
+    assert det.flush() == "<e"
+    assert det.flush() is None
+
+
+def test_eos_detector_stop_mid_piece_swallows_tail():
+    det = EosDetector([42], ["<eos>"])
+    assert det.append(1, "ok<eos>junk") == EosResult.EOS
+    assert det.get_delta() == "ok"
+
+
+def test_eos_detector_multiple_stops_longest_hold():
+    det = EosDetector([42], ["STOP", "SToo"])
+    assert det.append(1, "a ST") == EosResult.MAYBE_EOS
+    assert det.get_delta() == "a "
+    assert det.append(2, "OP") == EosResult.EOS
+    assert det.get_delta() is None
+
+
 def test_eos_detector_stop_token_id():
     det = EosDetector([42], ["</s>"])
     assert det.append(42, None) == EosResult.EOS
@@ -141,3 +181,31 @@ def test_eos_detector_long_text_passes_through():
 def test_chat_stops_from_tokenizer():
     t = make_tokenizer()
     assert chat_stops(t) == ["</s>", "<|eot|>"]
+
+
+def test_special_ids_survive_t_roundtrip(tmp_path):
+    """ADVICE r1: head-special vocabs (sentencepiece CONTROL at ids 0-2 plus a
+    USER_DEFINED token mid-vocab) must keep their special set across save/load
+    — the layout heuristic alone would demote <unk> to a merge candidate."""
+    vocab = [b"<unk>", b"<s>", b"</s>"] + [bytes([i]) for i in range(256)] + [b"<tool>", b"he"]
+    scores = [0.0] * len(vocab)
+    specials = [0, 1, 2, 259]  # <unk>, bos, eos, <tool> — but NOT "he"
+    t = Tokenizer(vocab, scores, bos_id=1, eos_ids=[2], special_ids=specials)
+    path = str(tmp_path / "sp.t")
+    t.save(path)
+    t2 = Tokenizer.load(path)
+    assert t2._special_ids == sorted(specials)
+    assert t2.regular_vocab_size == len(vocab) - len(specials)
+    # <unk> (id 0) must not act as a merge candidate after the roundtrip
+    assert 0 not in t2._regular_index.values()
+    # heuristic-matching sets write no extension key: file loads with defaults
+    vocab3 = [bytes([i]) for i in range(256)] + [b"<s>", b"</s>"]
+    t3 = Tokenizer(vocab3, [0.0] * 258, 256, [257])
+    p3 = str(tmp_path / "plain.t")
+    t3.save(p3)
+    raw = open(p3, "rb").read()
+    import struct as _s
+    header_size = _s.unpack("<i", raw[4:8])[0]
+    keys = [_s.unpack("<ii", raw[8 + 8 * i : 16 + 8 * i])[0] for i in range((header_size - 8) // 8)]
+    assert 100 not in keys  # SPECIAL_IDS key absent -> reference-readable
+    assert Tokenizer.load(p3)._special_ids == [256, 257]
