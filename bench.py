@@ -1,22 +1,139 @@
 """Benchmark: single-chip decode throughput on a synthetic Q40 Llama.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
-vs_baseline is measured against the driver north star of 1000 tok/s/chip
-(BASELINE.json: Llama-3.1-8B-Q40 on v5e-8; we scale the target by model size
-so a 1B run compares against 8000 tok/s-equivalent... no — we report raw
-decode tok/s on the benchmarked config and vs_baseline = value / north_star
-where north_star is size-adjusted: 1000 tok/s * (8.03B / params_B)).
+vs_baseline = decode tok/s vs the size-adjusted driver north star
+(BASELINE.json: Llama-3.1-8B-Q40 at 1000 tok/s/chip -> north_star =
+1000 * 8.03e9 / params).
 
-Presets via BENCH_PRESET env: tiny (CI smoke), 1b (default), 8b.
-Runs on whatever jax.devices() provides (the axon-tunneled TPU v5e chip in
-this container; CPU elsewhere).
+Hardened against the axon-tunnel wedge (VERDICT r1 #1): the parent process
+never initializes a JAX backend. It probes the tunnel in a subprocess with a
+timeout, retries UNAVAILABLE/hangs with a bounded budget, runs the real
+measurement in ONE worker subprocess with a generous timeout, and if the TPU
+never comes up emits a CPU-fallback record — the bench never exits non-zero
+and never prints nothing.
+
+Env knobs:
+  BENCH_PRESET         tiny | 1b (default) | 8b
+  BENCH_DECODE_TOKENS  timed fused-decode length (default 256)
+  BENCH_UNROLL         lax.scan unroll over layers: int, or 'full' (default 1)
+  BENCH_BUDGET_S       total wall-clock budget for the parent (default 840 —
+                       fits under the driver's `timeout 900 python bench.py`)
+  BENCH_FORCE_CPU      '1': skip the TPU entirely (CI smoke)
 """
 
 import json
 import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+_PROBE = (
+    "import jax, jax.numpy as jnp; jnp.ones(8).sum().block_until_ready(); "
+    "print('PROBE_OK', jax.devices()[0].platform)"
+)
+
+
+def _cpu_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""  # skip the axon sitecustomize entirely
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _run_child(argv, env, timeout_s: float):
+    """Run a child with a timeout, never blocking past it: on expiry the child
+    is killed and — if it sits in uninterruptible IO on the wedged tunnel —
+    ABANDONED rather than waited on (a plain subprocess.run would hang in its
+    post-kill communicate()). Returns (stdout, stderr, rc) or (None, "", -1)."""
+    import tempfile
+
+    with tempfile.TemporaryFile("w+") as out, tempfile.TemporaryFile("w+") as err:
+        proc = subprocess.Popen(argv, stdout=out, stderr=err, env=env, text=True)
+        try:
+            rc = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass  # D-state child: abandon it, do not block the bench
+            return None, "", -1
+        out.seek(0)
+        err.seek(0)
+        return out.read(), err.read(), rc
+
+
+def probe_tpu(timeout_s: float) -> bool:
+    """Can a fresh process reach the chip? Runs in a subprocess so a wedged
+    tunnel hangs the child, not us. Requires a NON-CPU platform — a fast init
+    failure makes JAX fall back to its CPU backend, which must not count."""
+    stdout, _, rc = _run_child([sys.executable, "-c", _PROBE], None, timeout_s)
+    if rc != 0 or stdout is None:
+        return False
+    for line in stdout.splitlines():
+        if line.startswith("PROBE_OK"):
+            platform = line.split()[-1].lower()
+            return platform != "cpu"
+    return False
+
+
+def run_worker(env, timeout_s: float):
+    """One measurement subprocess; returns the parsed JSON line or None."""
+    stdout, stderr, rc = _run_child(
+        [sys.executable, __file__, "--worker"], env, timeout_s
+    )
+    if stdout is None:
+        print(f"bench worker timed out after {timeout_s:.0f}s", file=sys.stderr)
+        return None
+    for line in reversed(stdout.splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                pass
+    sys.stderr.write(stderr[-2000:])
+    return None
+
+
+def main():
+    deadline = time.monotonic() + float(os.environ.get("BENCH_BUDGET_S", "840"))
+    force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
+    tpu_ok = False
+    if not force_cpu:
+        # bounded probe/retry: a wedged relay clears only server-side, so a
+        # couple of spaced attempts, then give up and record the CPU fallback.
+        for attempt in range(3):
+            budget = deadline - time.monotonic()
+            if budget < 240:  # not enough left for probe + worker + fallback
+                break
+            tpu_ok = probe_tpu(min(90, budget - 180))
+            if tpu_ok:
+                break
+            print(f"TPU probe {attempt + 1} failed (tunnel wedged/unavailable)",
+                  file=sys.stderr)
+            if deadline - time.monotonic() > 420:
+                time.sleep(60)
+    if tpu_ok:
+        budget = deadline - time.monotonic() - 120  # keep room for CPU fallback
+        result = run_worker(dict(os.environ), max(budget, 60))
+        if result is not None:
+            print(json.dumps(result))
+            return 0
+        print("TPU worker failed; falling back to CPU record", file=sys.stderr)
+    env = _cpu_env()
+    env["BENCH_DECODE_TOKENS"] = os.environ.get("BENCH_CPU_DECODE_TOKENS", "16")
+    result = run_worker(env, max(deadline - time.monotonic(), 120))
+    if result is None:  # last resort: an honest empty record, still rc=0
+        result = {
+            "metric": "decode tok/s (UNMEASURED: TPU tunnel down, CPU fallback failed)",
+            "value": 0.0, "unit": "tok/s", "vs_baseline": 0.0,
+        }
+    result["tpu_unavailable"] = not tpu_ok
+    print(json.dumps(result))
+    return 0
+
+
+# --------------------------------------------------------------------- worker
 
 
 def params_count(cfg) -> float:
@@ -28,9 +145,10 @@ def params_count(cfg) -> float:
     return cfg.vocab_size * cfg.dim * 2 + cfg.n_layers * per_layer
 
 
-def main():
+def worker():
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from dllama_tpu.engine.engine import InferenceEngine
     from dllama_tpu.models.config import LlamaConfig
@@ -50,11 +168,14 @@ def main():
         raise SystemExit(f"BENCH_PRESET must be one of {sorted(presets)}, got {preset!r}")
     label = {"tiny": "tiny", "1b": "Llama-3.2-1B", "8b": "Llama-3.1-8B"}[preset]
     cfg = LlamaConfig(**presets[preset])
+    unroll_env = os.environ.get("BENCH_UNROLL", "1")
+    unroll = True if unroll_env == "full" else int(unroll_env)
 
     dev = jax.devices()[0]
     t0 = time.perf_counter()
     params = random_params(cfg, seed=0, dtype=jnp.bfloat16, quantize=True)
-    eng = InferenceEngine(cfg, params, cache_dtype=jnp.bfloat16, max_prefill_chunk=128)
+    eng = InferenceEngine(cfg, params, cache_dtype=jnp.bfloat16, max_prefill_chunk=128,
+                          layer_unroll=unroll)
     t_setup = time.perf_counter() - t0
 
     prompt = np.arange(1, 129, dtype=np.int32)[None] % cfg.vocab_size
@@ -103,6 +224,7 @@ def main():
         "device": str(dev),
         "setup_s": round(t_setup, 1),
         "compile_s": round(t_prefill_compile + t_decode_compile, 1),
+        "unroll": unroll_env,
     }
     # bytes/token is part of the benchmark contract (SURVEY.md §5.1/§6): on
     # one chip it's 0; multi-chip runs report the analytic ICI payload.
@@ -116,4 +238,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        worker()
+    else:
+        sys.exit(main())

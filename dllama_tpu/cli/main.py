@@ -53,6 +53,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slots", type=int, default=0,
                    help="serve mode: continuous-batching slots (0 = single-request + prefix cache)")
     p.add_argument("--kernels", choices=["auto", "pallas", "xla"], default="auto")
+    p.add_argument("--sync", choices=["bf16", "q80"], default="bf16",
+                   help="tp activation exchange: native bf16 collectives or the "
+                        "reference's Q80 quantized payload (half the ICI bytes)")
     p.add_argument("--distributed", action="store_true",
                    help="multi-host: jax.distributed.initialize (run the same command on every host)")
     p.add_argument("--coordinator", default=None, help="host:port rendezvous (omit on TPU pods)")
@@ -84,6 +87,7 @@ def _load(args):
         cache_dtype=jnp.bfloat16 if args.cache_dtype == "bf16" else jnp.float32,
         dequantize=args.dequantize,
         max_prefill_chunk=args.max_prefill_chunk,
+        sync=args.sync,
     )
 
 

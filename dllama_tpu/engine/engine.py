@@ -74,6 +74,7 @@ class InferenceEngine:
         donate_cache: bool = True,
         attn_impl: str = "auto",  # 'auto' | 'jnp' | 'flash' (Pallas online-softmax)
         layer_unroll: int | bool = 1,  # lax.scan unroll over layers
+        sync: str = "bf16",  # 'bf16' (native collectives) | 'q80' (quantized exchange)
     ):
         self.cfg = cfg
         self.params = params
@@ -104,29 +105,57 @@ class InferenceEngine:
             ):
                 # off-TPU the Mosaic kernel can't lower; run the interpreter
                 attn_fn = partial(flash_gqa_attention, interpret=not on_tpu)
+        if sync not in ("bf16", "q80"):
+            raise ValueError(f"sync must be 'bf16' or 'q80', got {sync!r}")
+        col_fn = None
+        if sync == "q80":
+            # the reference's Q80 ZQ-pipe exchange as an ICI option: wo/w2
+            # partial sums ride quantized (parallel/collectives.py). Only
+            # meaningful with a tp axis; silently native otherwise.
+            if shardings is not None and shardings.mesh.shape["tp"] > 1:
+                from dllama_tpu.parallel.collectives import make_q80_col_matmul
+
+                col_fn = make_q80_col_matmul(shardings.mesh)
+
+        if shardings is not None and shardings.mesh.shape["pp"] > 1:
+            # stage-split forward: GPipe shard_map over 'pp' (manual axis),
+            # tp/dp composed by GSPMD inside each stage (parallel/pipeline.py).
+            # n_micro=1 — the engine drives one request; microbatch overlap
+            # belongs to the serving tier. layer_unroll does not apply (the
+            # stage schedule replaces the layer scan).
+            if col_fn is not None:
+                raise ValueError("--sync q80 is not supported on pp meshes yet")
+            from dllama_tpu.parallel.pipeline import make_pp_forward
+
+            pp_fwd = make_pp_forward(cfg, shardings.mesh, n_micro=1, attn_fn=attn_fn)
+
+            def fwd(params, cache, tokens, pos, rope_cache):
+                return pp_fwd(params, tokens, pos, cache, rope_cache)
+        else:
+            def fwd(params, cache, tokens, pos, rope_cache):
+                return forward(cfg, params, tokens, pos, cache, rope_cache, attn_fn,
+                               unroll=layer_unroll, col_fn=col_fn)
+
         donate = (1,) if donate_cache else ()
-        self._step = jax.jit(
-            partial(self._step_impl, cfg, attn_fn, layer_unroll), donate_argnums=donate
-        )
+        self._step = jax.jit(partial(self._step_impl, fwd), donate_argnums=donate)
         self._decode_n = jax.jit(
-            partial(self._decode_n_impl, cfg, attn_fn, layer_unroll),
+            partial(self._decode_n_impl, fwd),
             static_argnums=(5,),
             donate_argnums=donate,
         )
         self._decode_sample_n = jax.jit(
-            partial(self._decode_sample_n_impl, cfg, attn_fn, layer_unroll),
+            partial(self._decode_sample_n_impl, fwd),
             static_argnums=(6,),
             donate_argnums=donate,
         )
 
     @staticmethod
-    def _step_impl(cfg, attn_fn, unroll, params, cache, tokens, pos, rope_cache):
-        logits, cache = forward(cfg, params, tokens, pos, cache, rope_cache, attn_fn,
-                                unroll=unroll)
+    def _step_impl(fwd, params, cache, tokens, pos, rope_cache):
+        logits, cache = fwd(params, cache, tokens, pos, rope_cache)
         return logits[:, -1], cache
 
     @staticmethod
-    def _decode_n_impl(cfg, attn_fn, unroll, params, cache, token, pos, rope_cache, n):
+    def _decode_n_impl(fwd, params, cache, token, pos, rope_cache, n):
         """n greedy decode steps fused into one device program (lax.scan) —
         no host roundtrip per token. The whole reference decode loop
         (dllama.cpp:69-88: control packet + forward + sample per token)
@@ -134,8 +163,7 @@ class InferenceEngine:
 
         def body(carry, _):
             token, cache, p = carry
-            logits, cache = forward(cfg, params, token, p, cache, rope_cache, attn_fn,
-                                    unroll=unroll)
+            logits, cache = fwd(params, cache, token, p, rope_cache)
             nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
             return (nxt, cache, p + 1), nxt[:, 0]
 
@@ -143,7 +171,7 @@ class InferenceEngine:
         return toks, cache
 
     @staticmethod
-    def _decode_sample_n_impl(cfg, attn_fn, unroll, params, cache, token, pos, rope_cache,
+    def _decode_sample_n_impl(fwd, params, cache, token, pos, rope_cache,
                               key, n, temperature, topp):
         """n *sampled* decode steps fused on device — the sampler runs inside
         the scan (branchless in temperature/topp, sampling.sample_logits), so
@@ -153,8 +181,7 @@ class InferenceEngine:
 
         def body(carry, _):
             token, cache, p, key = carry
-            logits, cache = forward(cfg, params, token, p, cache, rope_cache, attn_fn,
-                                    unroll=unroll)
+            logits, cache = fwd(params, cache, token, p, rope_cache)
             key, sub = jax.random.split(key)
             nxt = sample_logits(logits[:, -1], sub, temperature, topp)[:, None]
             return (nxt, cache, p + 1, key), nxt[:, 0]

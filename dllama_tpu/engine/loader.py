@@ -31,6 +31,7 @@ class LoadedModel:
     engine: InferenceEngine
     tokenizer: Tokenizer | None
     shardings: LlamaShardings | None
+    sync: str = "bf16"  # tp exchange mode, forwarded to the serving tier
 
 
 def build_shardings(cfg: LlamaConfig, mesh_spec: str | None) -> LlamaShardings | None:
@@ -57,6 +58,7 @@ def load_model(
     cache_dtype=jnp.bfloat16,
     dequantize: bool = False,
     max_prefill_chunk: int = 128,
+    sync: str = "bf16",
 ) -> LoadedModel:
     cfg, header_size = read_header(model_path, max_seq_len)
     log.info("model: %s", cfg.describe())
@@ -80,5 +82,6 @@ def load_model(
         max_seq_len=max_seq_len,
         max_prefill_chunk=max_prefill_chunk,
         shardings=shardings,
+        sync=sync,
     )
-    return LoadedModel(cfg, engine, tokenizer, shardings)
+    return LoadedModel(cfg, engine, tokenizer, shardings, sync=sync)

@@ -68,7 +68,10 @@ def _cache_update(cache, new, pos_base, active):
     return upd
 
 
-def _layer(cfg: LlamaConfig, x, lp, k_cache, v_cache, rope, pos_base, attn_fn, active=None):
+def _layer(cfg: LlamaConfig, x, lp, k_cache, v_cache, rope, pos_base, attn_fn, active=None,
+           col_fn=None):
+    col_fn = col_fn or matmul  # wo/w2 col-sharded matmuls; `--sync q80` swaps in
+    # the Q80-exchange shard_map (parallel/collectives.make_q80_col_matmul)
     b, t, d = x.shape
     # --- attention block (reference "att" segment, llm.cpp:198-312)
     h = rms_norm(x, lp["rms_att"], cfg.norm_epsilon)
@@ -80,7 +83,7 @@ def _layer(cfg: LlamaConfig, x, lp, k_cache, v_cache, rope, pos_base, attn_fn, a
     k_cache = _cache_update(k_cache, k.transpose(0, 2, 1, 3), pos_base, active)
     v_cache = _cache_update(v_cache, v.transpose(0, 2, 1, 3), pos_base, active)
     att = attn_fn(q, k_cache, v_cache, pos_base).reshape(b, t, d)
-    x = x + matmul(att, lp["wo"])
+    x = x + col_fn(att, lp["wo"])
     # --- feed-forward block (reference "ff" segment, llm.cpp:314-385);
     # sparse-MoE variant when the header carries N_EXPERTS (llm.hpp:17-18 —
     # a key the reference parses but never executes)
@@ -90,7 +93,7 @@ def _layer(cfg: LlamaConfig, x, lp, k_cache, v_cache, rope, pos_base, attn_fn, a
     else:
         gate = activation(matmul(h, lp["w1"]).astype(jnp.float32), cfg.hidden_act).astype(x.dtype)
         up = matmul(h, lp["w3"])
-        x = x + matmul(gate * up, lp["w2"])
+        x = x + col_fn(gate * up, lp["w2"])
     return x, k_cache, v_cache
 
 
@@ -105,6 +108,7 @@ def run_layers(
     attn_fn=None,
     active: jax.Array | None = None,  # [B] bool: rows allowed to write cache
     unroll: int | bool = 1,
+    col_fn=None,  # wo/w2 matmul override (Q80 quantized exchange)
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Scan the decoder layers (any contiguous stack — the full model, or one
     pipeline stage's slice). Returns (x, k_cache, v_cache).
@@ -118,7 +122,7 @@ def run_layers(
     def scan_fn(carry, xs):
         x = carry
         lp, kc, vc = xs
-        x, kc, vc = _layer(cfg, x, lp, kc, vc, rope, pos_base, attn_fn, active)
+        x, kc, vc = _layer(cfg, x, lp, kc, vc, rope, pos_base, attn_fn, active, col_fn)
         return x, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(
@@ -139,6 +143,7 @@ def forward(
     # (parallel/ring_attention.sp_cache_attention).
     active: jax.Array | None = None,  # [B] bool cache-write mask (batch mode)
     unroll: int | bool = 1,  # lax.scan unroll over layers (see run_layers)
+    col_fn=None,  # wo/w2 matmul override (Q80 quantized exchange)
 ) -> tuple[jax.Array, KVCache]:
     """Returns (logits f32 [B, T, vocab], updated cache).
 
@@ -155,7 +160,7 @@ def forward(
         rope = jax.lax.dynamic_slice_in_dim(rope_cache, pos_base, t, axis=0)
     x, k_new, v_new = run_layers(
         cfg, params["layers"], x, pos_base, cache.k, cache.v, rope, attn_fn, active,
-        unroll=unroll,
+        unroll=unroll, col_fn=col_fn,
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_epsilon)
     logits = matmul(x, params["wcls"]).astype(jnp.float32)

@@ -22,8 +22,12 @@ Microbatches split the *batch* axis (all sequences share one position, so
 decode with B=1 degenerates to sequential layer-split — the PP bubble is the
 price of depth; throughput serving should drive PP with B >= pp).
 
-Composition: specs here address only the 'pp' mesh axis; run it on a mesh
-whose other axes are 1 (tp x pp composition is staged for a later round).
+Composition: the shard_map is *partial-manual* — only 'pp' is a manual axis
+(`axis_names={'pp'}`); tp/dp stay under GSPMD, so weights placed with
+P('pp', ..., 'tp') compose stage-split with tensor-parallel automatically
+(the matmul psum over 'tp' is inserted by XLA inside each stage). pp x sp is
+rejected by LlamaShardings (ring attention inside a manual stage is not
+supported).
 """
 
 from __future__ import annotations
@@ -87,6 +91,7 @@ def make_pp_forward(cfg: LlamaConfig, mesh: Mesh, n_micro: int = 1, attn_fn=None
                 P(),  # rope rows
             ),
             out_specs=(P(), P("pp"), P("pp")),
+            axis_names=frozenset({"pp"}),  # tp/dp stay GSPMD-auto inside stages
             check_vma=False,
         )
         def pipeline(embedding, layers, final_norm, wcls, toks, k_all, v_all, rope_rows):

@@ -148,15 +148,79 @@ def sp_cache_attention(
     return out.transpose(0, 3, 1, 2, 4).reshape(b, t, hq, d).astype(q.dtype)
 
 
+def ring_cache_attention(
+    q: jax.Array,  # [B, Tl, Hq, d] this shard's slice of the chunk's queries
+    k_cache: jax.Array,  # [B, Hkv, Sl, d] local seq shard of the cache
+    v_cache: jax.Array,
+    pos_base: jax.Array,  # scalar i32 — absolute position of the chunk's query 0
+    *,
+    axis_name: str = "sp",
+) -> jax.Array:
+    """Chunked-prefill attention with queries sequence-sharded over `sp` and
+    the KV *cache* ring-rotating; call inside shard_map.
+
+    The chunk's own keys are already written into the sp-sharded cache (the
+    cache update runs before attention in models/llama._layer), so each of the
+    `sp` steps attends local queries to one rotating cache block — masked to
+    global slots <= the query's absolute position — and merges the partial
+    softmax. vs. sp_cache_attention this also parallelizes the *query* axis:
+    qkv/FFN matmuls upstream shard over sp instead of being replicated, which
+    is the long-context prefill capability the reference lacks (SURVEY §5.7).
+    """
+    b, tl, hq, d = q.shape
+    hkv, sl = k_cache.shape[1], k_cache.shape[2]
+    g = hq // hkv
+    sp = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    scale = 1.0 / math.sqrt(d)
+
+    qg = q.reshape(b, tl, hkv, g, d)
+    q_pos = pos_base + idx * tl + jax.lax.broadcasted_iota(jnp.int32, (tl, sl), 0)
+
+    o = jnp.zeros((b, hkv, g, tl, d), jnp.float32)
+    m = jnp.full((b, hkv, g, tl), NEG, jnp.float32)
+    l = jnp.zeros((b, hkv, g, tl), jnp.float32)
+    acc = (o, m, l)
+
+    k, v = k_cache, v_cache
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+    for step in range(sp):
+        src = (idx - step) % sp  # owner of the cache block currently held
+        slot = src * sl + jax.lax.broadcasted_iota(jnp.int32, (tl, sl), 1)
+        mask = (slot <= q_pos)[None, None, None]
+        acc = _merge(acc, *_partial_attn(qg, k, v, mask, scale))
+        if step + 1 < sp:
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+
+    o, m, l = acc
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, tl, hq, d).astype(q.dtype)
+
+
 def make_sp_attention(mesh, cache_batch_spec=None):
     """Build the shard_map-wrapped attention for llama.forward's `attn_fn` slot.
 
     Specs mirror LlamaShardings.cache_spec: cache [B, Hkv, S, d] ->
-    P(dp?, 'tp', 'sp', None); queries replicated over sp, head-sharded on tp.
+    P(dp?, 'tp', 'sp', None). Dispatch is static on the chunk width T:
+    multi-token chunks divisible by sp take :func:`ring_cache_attention`
+    (queries sharded over sp — true sequence-parallel prefill); decode and
+    ragged chunks take :func:`sp_cache_attention` (replicated queries, LSE
+    merge over the cache shards).
     """
     dp = cache_batch_spec
+    sp = mesh.shape["sp"]
 
     def attn(q, k_cache, v_cache, pos_base):
+        t = q.shape[1]  # static under jit
+        if t > 1 and t % sp == 0:
+            return jax.shard_map(
+                partial(ring_cache_attention, axis_name="sp"),
+                mesh=mesh,
+                in_specs=(P(dp, "sp", "tp", None), P(dp, "tp", "sp", None),
+                          P(dp, "tp", "sp", None), P()),
+                out_specs=P(dp, "sp", "tp", None),
+            )(q, k_cache, v_cache, pos_base)
         return jax.shard_map(
             partial(sp_cache_attention, axis_name="sp"),
             mesh=mesh,

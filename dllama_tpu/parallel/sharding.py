@@ -52,12 +52,18 @@ class LlamaShardings:
         self.cfg = cfg
         tp = mesh.shape["tp"]
         sp = mesh.shape["sp"]
+        pp = mesh.shape["pp"]
         if cfg.n_kv_heads % tp != 0:
             # the reference's hard requirement nNodes <= nKvHeads (app.cpp:201-203);
             # ours is divisibility of the kv-head axis.
             raise ValueError(f"n_kv_heads={cfg.n_kv_heads} not divisible by tp={tp}")
         if cfg.seq_len % max(sp, 1) != 0:
             raise ValueError(f"seq_len={cfg.seq_len} not divisible by sp={sp}")
+        if pp > 1:
+            if cfg.n_layers % pp != 0:
+                raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
+            if sp > 1:
+                raise ValueError("pp x sp composition is not supported; use pp with tp/dp")
 
     def _named(self, spec: P) -> NamedSharding:
         return NamedSharding(self.mesh, spec)
@@ -88,6 +94,9 @@ class LlamaShardings:
             spec = P(None, "tp")
         else:
             spec = LAYER_SPECS[name.split(".")[-1]]
+            if self.mesh.shape["pp"] > 1:
+                # stage-split: the stacked layer axis shards over 'pp'
+                spec = P("pp", *tuple(spec)[1:])
         return self._expand(spec, leaf)
 
     def param_spec_tree(self, params) -> dict:
@@ -135,7 +144,8 @@ class LlamaShardings:
 
     def cache_spec(self, batch: int) -> P:
         # [n_layers, batch, n_kv_heads, seq, head_size]
-        return P(None, self._batch_axis(batch), "tp", "sp", None)
+        layer_axis = "pp" if self.mesh.shape["pp"] > 1 else None
+        return P(layer_axis, self._batch_axis(batch), "tp", "sp", None)
 
     def put_cache(self, cache: KVCache) -> KVCache:
         from dllama_tpu.parallel.multihost import device_put_sharded

@@ -103,7 +103,8 @@ class ApiServer:
 
         if self.scheduler is not None:
             return self._complete_batched(
-                body, messages, temperature, topp, max_tokens, extra_stops, emit
+                body, messages, temperature, topp, max_tokens, extra_stops, emit,
+                seed=seed,
             )
 
         with self.lock:
@@ -178,11 +179,11 @@ class ApiServer:
         }
 
     def _complete_batched(self, body, messages, temperature, topp, max_tokens,
-                          extra_stops, emit) -> dict:
+                          extra_stops, emit, seed=None) -> dict:
         """Continuous-batching completion: submit to the scheduler, stream from
-        the per-request queue. No server-side prefix cache (slots are recycled
-        across conversations) and no per-request seed (the batch shares one
-        device PRNG stream); temperature=0 stays exactly reproducible."""
+        the per-request queue. Per-request `seed` pins the slot's own PRNG
+        stream (reproducible regardless of batch-mates). No server-side prefix
+        cache in this tier (slots are recycled across conversations)."""
         generated = self.template.generate(
             [ChatItem(r, c) for r, c in messages], append_generation_prompt=True
         )
@@ -201,7 +202,8 @@ class ApiServer:
         )
         decoder = self.tokenizer.make_stream_decoder()
         req = self.scheduler.submit(
-            prompt_tokens, temperature, topp, budget, self.tokenizer.eos_ids
+            prompt_tokens, temperature, topp, budget, self.tokenizer.eos_ids,
+            seed=int(seed) if seed is not None else None,
         )
         parts: list[str] = []
         n_generated = 0
@@ -366,6 +368,7 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
             cache_dtype=loaded.engine.cache.k.dtype,
             max_seq_len=loaded.engine.seq_len,
             shardings=loaded.shardings,  # multi-chip serving keeps the mesh placement
+            sync=getattr(loaded, "sync", "bf16"),
         )
         scheduler = Scheduler(be)
     api = ApiServer(

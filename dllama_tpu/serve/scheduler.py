@@ -17,6 +17,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 
 from dllama_tpu.engine.batch import BatchEngine
@@ -33,11 +34,31 @@ class Request:
     topp: float
     max_tokens: int
     eos_ids: frozenset[int]
+    seed: int | None = None
     out: queue.Queue = field(default_factory=queue.Queue)
     produced: int = 0
     slot: int = -1
     finish_reason: str | None = None
     cancelled: threading.Event = field(default_factory=threading.Event)
+    # latency marks (time.monotonic): the serving-tier observability the
+    # reference's per-token console lines provide (dllama.cpp:82-87)
+    submitted_at: float = 0.0
+    first_token_at: float | None = None
+    finished_at: float | None = None
+
+    @property
+    def ttft_ms(self) -> float | None:
+        """Time to first token (includes queueing + prefill)."""
+        if self.first_token_at is None:
+            return None
+        return (self.first_token_at - self.submitted_at) * 1000.0
+
+    @property
+    def itl_ms(self) -> float | None:
+        """Mean inter-token latency after the first token."""
+        if self.finished_at is None or self.first_token_at is None or self.produced < 2:
+            return None
+        return (self.finished_at - self.first_token_at) * 1000.0 / (self.produced - 1)
 
     def tokens(self):
         """Blocking iterator over generated tokens (ends on EOS/budget/cancel)."""
@@ -57,6 +78,8 @@ class Scheduler:
         self.admit_timeout = admit_timeout
         self.pending: queue.Queue[Request] = queue.Queue()
         self.slots: dict[int, Request] = {}
+        self._completed: list[Request] = []  # ring of recent requests (metrics)
+        self._metrics_lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="dllama-scheduler", daemon=True)
@@ -64,12 +87,26 @@ class Scheduler:
 
     # ------------------------------------------------------------------- api
 
-    def submit(self, prompt, temperature, topp, max_tokens, eos_ids) -> Request:
+    def submit(self, prompt, temperature, topp, max_tokens, eos_ids,
+               seed: int | None = None) -> Request:
         req = Request(list(prompt), float(temperature), float(topp), int(max_tokens),
-                      frozenset(eos_ids))
+                      frozenset(eos_ids), seed=seed, submitted_at=time.monotonic())
         self.pending.put(req)
         self._wake.set()
         return req
+
+    def latency_summary(self) -> dict:
+        """Aggregate TTFT / inter-token latency over completed requests."""
+        with self._metrics_lock:
+            done = list(self._completed)
+        ttfts = [r.ttft_ms for r in done if r.ttft_ms is not None]
+        itls = [r.itl_ms for r in done if r.itl_ms is not None]
+        mean = lambda xs: sum(xs) / len(xs) if xs else None
+        return {
+            "completed": len(done),
+            "ttft_ms_mean": mean(ttfts),
+            "itl_ms_mean": mean(itls),
+        }
 
     def cancel(self, req: Request) -> None:
         req.cancelled.set()
@@ -88,10 +125,16 @@ class Scheduler:
             self.slots.pop(req.slot, None)
             req.slot = -1
         req.finish_reason = req.finish_reason or reason
+        req.finished_at = time.monotonic()
+        with self._metrics_lock:
+            self._completed.append(req)
+            del self._completed[:-256]  # bound the ring
         req.out.put(_END)
 
     def _emit(self, req: Request, token: int, row_at_emit: int) -> bool:
         """Queue one token; returns True when the request just finished."""
+        if req.first_token_at is None:
+            req.first_token_at = time.monotonic()
         req.out.put(int(token))
         req.produced += 1
         if token in req.eos_ids:
@@ -116,7 +159,8 @@ class Scheduler:
                 req.out.put(_END)
                 continue
             try:
-                first = self.engine.add(slot, req.prompt, req.temperature, req.topp)
+                first = self.engine.add(slot, req.prompt, req.temperature, req.topp,
+                                        seed=req.seed)
             except Exception as e:  # bad request (too long, …) — fail just this one
                 log.exception("prefill failed")
                 req.out.put(e)

@@ -124,3 +124,53 @@ def test_engine_sp_shard_map_end_to_end():
     np.testing.assert_allclose(got, ref_logits, atol=2e-4, rtol=1e-3)
     got_l2 = np.asarray(eng.decode_step(np.array([[11]])))
     np.testing.assert_allclose(got_l2, ref_l2, atol=2e-4, rtol=1e-3)
+
+
+@pytest.mark.parametrize("t,pos", [(8, 0), (8, 8), (16, 16)])
+def test_ring_cache_attention_matches_gqa(rng, t, pos):
+    """Sequence-sharded-query prefill over the rotating cache == full-cache
+    gqa_attention at arbitrary chunk positions (VERDICT r1 #6)."""
+    from dllama_tpu.parallel.ring_attention import ring_cache_attention
+
+    b, hq, hkv, d, s = 2, 8, 4, 16, 32
+    q = rng.standard_normal((b, t, hq, d)).astype(np.float32)
+    kc = rng.standard_normal((b, hkv, s, d)).astype(np.float32)
+    vc = rng.standard_normal((b, hkv, s, d)).astype(np.float32)
+    want = np.asarray(gqa_attention(jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc), jnp.int32(pos)))
+
+    mesh = make_mesh(MeshConfig(sp=4, tp=2))
+    got = jax.jit(
+        jax.shard_map(
+            lambda q, kc, vc, p: ring_cache_attention(q, kc, vc, p, axis_name="sp"),
+            mesh=mesh,
+            in_specs=(P(None, "sp", "tp", None), P(None, "tp", "sp", None), P(None, "tp", "sp", None), P()),
+            out_specs=P(None, "sp", "tp", None),
+        )
+    )(q, kc, vc, jnp.int32(pos))
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("sp,tp", [(2, 1), (4, 2), (8, 1)])
+def test_engine_sp_ring_prefill_long_prompt(sp, tp):
+    """e2e: a prompt longer than one sp shard's cache slice prefills through
+    the ring path (chunk width divisible by sp -> ring_cache_attention) and
+    matches single-device logits; decode then runs the LSE-merge path."""
+    cfg = LlamaConfig(
+        dim=128, hidden_dim=256, n_layers=2, n_heads=8, n_kv_heads=4, vocab_size=128, seq_len=64
+    )
+    params = random_params(cfg, seed=3, dtype=jnp.float32, quantize=False)
+    rng = np.random.default_rng(11)
+    # seq_len/sp <= 32 for sp>=2; prompt of 40 spans multiple shard slices
+    prompt = rng.integers(1, cfg.vocab_size, size=(1, 40)).astype(np.int32)
+
+    ref = InferenceEngine(cfg, params, cache_dtype=jnp.float32)
+    ref_logits = np.asarray(ref.prefill(prompt))
+    ref_l2 = np.asarray(ref.decode_step(np.array([[11]])))
+
+    mesh = make_mesh(MeshConfig(sp=sp, tp=tp))
+    sh = LlamaShardings(mesh, cfg)
+    eng = InferenceEngine(cfg, params, cache_dtype=jnp.float32, shardings=sh)
+    got = np.asarray(eng.prefill(prompt))
+    np.testing.assert_allclose(got, ref_logits, atol=2e-4, rtol=1e-3)
+    got_l2 = np.asarray(eng.decode_step(np.array([[11]])))
+    np.testing.assert_allclose(got_l2, ref_l2, atol=2e-4, rtol=1e-3)
