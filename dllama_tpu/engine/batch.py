@@ -48,6 +48,7 @@ class BatchEngine:
         shardings=None,  # parallel/sharding.LlamaShardings: multi-chip serving
         attn_impl: str = "auto",  # 'auto' | 'jnp' | 'flash' (same as InferenceEngine)
         sync: str = "bf16",  # 'bf16' | 'q80' quantized tp exchange (as InferenceEngine)
+        kernels: str = "auto",  # 'auto' | 'pallas' | 'xla' matmul backend
     ):
         from dllama_tpu.ops.layers import build_rope_cache
 
@@ -97,29 +98,38 @@ class BatchEngine:
             ):
                 attn_fn = partial(flash_gqa_attention, interpret=not on_tpu)
 
+        # same per-engine backend resolution as InferenceEngine (sharded => xla)
+        from dllama_tpu.ops.matmul import matmul as _matmul, resolve_backend
+
+        self.backend = resolve_backend(
+            None if kernels == "auto" else kernels, sharded=shardings is not None
+        )
+        mm = partial(_matmul, backend=self.backend)
+
         self._prefill_step = jax.jit(
-            partial(self._prefill_impl, cfg, attn_fn, self._col_fn), donate_argnums=(1,)
+            partial(self._prefill_impl, cfg, attn_fn, self._col_fn, mm), donate_argnums=(1,)
         )
         self._decode = jax.jit(
-            partial(self._decode_impl, cfg, attn_fn, self._col_fn),
+            partial(self._decode_impl, cfg, attn_fn, self._col_fn, mm),
             static_argnums=(8,), donate_argnums=(1,),
         )
 
     # ------------------------------------------------------------- jitted fns
 
     @staticmethod
-    def _prefill_impl(cfg, attn_fn, col_fn, params, cache, tokens, pos_vec, active, rope):
+    def _prefill_impl(cfg, attn_fn, col_fn, mm, params, cache, tokens, pos_vec, active, rope):
         logits, cache = forward(cfg, params, tokens, pos_vec, cache, rope, attn_fn,
-                                active=active, col_fn=col_fn)
+                                active=active, col_fn=col_fn, mm=mm, last_only=True)
         return logits[:, -1], cache
 
     @staticmethod
-    def _decode_impl(cfg, attn_fn, col_fn, params, cache, tokens, pos_vec, active, keys,
+    def _decode_impl(cfg, attn_fn, col_fn, mm, params, cache, tokens, pos_vec, active, keys,
                      temps, topps, n, rope):
         def body(carry, _):
             tok, cache, p, keys = carry
             logits, cache = forward(cfg, params, tok, p, cache, rope, attn_fn,
-                                    active=jnp.asarray(active), col_fn=col_fn)
+                                    active=jnp.asarray(active), col_fn=col_fn, mm=mm,
+                                    last_only=True)
             splits = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
             keys, subs = splits[:, 0], splits[:, 1]
             nxt = _sample_rows(logits[:, -1], subs, temps, topps)[:, None]

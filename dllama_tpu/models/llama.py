@@ -68,32 +68,51 @@ def _cache_update(cache, new, pos_base, active):
     return upd
 
 
-def _layer(cfg: LlamaConfig, x, lp, k_cache, v_cache, rope, pos_base, attn_fn, active=None,
-           col_fn=None):
-    col_fn = col_fn or matmul  # wo/w2 col-sharded matmuls; `--sync q80` swaps in
-    # the Q80-exchange shard_map (parallel/collectives.make_q80_col_matmul)
+from dllama_tpu.ops.quant import slice_leaf as _slice_layer
+
+
+def _layer(cfg: LlamaConfig, x, layers, li, k_cache, v_cache, rope, pos_base, attn_fn,
+           active=None, col_fn=None, mm=None):
+    """One decoder layer. `layers` is the full stacked params dict and `li`
+    the traced layer index — quantized weights are NOT sliced here: the matmul
+    dispatcher either DMA-indexes the stack (Pallas scalar prefetch) or slices
+    lazily (XLA path). Slicing stacked weights before a pallas_call would make
+    XLA materialize a full HBM copy of every weight, every layer, every token.
+    """
+    mm = mm or matmul
+    if col_fn is None:
+        colmm = mm  # wo/w2 col-sharded matmuls; `--sync q80` swaps in the
+        # Q80-exchange shard_map (parallel/collectives.make_q80_col_matmul)
+    else:
+        def colmm(h, w, layer=None):
+            return col_fn(h, _slice_layer(w, layer) if layer is not None else w)
     b, t, d = x.shape
     # --- attention block (reference "att" segment, llm.cpp:198-312)
-    h = rms_norm(x, lp["rms_att"], cfg.norm_epsilon)
-    q = matmul(h, lp["wq"]).reshape(b, t, cfg.n_heads, cfg.head_size)
-    k = matmul(h, lp["wk"]).reshape(b, t, cfg.n_kv_heads, cfg.head_size)
-    v = matmul(h, lp["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.head_size)
+    h = rms_norm(x, layers["rms_att"][li], cfg.norm_epsilon)
+    q = mm(h, layers["wq"], li).reshape(b, t, cfg.n_heads, cfg.head_size)
+    k = mm(h, layers["wk"], li).reshape(b, t, cfg.n_kv_heads, cfg.head_size)
+    v = mm(h, layers["wv"], li).reshape(b, t, cfg.n_kv_heads, cfg.head_size)
     q = apply_rope(q, rope)
     k = apply_rope(k, rope)
     k_cache = _cache_update(k_cache, k.transpose(0, 2, 1, 3), pos_base, active)
     v_cache = _cache_update(v_cache, v.transpose(0, 2, 1, 3), pos_base, active)
     att = attn_fn(q, k_cache, v_cache, pos_base).reshape(b, t, d)
-    x = x + col_fn(att, lp["wo"])
+    x = x + colmm(att, layers["wo"], li)
     # --- feed-forward block (reference "ff" segment, llm.cpp:314-385);
     # sparse-MoE variant when the header carries N_EXPERTS (llm.hpp:17-18 —
     # a key the reference parses but never executes)
-    h = rms_norm(x, lp["rms_ffn"], cfg.norm_epsilon)
-    if "moe_gate" in lp:
-        x = x + moe_ffn(cfg, h, lp["moe_gate"], lp["moe_w1"], lp["moe_w2"], lp["moe_w3"])
+    h = rms_norm(x, layers["rms_ffn"][li], cfg.norm_epsilon)
+    if "moe_gate" in layers:
+        x = x + moe_ffn(
+            cfg, h, layers["moe_gate"][li],
+            _slice_layer(layers["moe_w1"], li),
+            _slice_layer(layers["moe_w2"], li),
+            _slice_layer(layers["moe_w3"], li),
+        )
     else:
-        gate = activation(matmul(h, lp["w1"]).astype(jnp.float32), cfg.hidden_act).astype(x.dtype)
-        up = matmul(h, lp["w3"])
-        x = x + col_fn(gate * up, lp["w2"])
+        gate = activation(mm(h, layers["w1"], li).astype(jnp.float32), cfg.hidden_act).astype(x.dtype)
+        up = mm(h, layers["w3"], li)
+        x = x + colmm(gate * up, layers["w2"], li)
     return x, k_cache, v_cache
 
 
@@ -109,24 +128,30 @@ def run_layers(
     active: jax.Array | None = None,  # [B] bool: rows allowed to write cache
     unroll: int | bool = 1,
     col_fn=None,  # wo/w2 matmul override (Q80 quantized exchange)
+    mm=None,  # quantized-matmul fn (x, w, layer) -> out; default ops.matmul
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Scan the decoder layers (any contiguous stack — the full model, or one
     pipeline stage's slice). Returns (x, k_cache, v_cache).
 
-    `unroll`: passed to lax.scan — unroll=True trades compile time for letting
-    XLA see every layer's weight slice statically (no per-iteration
-    dynamic-slice of the stacked params; matters when slices feed Pallas
-    custom calls that XLA would otherwise copy for)."""
+    The scan carries only the layer INDEX (plus the per-layer cache slices) —
+    the stacked weights stay closed-over and un-sliced, so the Pallas kernels
+    can DMA-index them with zero copies (ops/pallas/q40_matmul.py docstring).
+
+    `unroll`: passed to lax.scan — trades compile time for cross-layer
+    scheduling freedom."""
     attn_fn = attn_fn or gqa_attention
+    n_layers = k_cache.shape[0]
 
     def scan_fn(carry, xs):
         x = carry
-        lp, kc, vc = xs
-        x, kc, vc = _layer(cfg, x, lp, kc, vc, rope, pos_base, attn_fn, active, col_fn)
+        li, kc, vc = xs
+        x, kc, vc = _layer(cfg, x, layer_params, li, kc, vc, rope, pos_base, attn_fn,
+                           active, col_fn, mm)
         return x, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(
-        scan_fn, x, (layer_params, k_cache, v_cache), unroll=unroll
+        scan_fn, x, (jnp.arange(n_layers, dtype=jnp.int32), k_cache, v_cache),
+        unroll=unroll,
     )
     return x, k_new, v_new
 
@@ -144,12 +169,20 @@ def forward(
     active: jax.Array | None = None,  # [B] bool cache-write mask (batch mode)
     unroll: int | bool = 1,  # lax.scan unroll over layers (see run_layers)
     col_fn=None,  # wo/w2 matmul override (Q80 quantized exchange)
+    mm=None,  # quantized-matmul fn (x, w, layer) -> out; default ops.matmul
+    last_only: bool = False,  # project logits for the last position only
 ) -> tuple[jax.Array, KVCache]:
     """Returns (logits f32 [B, T, vocab], updated cache).
 
     pos_base may be a scalar (all rows at one position — the single-sequence
     fast path) or an i32[B] vector giving each row its own position
-    (continuous batching; rope rows are then gathered per row)."""
+    (continuous batching; rope rows are then gathered per row).
+
+    ``last_only=True`` slices x to the final position before the lm-head
+    matmul — prefill only needs next-token logits, and XLA cannot DCE rows of
+    a dot, so without this a 128-token chunk would pay 128x the lm-head cost
+    (the reference has the same shape: logits only materialize for the last
+    token of a batch, dllama.cpp:69-88)."""
     x = params["embedding"][tokens]  # [B, T, D]
     t = tokens.shape[1]
     pos_base = jnp.asarray(pos_base, jnp.int32)
@@ -160,10 +193,12 @@ def forward(
         rope = jax.lax.dynamic_slice_in_dim(rope_cache, pos_base, t, axis=0)
     x, k_new, v_new = run_layers(
         cfg, params["layers"], x, pos_base, cache.k, cache.v, rope, attn_fn, active,
-        unroll=unroll, col_fn=col_fn,
+        unroll=unroll, col_fn=col_fn, mm=mm,
     )
+    if last_only:
+        x = x[:, -1:]
     x = rms_norm(x, params["final_norm"], cfg.norm_epsilon)
-    logits = matmul(x, params["wcls"]).astype(jnp.float32)
+    logits = (mm or matmul)(x, params["wcls"]).astype(jnp.float32)
     return logits, KVCache(k_new, v_new)
 
 

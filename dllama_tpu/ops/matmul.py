@@ -1,14 +1,24 @@
-"""Quantized matmul dispatch: Pallas TPU kernel or XLA fallback.
+"""Quantized matmul dispatch: Pallas TPU kernels or XLA fallback.
 
 The reference routes each matmul through a per-(op, quant-triple) kernel table
 (nn-cpu-ops.cpp:1296-1355, llamafile sgemm for batch>1). Here the "dispatch
 table" is two backends:
 
 * ``xla``    — dequantize-then-dot in one jit; XLA fuses the dequant into the
-               matmul epilogue. Correctness reference, and the only path on CPU.
+               matmul epilogue. Correctness reference, and the only path on
+               CPU and on sharded (GSPMD) engines: ``pallas_call`` has no
+               partitioning rule, so under a mesh the Pallas path would
+               all-gather sharded weights per call.
 * ``pallas`` — fused Q40 dequant-matmul kernels (ops/pallas/q40_matmul.py)
-               that stream packed nibbles HBM->VMEM, i.e. ~3.5x less HBM
-               traffic than bf16 weights — the decode hot loop.
+               that stream packed nibbles HBM->VMEM (~3x less HBM traffic
+               than bf16 weights) and address layer-stacked weights by
+               scalar-prefetch index (no per-layer slice copies). Inside, a
+               decode-shaped (m<=16) and a prefill-shaped (m>16) kernel split
+               mirrors the reference's GEMV/sgemm tiering.
+
+Backend resolution: an explicit ``backend=`` argument wins (the engine passes
+one resolved at construction — per-engine, not global), then the module-level
+``BACKEND`` switch (CLI ``--kernels``), then platform auto-detection.
 """
 
 from __future__ import annotations
@@ -16,36 +26,48 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from dllama_tpu.ops.quant import QTensor
+from dllama_tpu.ops.quant import QTensor, slice_leaf
 
-# module-level backend switch; engine sets this once at startup.
+# module-level backend switch; the CLI sets this once at startup.
 BACKEND = "auto"
 
 
-def _use_pallas() -> bool:
-    if BACKEND == "xla":
-        return False
+def _platform() -> str:
     try:
-        platform = jax.devices()[0].platform
+        return jax.devices()[0].platform
     except RuntimeError:
-        return False
-    if BACKEND == "pallas":
-        return True
-    return platform == "tpu"
+        return "cpu"
 
 
-def matmul(x: jax.Array, w) -> jax.Array:
-    """``x @ w`` where ``w`` is a QTensor or a dense [k, n] array.
+def resolve_backend(backend: str | None = None, sharded: bool = False) -> str:
+    """'pallas' or 'xla'. Sharded engines force 'xla' unless explicitly
+    overridden (pallas_call under GSPMD would gather the sharded weights)."""
+    b = backend or BACKEND
+    if b == "auto":
+        if sharded:
+            return "xla"
+        return "pallas" if _platform() == "tpu" else "xla"
+    return b
+
+
+def matmul(x: jax.Array, w, layer=None, backend: str | None = None) -> jax.Array:
+    """``x @ w`` (or ``x @ w[layer]``) where ``w`` is a QTensor or dense array.
 
     x: [..., k] activations (bf16/f32); returns [..., n] in x.dtype.
+    ``layer``: traced index into a layer-stacked weight ([L, k, n] logical) —
+    the Pallas path indexes the stack via DMA, the XLA path slices it.
     """
     if isinstance(w, QTensor):
-        if _use_pallas():
+        if resolve_backend(backend) == "pallas":
             from dllama_tpu.ops.pallas.q40_matmul import q40_matmul, supported
 
             if supported(x.shape, w):
-                return q40_matmul(x, w)
+                return q40_matmul(x, w, layer, interpret=_platform() != "tpu")
+        if layer is not None and w.packed.ndim == 3:
+            w = slice_leaf(w, layer)
         wd = w.dequantize(x.dtype)
     else:
+        if layer is not None and jnp.ndim(w) == 3:
+            w = slice_leaf(w, layer)
         wd = w.astype(x.dtype)
     return jnp.dot(x, wd, preferred_element_type=jnp.float32).astype(x.dtype)

@@ -1,14 +1,43 @@
-"""Fused Q40 dequant-matmul Pallas kernel — the decode hot loop.
+"""Fused Q40 dequant-matmul Pallas kernels — the decode/prefill hot loop.
 
-The reference's equivalent is matmul_Q80_Q40 (nn-cpu-ops.cpp:225-446) plus
-llamafile sgemm for prefill; on TPU the win is HBM bandwidth: the kernel
-streams the *packed* 4-bit weights (0.56 bytes/weight incl. scales) from HBM
-into VMEM and dequantizes on-chip right before the MXU dot — ~3.5x less HBM
-traffic than bf16 weights, which is the whole game for batch=1 decode.
+The reference's equivalent is matmul_Q80_Q40 (nn-cpu-ops.cpp:225-446) for
+decode plus llamafile sgemm (sgemm.cpp:819-1010) for prefill; on TPU the win
+is HBM bandwidth: the kernel streams the *packed* 4-bit weights (0.69
+bytes/weight incl. f32 scales) from HBM into VMEM and dequantizes on-chip
+right before the MXU dot — ~3x less HBM traffic than bf16 weights, which is
+the whole game for small-batch decode.
 
-Layout (see ops/quant.QTensor): ``packed: u8[k/2, n]`` where packed row
+Two TPU-specific design points beyond the reference's scheme:
+
+1. **Layer-stacked weights with scalar-prefetch indexing.** The model keeps
+   every layer's weights stacked as one ``[L, k/2, n]`` array (the scanned
+   forward needs that layout). Feeding ``lax.dynamic_slice`` output to a
+   custom call would make XLA materialize a full HBM copy of every weight,
+   every layer, every token — tripling decode traffic. Instead the kernels
+   take the whole stacked array plus the layer index as a scalar-prefetch
+   argument; the Pallas DMA pipeline indexes the layer directly in HBM
+   (``PrefetchScalarGridSpec``), so no copy ever exists.
+
+2. **Two dequant schemes, split by batch size** (the reference's decode
+   GEMV / prefill sgemm split, nn-cpu-ops.cpp:1003-1019):
+
+   * ``deq`` (m > 16): classic in-kernel dequant — unpack nibbles, one
+     fused multiply per weight, bf16 dot. Dequant cost amortizes over the m
+     rows, so prefill is MXU-bound.
+   * ``blockdot`` (m <= 16): decode is HBM/VPU-bound and per-element dequant
+     arithmetic is the bottleneck, so this kernel never builds the dequantized
+     matrix. Nibbles become *exact* signed codes ``q - 8`` via an
+     exponent-trick bitcast (OR into the mantissa of 2^23 where the float ulp
+     is 1, subtract 2^23 + 8 — exact by Sterbenz), the codes are lossless in
+     bf16 (|q-8| <= 8), the MXU computes per-block partial dots
+     y[kb] = x_kb @ codes_kb, and the f32 block scales touch only the tiny
+     [k/32, m, n-tile] partials:  out = sum_kb s[kb] * y[kb].
+     Per-weight VPU work drops to the ~2-op unpack; the scale math is
+     O(m/32) per weight element and the per-element dequant multiply is gone.
+
+Layout (see ops/quant.QTensor): ``packed: u8[(L,) k/2, n]`` where packed row
 ``16*b + j`` holds codes for input dims ``32*b + j`` (low nibble) and
-``32*b + j + 16`` (high nibble); ``scales: f16[k/32, n]``.
+``32*b + j + 16`` (high nibble); ``scales: f32[(L,) k/32, n]``.
 
 Grid is (m_tiles, n_tiles, k_tiles) with k innermost: the f32 accumulator
 block stays VMEM-resident across the k sweep and is written back once per
@@ -27,21 +56,42 @@ from jax.experimental.pallas import tpu as pltpu
 from dllama_tpu.ops.pallas.tiling import pick_tile as _pick_tile
 from dllama_tpu.ops.quant import Q_BLOCK, QTensor
 
+# f32 bit pattern of 2^23 = 8388608.0; mantissa ulp there is exactly 1, so
+# OR-ing a nibble q into the low bits gives the exact float 2^23 + q, and
+# subtracting (2^23 + 8) yields the exact signed code q - 8 (the subtraction
+# of nearby floats is exact by Sterbenz' lemma) — int->float conversion and
+# the -8 offset in two cheap VPU ops, no convert instruction.
+_EXP_BITS = 0x4B000000
+_V_OFFSET = 8388608.0 + 8.0
 
-def _kernel(x_ref, packed_ref, scales_ref, out_ref, acc_ref, *, tk: int, tn: int):
+# kernel-style override for benchmarks: 'auto' | 'deq' | 'blockdot'
+STYLE = "auto"
+
+
+def _unpack_codes(packed_block, tk: int, tn: int):
+    """u8[tk/2, tn] nibbles -> f32[tk/32, 32, tn] of exact codes q - 8."""
+    p = packed_block.astype(jnp.int32)
+    lo = (p & 0x0F) | _EXP_BITS
+    hi = (p >> 4) | _EXP_BITS
+    nb = tk // Q_BLOCK
+    half = Q_BLOCK // 2
+    codes = jnp.concatenate(
+        [lo.reshape(nb, half, tn), hi.reshape(nb, half, tn)], axis=1
+    )
+    return jax.lax.bitcast_convert_type(codes, jnp.float32) - _V_OFFSET
+
+
+def _deq_kernel(layer_ref, x_ref, packed_ref, scales_ref, out_ref, acc_ref, *, tk, tn):
+    del layer_ref  # consumed by the index maps
     kb = pl.program_id(2)
 
     @pl.when(kb == 0)
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # unpack nibbles -> codes in [-8, 7] laid out [tk//32, 32, tn]
-    p = packed_ref[:].astype(jnp.int32).reshape(tk // Q_BLOCK, Q_BLOCK // 2, tn)
-    lo = (p & 0x0F) - 8
-    hi = (p >> 4) - 8
-    codes = jnp.concatenate([lo, hi], axis=1)  # [tk//32, 32, tn]
-    s = scales_ref[:].astype(jnp.float32)[:, None, :]
-    w = (codes.astype(jnp.float32) * s).reshape(tk, tn).astype(x_ref.dtype)
+    c = _unpack_codes(packed_ref[:], tk, tn)  # [nb, 32, tn] exact q - 8
+    s = scales_ref[:][:, None, :]
+    w = (c * s).reshape(tk, tn).astype(x_ref.dtype)
     acc_ref[:] += jnp.dot(x_ref[:], w, preferred_element_type=jnp.float32)
 
     @pl.when(kb == pl.num_programs(2) - 1)
@@ -49,58 +99,157 @@ def _kernel(x_ref, packed_ref, scales_ref, out_ref, acc_ref, *, tk: int, tn: int
         out_ref[:] = acc_ref[:]
 
 
+def _blockdot_kernel(
+    layer_ref, xb_ref, packed_ref, scales_ref, out_ref, acc_ref, *, tk, tn
+):
+    del layer_ref
+    kb = pl.program_id(1)
+
+    @pl.when(kb == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # codes q-8 are EXACT in the activation dtype (|q-8| <= 8, integral —
+    # lossless even in bf16), so the MXU block-dot on raw codes is exact; the
+    # f32 scales touch only the [nb, m, tn] partials — per-weight VPU work is
+    # just the unpack, no per-element dequant multiply.
+    c = _unpack_codes(packed_ref[:], tk, tn).astype(xb_ref.dtype)  # [nb, 32, tn]
+    y = jax.lax.dot_general(
+        xb_ref[:], c, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+    )  # [nb, m, tn]
+    s = scales_ref[:][:, None, :]  # [nb, 1, tn]
+    acc_ref[:] += jnp.sum(y * s, axis=0)
+
+    @pl.when(kb == pl.num_programs(1) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def q40_matmul_2d(x: jax.Array, packed: jax.Array, scales: jax.Array, *, interpret: bool = False) -> jax.Array:
-    """x[m, k] @ dequant(packed, scales)[k, n] -> f32[m, n]."""
+def _deq_call(layer, x, packed, scales, *, interpret: bool = False):
+    """x[m, k] @ dequant(packed[layer], scales[layer]) -> f32[m, n]."""
     m, k = x.shape
-    n = packed.shape[1]
+    n = packed.shape[-1]
     tm = _pick_tile(m, (256, 128, 64, 32, 16, 8))
     tn = _pick_tile(n, (512, 256, 128))
     tk = _pick_tile(k, (512, 256, 128, 64, 32))
-    assert k % Q_BLOCK == 0 and tk % Q_BLOCK == 0, (k, tk)
-
     grid = (m // tm, n // tn, k // tk)
-    return pl.pallas_call(
-        functools.partial(_kernel, tk=tk, tn=tn),
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((tm, tk), lambda i, j, kb: (i, kb)),
-            pl.BlockSpec((tk // 2, tn), lambda i, j, kb: (kb, j)),
-            pl.BlockSpec((tk // Q_BLOCK, tn), lambda i, j, kb: (kb, j)),
+            pl.BlockSpec((tm, tk), lambda i, j, kb, L: (i, kb)),
+            pl.BlockSpec((None, tk // 2, tn), lambda i, j, kb, L: (L[0], kb, j)),
+            pl.BlockSpec((None, tk // Q_BLOCK, tn), lambda i, j, kb, L: (L[0], kb, j)),
         ],
-        out_specs=pl.BlockSpec((tm, tn), lambda i, j, kb: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        out_specs=pl.BlockSpec((tm, tn), lambda i, j, kb, L: (i, j)),
         scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_deq_kernel, tk=tk, tn=tn),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
             flops=2 * m * n * k,
-            bytes_accessed=m * k * x.dtype.itemsize + k * n // 2 + (k // Q_BLOCK) * n * 2 + m * n * 4,
+            bytes_accessed=m * k * x.dtype.itemsize
+            + k * n // 2
+            + (k // Q_BLOCK) * n * 4
+            + m * n * 4,
             transcendentals=0,
         ),
         interpret=interpret,
-    )(x, packed, scales)
+    )(layer, x, packed, scales)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _blockdot_call(layer, x, packed, scales, *, interpret: bool = False):
+    """Decode-shaped path: x[m<=16, k] against stacked Q40 weights."""
+    m, k = x.shape
+    n = packed.shape[-1]
+    nb = k // Q_BLOCK
+    tn = _pick_tile(n, (512, 256, 128))
+    tk = _pick_tile(k, (2048, 1024, 512, 256, 128, 64, 32))
+    grid = (n // tn, k // tk)
+    # pre-shaped outside the kernel: Mosaic can't split the lane dim in-kernel
+    xb = x.reshape(m, nb, Q_BLOCK).transpose(1, 0, 2)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tk // Q_BLOCK, m, Q_BLOCK), lambda j, kb, L: (kb, 0, 0)),
+            pl.BlockSpec((None, tk // 2, tn), lambda j, kb, L: (L[0], kb, j)),
+            pl.BlockSpec((None, tk // Q_BLOCK, tn), lambda j, kb, L: (L[0], kb, j)),
+        ],
+        out_specs=pl.BlockSpec((m, tn), lambda j, kb, L: (0, j)),
+        scratch_shapes=[pltpu.VMEM((m, tn), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_blockdot_kernel, tk=tk, tn=tn),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * n * k,
+            bytes_accessed=m * k * 4 + k * n // 2 + (k // Q_BLOCK) * n * 4 + m * n * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(layer, xb, packed, scales)
 
 
 def supported(x_shape: tuple[int, ...], w: QTensor) -> bool:
     """Tileability check used by the ops.matmul dispatcher."""
-    k, n = w.shape
+    k, n = w.shape[-2], w.shape[-1]
     return k % Q_BLOCK == 0 and n % 128 == 0 and k >= 128
 
 
-def q40_matmul(x: jax.Array, w: QTensor, *, interpret: bool = False) -> jax.Array:
-    """``x @ w`` for any leading batch dims; returns x.dtype like the XLA path."""
+def q40_matmul(
+    x: jax.Array, w: QTensor, layer=None, *, interpret: bool = False
+) -> jax.Array:
+    """``x @ w[layer]`` for any leading batch dims; returns x.dtype.
+
+    ``w`` may be a 2-D weight (``layer=None``) or a layer-stacked
+    ``[L, k, n]`` weight addressed by the traced scalar ``layer`` — the
+    stacked form is indexed by the DMA engine, never sliced by XLA.
+    """
     *lead, k = x.shape
     m = 1
     for d in lead:
         m *= d
+    if w.packed.ndim == 2:
+        packed, scales = w.packed[None], w.scales[None]
+        layer = 0
+    else:
+        packed, scales = w.packed, w.scales
+        assert layer is not None, "stacked QTensor needs a layer index"
+    n = packed.shape[-1]
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
     x2 = x.reshape(m, k)
     # pad rows up to the f32 sublane (8) so tiny decode batches still tile
     pad = (-m) % 8
     if pad:
         x2 = jnp.pad(x2, ((0, pad), (0, 0)))
-    out = q40_matmul_2d(x2, w.packed, w.scales, interpret=interpret)
+    mp = m + pad
+    style = STYLE
+    if style == "auto":
+        style = "blockdot" if mp <= 16 else "deq"
+    if style == "blockdot":
+        out = _blockdot_call(layer_arr, x2, packed, scales, interpret=interpret)
+    else:
+        out = _deq_call(layer_arr, x2, packed, scales, interpret=interpret)
     if pad:
         out = out[:m]
-    return out.reshape(*lead, w.shape[1]).astype(x.dtype)
+    return out.reshape(*lead, n).astype(x.dtype)
+
+
+def q40_matmul_2d(
+    x: jax.Array, packed: jax.Array, scales: jax.Array, *, interpret: bool = False
+) -> jax.Array:
+    """Back-compat wrapper: x[m, k] @ dequant(packed, scales) -> f32[m, n]."""
+    layer = jnp.zeros((1,), jnp.int32)
+    return _deq_call(layer, x, packed[None], scales[None], interpret=interpret)

@@ -238,6 +238,18 @@ class QTensor:
         return w.reshape(*lead, k, n).astype(dtype)
 
 
+def slice_leaf(w, li):
+    """One layer's slice of a stacked weight leaf (QTensor or dense array).
+
+    The single place that knows how to index a stacked QTensor — callers that
+    must materialize a per-layer slice (XLA matmul path, q80 col_fn, MoE
+    expert stacks) go through here so a future QTensor layout change has one
+    site to update."""
+    if isinstance(w, QTensor):
+        return QTensor(w.packed[li], w.scales[li])
+    return w[li]
+
+
 def quantize_q80_jnp(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     """On-device Q80 quantize of activations along the last dim.
 

@@ -50,12 +50,12 @@ def _shift_right(x: jax.Array, pp: int) -> jax.Array:
     return jax.lax.ppermute(x, "pp", [(i, i + 1) for i in range(pp - 1)])
 
 
-def _stage_body(cfg: LlamaConfig, attn_fn, layers, x, pos, k, v, rope):
-    x, k, v = run_layers(cfg, layers, x, pos, k, v, rope, attn_fn)
+def _stage_body(cfg: LlamaConfig, attn_fn, mm, layers, x, pos, k, v, rope):
+    x, k, v = run_layers(cfg, layers, x, pos, k, v, rope, attn_fn, mm=mm)
     return x, k, v
 
 
-def make_pp_forward(cfg: LlamaConfig, mesh: Mesh, n_micro: int = 1, attn_fn=None):
+def make_pp_forward(cfg: LlamaConfig, mesh: Mesh, n_micro: int = 1, attn_fn=None, mm=None):
     """Build `fn(params, tokens, pos, cache, rope_cache) -> (logits, cache)`.
 
     params: the standard stacked pytree, with every `layers` leaf and the
@@ -110,7 +110,7 @@ def make_pp_forward(cfg: LlamaConfig, mesh: Mesh, n_micro: int = 1, attn_fn=None
                 # batch-slice of this stage's cache for the in-flight microbatch
                 k_mb = jax.lax.dynamic_slice_in_dim(k_all, m_in * mbs, mbs, axis=1)
                 v_mb = jax.lax.dynamic_slice_in_dim(v_all, m_in * mbs, mbs, axis=1)
-                y, k_new, v_new = _stage_body(cfg, attn_fn, layers, x, pos, k_mb, v_mb, rope_rows)
+                y, k_new, v_new = _stage_body(cfg, attn_fn, mm, layers, x, pos, k_mb, v_mb, rope_rows)
                 # bubble steps must not touch the cache
                 k_upd = jax.lax.dynamic_update_slice_in_dim(k_all, k_new, m_in * mbs, axis=1)
                 v_upd = jax.lax.dynamic_update_slice_in_dim(v_all, v_new, m_in * mbs, axis=1)
@@ -127,7 +127,7 @@ def make_pp_forward(cfg: LlamaConfig, mesh: Mesh, n_micro: int = 1, attn_fn=None
                 x = _shift_right(y, pp)
 
             h = rms_norm(out.reshape(b, t, cfg.dim), final_norm, cfg.norm_epsilon)
-            logits = matmul(h, wcls).astype(jnp.float32)
+            logits = (mm or matmul)(h, wcls).astype(jnp.float32)
             # only the last stage holds real logits; broadcast via masked psum
             logits = jax.lax.psum(
                 jnp.where(stage == pp - 1, logits, jnp.zeros_like(logits)), "pp"

@@ -1,0 +1,184 @@
+"""Microbench harness for Q40 matmul kernel variants on the real TPU.
+
+Usage: python experiments/kbench.py [variant ...]
+Measures achieved HBM GB/s (packed+scales bytes) for decode (m=8) and
+prefill (m=128) shapes of the 1B preset.
+"""
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dllama_tpu.ops.quant import Q_BLOCK, QTensor
+from dllama_tpu.ops.pallas.q40_matmul import q40_matmul_2d as current_kernel
+from dllama_tpu.ops.pallas.tiling import pick_tile as _pick_tile
+
+
+# ---------------------------------------------------------------- variant B
+# u8 unpack kept narrow, dequant via fma (w = f*s - 8s), f32 dot (no bf16 cast)
+def _kernel_b(x_ref, packed_ref, scales_ref, out_ref, acc_ref, *, tk, tn):
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    p = packed_ref[:].astype(jnp.int32)  # [tk/2, tn]
+    lo = (p & 0x0F)
+    hi = (p >> 4)
+    codes = jnp.concatenate(
+        [lo.reshape(tk // Q_BLOCK, Q_BLOCK // 2, tn), hi.reshape(tk // Q_BLOCK, Q_BLOCK // 2, tn)],
+        axis=1,
+    )  # i32 [tk/32, 32, tn]
+    s = scales_ref[:].astype(jnp.float32)[:, None, :]
+    f = codes.astype(jnp.float32)
+    w = (f * s - 8.0 * s).reshape(tk, tn)
+    acc_ref[:] += jnp.dot(x_ref[:].astype(jnp.float32), w, preferred_element_type=jnp.float32)
+
+    @pl.when(kb == pl.num_programs(2) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+# ---------------------------------------------------------------- variant C
+# like B but scale applied after the per-block dot (block-diag batched dot)
+def _kernel_c(x_ref, packed_ref, scales_ref, out_ref, acc_ref, *, tk, tn):
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    p = packed_ref[:].astype(jnp.int32)
+    lo = (p & 0x0F)
+    hi = (p >> 4)
+    nb = tk // Q_BLOCK
+    codes = jnp.concatenate(
+        [lo.reshape(nb, Q_BLOCK // 2, tn), hi.reshape(nb, Q_BLOCK // 2, tn)], axis=1
+    ).astype(jnp.float32).astype(jnp.bfloat16)  # [nb, 32, tn]
+    m = x_ref.shape[0]
+    xb = x_ref[:].reshape(m, nb, Q_BLOCK).transpose(1, 0, 2).astype(jnp.bfloat16)  # [nb, m, 32]
+    y = jax.lax.dot_general(
+        xb, codes, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+    )  # [nb, m, tn]
+    s = scales_ref[:].astype(jnp.float32)  # [nb, tn]
+    y = y - 8.0 * jnp.sum(xb.astype(jnp.float32), axis=2, keepdims=True)
+    acc_ref[:] += jnp.sum(y * s[:, None, :], axis=0)
+
+    @pl.when(kb == pl.num_programs(2) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+# ---------------------------------------------------------------- variant D
+# bf16 weights materialized (roofline reference for unquantized): plain dot
+def _kernel_d(x_ref, w_ref, out_ref, acc_ref, *, tk, tn):
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(x_ref[:], w_ref[:], preferred_element_type=jnp.float32)
+
+    @pl.when(kb == pl.num_programs(2) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+def make_call(kernel, m, k, n, *, tiles=None, bf16=False):
+    tm = _pick_tile(m, (256, 128, 64, 32, 16, 8))
+    tn, tk = tiles or (_pick_tile(n, (512, 256, 128)), _pick_tile(k, (512, 256, 128, 64, 32)))
+    grid = (m // tm, n // tn, k // tk)
+    if bf16:
+        in_specs = [
+            pl.BlockSpec((tm, tk), lambda i, j, kb: (i, kb)),
+            pl.BlockSpec((tk, tn), lambda i, j, kb: (kb, j)),
+        ]
+    else:
+        in_specs = [
+            pl.BlockSpec((tm, tk), lambda i, j, kb: (i, kb)),
+            pl.BlockSpec((tk // 2, tn), lambda i, j, kb: (kb, j)),
+            pl.BlockSpec((tk // Q_BLOCK, tn), lambda i, j, kb: (kb, j)),
+        ]
+    return pl.pallas_call(
+        functools.partial(kernel, tk=tk, tn=tn),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((tm, tn), lambda i, j, kb: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )
+
+
+def bench(fn, args, iters=30):
+    """Each iteration gets a DISTINCT x buffer (the tunnel appears to cache
+    results for identical (executable, args) pairs); dispatch is async with a
+    single block at the end."""
+    x, *rest = args
+    jfn = jax.jit(fn)
+    xs = [x + jnp.float32(i).astype(x.dtype) for i in range(iters)]
+    jax.block_until_ready(xs)
+    out = jfn(xs[0], *rest)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    outs = [jfn(xi, *rest) for xi in xs]
+    jax.block_until_ready(outs)
+    return (time.perf_counter() - t0) / iters
+
+
+SHAPES = {
+    "wq": (2048, 2048),
+    "w1": (2048, 8192),
+    "w2": (8192, 2048),
+    "wcls": (2048, 128256),
+}
+
+
+def main():
+    # argv: m shape variant [variant...]
+    m = int(sys.argv[1])
+    label = sys.argv[2]
+    variants = sys.argv[3:] or ["A", "B", "D", "E"]
+    k, n = SHAPES[label]
+    rng = np.random.default_rng(0)
+    w = QTensor.quantize((rng.standard_normal((k, n)) * 0.02).astype(np.float32))
+    x = jnp.asarray(rng.standard_normal((m, k)), jnp.bfloat16)
+    qbytes = k * n // 2 + (k // Q_BLOCK) * n * 4  # packed + f32 scales
+    rows = []
+    for v in variants:
+        if v == "A":
+            t = bench(lambda x, p, s: current_kernel(x, p, s), (x, w.packed, w.scales))
+            rows.append(("A current", t, qbytes))
+        elif v == "B":
+            call = make_call(_kernel_b, m, k, n)
+            t = bench(call, (x, w.packed, w.scales))
+            rows.append(("B fma-f32", t, qbytes))
+        elif v == "D":
+            wb = w.dequantize(jnp.bfloat16)
+            call = make_call(_kernel_d, m, k, n, bf16=True)
+            t = bench(call, (x, wb))
+            rows.append(("D bf16-ref", t, k * n * 2))
+        elif v == "E":
+            t = bench(
+                lambda x, w: jnp.dot(x, w.dequantize(jnp.bfloat16), preferred_element_type=jnp.float32),
+                (x, w),
+            )
+            rows.append(("E xla-deq", t, qbytes))
+    out = f"m={m} {label}: "
+    for name, t, nb in rows:
+        out += f"{name}={t*1e6:.0f}us({nb/t/1e9:.0f}GB/s) "
+    print(out)
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()
