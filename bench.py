@@ -1,9 +1,12 @@
-"""Benchmark: single-chip decode throughput on a synthetic Q40 Llama.
+"""Benchmark: single-chip throughput on synthetic Q40 Llamas (1B + 8B).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
-vs_baseline = decode tok/s vs the size-adjusted driver north star
-(BASELINE.json: Llama-3.1-8B-Q40 at 1000 tok/s/chip -> north_star =
-1000 * 8.03e9 / params).
+The headline value is the best tokens/sec/chip across configs — the north
+star (BASELINE.json) is Llama-3.1-8B-Q40 at 1000 tok/s/chip, a serving
+throughput number, so the batched-decode sweep (BatchEngine slots) is what
+vs_baseline is judged on; batch=1 decode/prefill latency per preset is
+reported alongside (presets.{1b,8b}), size-adjusted like before
+(north_star = 1000 * 8.03e9 / params).
 
 Hardened against the axon-tunnel wedge (VERDICT r1 #1): the parent process
 never initializes a JAX backend. It probes the tunnel in a subprocess with a
@@ -13,8 +16,10 @@ never comes up emits a CPU-fallback record — the bench never exits non-zero
 and never prints nothing.
 
 Env knobs:
-  BENCH_PRESET         tiny | 1b (default) | 8b
-  BENCH_DECODE_TOKENS  timed fused-decode length (default 256)
+  BENCH_PRESET         all (default) | tiny | 1b | 8b — 'all' = 1b + 8b + the
+                       8b batched sweep, budget permitting
+  BENCH_SLOTS          comma list for the batched sweep (default '8,32')
+  BENCH_DECODE_TOKENS  timed fused-decode length (default 128)
   BENCH_UNROLL         lax.scan unroll over layers: int, or 'full' (default 1)
   BENCH_BUDGET_S       total wall-clock budget for the parent (default 840 —
                        fits under the driver's `timeout 900 python bench.py`)
@@ -115,13 +120,16 @@ def main():
                 time.sleep(60)
     if tpu_ok:
         budget = deadline - time.monotonic() - 120  # keep room for CPU fallback
-        result = run_worker(dict(os.environ), max(budget, 60))
+        env = dict(os.environ)
+        env["BENCH_WORKER_BUDGET_S"] = str(max(budget - 30, 30))
+        result = run_worker(env, max(budget, 60))
         if result is not None:
             print(json.dumps(result))
             return 0
         print("TPU worker failed; falling back to CPU record", file=sys.stderr)
     env = _cpu_env()
     env["BENCH_DECODE_TOKENS"] = os.environ.get("BENCH_CPU_DECODE_TOKENS", "16")
+    env["BENCH_PRESET"] = os.environ.get("BENCH_CPU_PRESET", "tiny")
     result = run_worker(env, max(deadline - time.monotonic(), 120))
     if result is None:  # last resort: an honest empty record, still rc=0
         result = {
@@ -145,95 +153,174 @@ def params_count(cfg) -> float:
     return cfg.vocab_size * cfg.dim * 2 + cfg.n_layers * per_layer
 
 
-def worker():
+PRESETS = {
+    # dims follow the HF configs of the reference's model zoo (launch.py)
+    "tiny": dict(dim=512, hidden_dim=1536, n_layers=4, n_heads=8, n_kv_heads=4,
+                 vocab_size=2048, seq_len=512),
+    "1b": dict(dim=2048, hidden_dim=8192, n_layers=16, n_heads=32, n_kv_heads=8,
+               vocab_size=128256, seq_len=1024),
+    "8b": dict(dim=4096, hidden_dim=14336, n_layers=32, n_heads=32, n_kv_heads=8,
+               vocab_size=128256, seq_len=1024),
+}
+LABELS = {"tiny": "tiny", "1b": "Llama-3.2-1B", "8b": "Llama-3.1-8B"}
+
+
+def bench_engine(cfg, params, n_decode, unroll, prompt_len=512):
+    """Batch=1 prefill + fused-decode timings for one preset. Returns dict."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
     from dllama_tpu.engine.engine import InferenceEngine
-    from dllama_tpu.models.config import LlamaConfig
-    from dllama_tpu.models.llama import random_params
 
-    preset = os.environ.get("BENCH_PRESET", "1b")
-    presets = {
-        # dims follow the HF configs of the reference's model zoo (launch.py)
-        "tiny": dict(dim=512, hidden_dim=1536, n_layers=4, n_heads=8, n_kv_heads=4,
-                     vocab_size=2048, seq_len=512),
-        "1b": dict(dim=2048, hidden_dim=8192, n_layers=16, n_heads=32, n_kv_heads=8,
-                   vocab_size=128256, seq_len=1024),
-        "8b": dict(dim=4096, hidden_dim=14336, n_layers=32, n_heads=32, n_kv_heads=8,
-                   vocab_size=128256, seq_len=1024),
-    }
-    if preset not in presets:
-        raise SystemExit(f"BENCH_PRESET must be one of {sorted(presets)}, got {preset!r}")
-    label = {"tiny": "tiny", "1b": "Llama-3.2-1B", "8b": "Llama-3.1-8B"}[preset]
-    cfg = LlamaConfig(**presets[preset])
-    unroll_env = os.environ.get("BENCH_UNROLL", "1")
-    unroll = True if unroll_env == "full" else int(unroll_env)
+    import jax.numpy as jnp
 
-    dev = jax.devices()[0]
-    t0 = time.perf_counter()
-    params = random_params(cfg, seed=0, dtype=jnp.bfloat16, quantize=True)
-    eng = InferenceEngine(cfg, params, cache_dtype=jnp.bfloat16, max_prefill_chunk=128,
-                          layer_unroll=unroll)
-    t_setup = time.perf_counter() - t0
-
-    prompt = np.arange(1, 129, dtype=np.int32)[None] % cfg.vocab_size
+    eng = InferenceEngine(cfg, params, cache_dtype=jnp.bfloat16,
+                          max_prefill_chunk=512, layer_unroll=unroll)
+    prompt_len = min(prompt_len, cfg.seq_len // 2)
+    prompt = (np.arange(1, prompt_len + 1, dtype=np.int32)[None]) % cfg.vocab_size
     t0 = time.perf_counter()
     logits = eng.prefill(prompt)
     jax.block_until_ready(logits)
-    t_prefill_compile = time.perf_counter() - t0
-
+    t_compile = time.perf_counter() - t0
     first = np.asarray(jnp.argmax(logits, -1)).astype(np.int32)
     prefill_end = eng.pos
 
-    # warmup/compile the fused decode loop with the SAME static n as the timed
-    # run (n is a static arg of the scan — a different n would recompile inside
-    # the timed region)
-    n_decode = int(os.environ.get("BENCH_DECODE_TOKENS", "256"))
     n_decode = min(n_decode, eng.seq_len - eng.pos - 1)
     t0 = time.perf_counter()
-    _ = eng.decode_greedy_n(first, n_decode)
-    t_decode_compile = time.perf_counter() - t0
+    _ = eng.decode_greedy_n(first, n_decode)  # compile+warmup, same static n
+    t_compile += time.perf_counter() - t0
 
-    # timed decode over the same range (cache slots past pos are masked out)
     eng.reset(prefill_end)
     t0 = time.perf_counter()
-    toks = eng.decode_greedy_n(first, n_decode)  # np.asarray inside = device sync
+    _ = eng.decode_greedy_n(first, n_decode)  # np.asarray inside = device sync
     t_decode = time.perf_counter() - t0
-    tok_s = n_decode / t_decode
 
-    # timed prefill (cache already compiled; re-run from pos 0)
     eng.reset(0)
     t0 = time.perf_counter()
     logits = eng.prefill(prompt)
     jax.block_until_ready(logits)
     t_prefill = time.perf_counter() - t0
-    prefill_tok_s = prompt.shape[1] / t_prefill
 
     n_params = params_count(cfg)
-    north_star = 1000.0 * (8.03e9 / n_params)  # size-adjusted 8B@1000tok/s/chip
-    result = {
-        "metric": f"decode tok/s, {label}-Q40 synthetic, batch=1, 1 chip ({dev.platform})",
-        "value": round(tok_s, 2),
-        "unit": "tok/s",
-        "vs_baseline": round(tok_s / north_star, 4),
+    prefill_tok_s = prompt.shape[1] / t_prefill
+    # ~2 flops/param/token; v5e bf16 peak ~197 TFLOP/s
+    mfu = prefill_tok_s * 2.0 * n_params / 197e12
+    del eng
+    return {
+        "decode_tok_s": round(n_decode / t_decode, 2),
+        "decode_ms_per_token": round(1000.0 * t_decode / n_decode, 3),
         "prefill_tok_s": round(prefill_tok_s, 1),
-        "decode_ms_per_token": round(1000.0 / tok_s, 3),
+        "prefill_mfu": round(mfu, 4),
+        "compile_s": round(t_compile, 1),
         "params_b": round(n_params / 1e9, 3),
-        "device": str(dev),
-        "setup_s": round(t_setup, 1),
-        "compile_s": round(t_prefill_compile + t_decode_compile, 1),
-        "unroll": unroll_env,
     }
+
+
+def bench_batched(cfg, params, slots, n_decode=64):
+    """Aggregate decode tok/s/chip from the continuous-batching tier with all
+    `slots` sequences decoding together (BatchEngine, per-slot positions)."""
+    import numpy as np
+
+    from dllama_tpu.engine.batch import BatchEngine
+
+    import jax.numpy as jnp
+
+    eng = BatchEngine(cfg, params, n_slots=slots, cache_dtype=jnp.bfloat16,
+                      max_prefill_chunk=64)
+    rng = np.random.default_rng(0)
+    t0 = time.perf_counter()
+    for s in range(slots):
+        eng.add(s, list(rng.integers(1, cfg.vocab_size, 64)), temperature=0.8, seed=s)
+    t_prefill = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    eng.decode(n_decode)  # compile + warmup (same static n)
+    t_compile = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    eng.decode(n_decode)
+    t = time.perf_counter() - t0
+    del eng
+    return {
+        "slots": slots,
+        "agg_tok_s": round(slots * n_decode / t, 1),
+        "step_ms": round(1000.0 * t / n_decode, 2),
+        "admit_prefill_s": round(t_prefill, 1),
+        "compile_s": round(t_compile, 1),
+    }
+
+
+def worker():
+    import jax
+    import jax.numpy as jnp
+
+    from dllama_tpu.models.config import LlamaConfig
+    from dllama_tpu.models.llama import random_params_fast
+
+    deadline = time.monotonic() + float(os.environ.get("BENCH_WORKER_BUDGET_S", "1e9"))
+    preset = os.environ.get("BENCH_PRESET", "all")
+    unroll_env = os.environ.get("BENCH_UNROLL", "1")
+    unroll = True if unroll_env == "full" else int(unroll_env)
+    n_decode = int(os.environ.get("BENCH_DECODE_TOKENS", "128"))
+    slot_list = [int(s) for s in os.environ.get("BENCH_SLOTS", "8,32").split(",")]
+    run_presets = ["1b", "8b"] if preset == "all" else [preset]
+
+    for name in run_presets:
+        if name not in PRESETS:
+            raise SystemExit(
+                f"BENCH_PRESET must be 'all' or one of {sorted(PRESETS)}, got {name!r}"
+            )
+
+    dev = jax.devices()[0]
+    results = {}
+    batch_results = []
+    best = (0.0, "", 0.0)  # (tok_s/north_star, label, tok_s)
+    setup_s = 0.0
+    for name in run_presets:
+        if time.monotonic() > deadline - 180 and results:
+            # out of budget: keep the measurements we already have rather than
+            # letting the parent's timeout discard everything
+            results[name] = {"skipped": "budget"}
+            continue
+        cfg = LlamaConfig(**PRESETS[name])
+        t0 = time.perf_counter()
+        params = random_params_fast(cfg, seed=0, dtype=jnp.bfloat16)
+        setup_s += time.perf_counter() - t0
+        r = bench_engine(cfg, params, n_decode, unroll)
+        results[name] = r
+        north = 1000.0 * (8.03e9 / (r["params_b"] * 1e9))
+        if r["decode_tok_s"] / north > best[0]:
+            best = (r["decode_tok_s"] / north, f"{LABELS[name]} batch=1 decode", r["decode_tok_s"])
+        # batched sweep on the LAST preset (the 8B north-star config), while
+        # its params are live; skip slots we no longer have budget for
+        if name == run_presets[-1] and name != "tiny":
+            for slots in slot_list:
+                if time.monotonic() > deadline - 120:
+                    batch_results.append({"slots": slots, "skipped": "budget"})
+                    continue
+                br = bench_batched(cfg, params, slots)
+                br["preset"] = name
+                batch_results.append(br)
+                if br["agg_tok_s"] / north > best[0]:
+                    best = (br["agg_tok_s"] / north, f"{LABELS[name]} {slots}-slot serving", br["agg_tok_s"])
+        del params
+
     # bytes/token is part of the benchmark contract (SURVEY.md §5.1/§6): on
     # one chip it's 0; multi-chip runs report the analytic ICI payload.
     from dllama_tpu.utils.profiling import collective_bytes_per_token
 
-    n_dev = jax.device_count()
-    result["kb_per_token_per_chip"] = round(
-        collective_bytes_per_token(cfg, tp=n_dev)["kb_per_token_per_chip"], 1
-    )
+    cfg8 = LlamaConfig(**PRESETS[run_presets[-1]])
+    kb = collective_bytes_per_token(cfg8, tp=jax.device_count())["kb_per_token_per_chip"]
+    result = {
+        "metric": f"tokens/sec/chip, {best[1]}, Q40 synthetic, 1 chip ({dev.platform})",
+        "value": best[2],
+        "unit": "tok/s",
+        "vs_baseline": round(best[0], 4),
+        "presets": results,
+        "batch": batch_results,
+        "device": str(dev),
+        "setup_s": round(setup_s, 1),
+        "unroll": unroll_env,
+        "kb_per_token_per_chip": round(kb, 1),
+    }
     print(json.dumps(result))
 
 

@@ -99,12 +99,10 @@ class BatchEngine:
                 attn_fn = partial(flash_gqa_attention, interpret=not on_tpu)
 
         # same per-engine backend resolution as InferenceEngine (sharded => xla)
-        from dllama_tpu.ops.matmul import matmul as _matmul, resolve_backend
+        from dllama_tpu.ops.matmul import engine_matmul
 
-        self.backend = resolve_backend(
-            None if kernels == "auto" else kernels, sharded=shardings is not None
-        )
-        mm = partial(_matmul, backend=self.backend)
+        mm = engine_matmul(kernels, shardings)
+        self.backend = mm.keywords["backend"]
 
         self._prefill_step = jax.jit(
             partial(self._prefill_impl, cfg, attn_fn, self._col_fn, mm), donate_argnums=(1,)

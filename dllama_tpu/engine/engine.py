@@ -97,12 +97,10 @@ class InferenceEngine:
         # path — pallas_call has no GSPMD partitioning rule, so under a tp
         # mesh the Pallas kernels would all-gather the sharded weights per
         # layer (VERDICT r2 weak #1; same reasoning as the flash gating below).
-        from dllama_tpu.ops.matmul import matmul as _matmul, resolve_backend
+        from dllama_tpu.ops.matmul import engine_matmul
 
-        self.backend = resolve_backend(
-            None if kernels == "auto" else kernels, sharded=shardings is not None
-        )
-        mm = partial(_matmul, backend=self.backend)
+        mm = engine_matmul(kernels, shardings)
+        self.backend = mm.keywords["backend"]
 
         attn_fn = shardings.attn_fn(batch) if shardings is not None else None
         if attn_fn is None and attn_impl != "jnp":

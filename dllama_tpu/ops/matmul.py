@@ -50,6 +50,18 @@ def resolve_backend(backend: str | None = None, sharded: bool = False) -> str:
     return b
 
 
+def engine_matmul(kernels: str, shardings) -> "functools.partial":
+    """The single place engines turn their (kernels flag, shardings) pair
+    into a bound matmul — InferenceEngine and BatchEngine share this so the
+    resolution rule can never diverge between tiers."""
+    import functools
+
+    backend = resolve_backend(
+        None if kernels == "auto" else kernels, sharded=shardings is not None
+    )
+    return functools.partial(matmul, backend=backend)
+
+
 def matmul(x: jax.Array, w, layer=None, backend: str | None = None) -> jax.Array:
     """``x @ w`` (or ``x @ w[layer]``) where ``w`` is a QTensor or dense array.
 

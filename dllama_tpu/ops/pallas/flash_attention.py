@@ -15,6 +15,15 @@ cache head h // group, so no materialized repeat_kv.
 Causality follows gqa_attention's fixed-size-cache masking (ops/layers.py):
 query t sees cache slots s <= pos_base + t, which also masks the unwritten
 tail of the ring buffer.
+
+KV-tile pruning: the cache is a fixed [S] ring (static shapes for XLA), but a
+decode step at position p only has p+1 live rows. `pos` rides as a
+scalar-prefetch argument so the k/v index maps can clamp the kv-tile index to
+the last live tile — Pallas elides the DMA when consecutive grid steps map to
+the same block — and the kernel skips the masked tiles' compute entirely.
+Decode cost then scales with the *live* cache, not S (the reference's
+`t = 0..pos` loop bound, nn-cpu-ops.cpp:752-787, recovered without dynamic
+shapes).
 """
 
 from __future__ import annotations
@@ -42,31 +51,38 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref, *, sca
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    q = q_ref[:].astype(jnp.float32)  # [tq, hd]
-    k = k_ref[:].astype(jnp.float32)  # [ts, hd]
-    v = v_ref[:].astype(jnp.float32)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-    s = s * scale  # [tq, ts]
+    # query-row absolute positions (query row r is token pos[b] + iq*tq + r,
+    # b = this head's batch row; padded tail rows are discarded by the
+    # wrapper) — computed OUTSIDE the pl.when (program_id can't lower inside
+    # its branch in interpret mode)
+    pos_b = pos_ref[pl.program_id(0) // hq]
+    qpos_max = pos_b + iq * tq + tq - 1
 
-    # causal mask against absolute cache positions (query row r is token
-    # pos[b] + iq*tq + r, b = this head's batch row; padded tail rows are
-    # discarded by the wrapper)
-    qpos = pos_ref[pl.program_id(0) // hq] + iq * tq + jax.lax.broadcasted_iota(
-        jnp.int32, (tq, ts), 0
-    )
-    span = ks * ts + jax.lax.broadcasted_iota(jnp.int32, (tq, ts), 1)
-    mask = span <= qpos
-    s = jnp.where(mask, s, _NEG_INF)
+    # kv tiles fully past the last visible position are dead (their DMA was
+    # elided by the clamped index map too): skip their compute
+    @pl.when(ks * ts <= qpos_max)
+    def _():
+        q = q_ref[:].astype(jnp.float32)  # [tq, hd]
+        k = k_ref[:].astype(jnp.float32)  # [ts, hd]
+        v = v_ref[:].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        s = s * scale  # [tq, ts]
 
-    m_prev = m_ref[:][:, :1]  # replicated across lanes; take one
-    l_prev = l_ref[:][:, :1]
-    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-    alpha = jnp.exp(m_prev - m_cur)
-    p = jnp.where(mask, jnp.exp(s - m_cur), 0.0)  # [tq, ts]
-    l_cur = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot(p, v, preferred_element_type=jnp.float32)
-    m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
-    l_ref[:] = jnp.broadcast_to(l_cur, l_ref.shape)
+        # causal mask against absolute cache positions
+        qpos = pos_b + iq * tq + jax.lax.broadcasted_iota(jnp.int32, (tq, ts), 0)
+        span = ks * ts + jax.lax.broadcasted_iota(jnp.int32, (tq, ts), 1)
+        mask = span <= qpos
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_ref[:][:, :1]  # replicated across lanes; take one
+        l_prev = l_ref[:][:, :1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.where(mask, jnp.exp(s - m_cur), 0.0)  # [tq, ts]
+        l_cur = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot(p, v, preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_cur, l_ref.shape)
 
     @pl.when(ks == pl.num_programs(2) - 1)
     def _():
@@ -83,22 +99,32 @@ def _flash_folded(q, k, v, pos, *, group: int, hq: int, interpret: bool):
     tq = _pick_tile(tp, (128, 64, 32, 16, 8))
     ts = _pick_tile(s, (512, 256, 128, 64))
     grid = (bhq, tp // tq, s // ts)
-    return pl.pallas_call(
-        functools.partial(_kernel, scale=1.0 / math.sqrt(hd), tq=tq, ts=ts, hq=hq),
+
+    def kv_index(h, i, ks, pos):
+        # clamp dead kv tiles to the last LIVE tile: the repeated block index
+        # makes Pallas skip the DMA, and the kernel skips their compute
+        last_live = (pos[h // hq] + i * tq + tq - 1) // ts
+        return (h // group, jnp.minimum(ks, last_live), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # pos: i32[B]
         grid=grid,
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # pos: i32[B]
-            pl.BlockSpec((None, tq, hd), lambda h, i, ks: (h, i, 0)),
-            pl.BlockSpec((None, ts, hd), lambda h, i, ks: (h // group, ks, 0)),
-            pl.BlockSpec((None, ts, hd), lambda h, i, ks: (h // group, ks, 0)),
+            pl.BlockSpec((None, tq, hd), lambda h, i, ks, pos: (h, i, 0)),
+            pl.BlockSpec((None, ts, hd), kv_index),
+            pl.BlockSpec((None, ts, hd), kv_index),
         ],
-        out_specs=pl.BlockSpec((None, tq, hd), lambda h, i, ks: (h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bhq, tp, hd), jnp.float32),
+        out_specs=pl.BlockSpec((None, tq, hd), lambda h, i, ks, pos: (h, i, 0)),
         scratch_shapes=[
             pltpu.VMEM((tq, hd), jnp.float32),
             pltpu.VMEM((tq, 128), jnp.float32),
             pltpu.VMEM((tq, 128), jnp.float32),
         ],
+    )
+    return pl.pallas_call(
+        functools.partial(_kernel, scale=1.0 / math.sqrt(hd), tq=tq, ts=ts, hq=hq),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bhq, tp, hd), jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),

@@ -218,6 +218,9 @@ def q40_matmul(
     stacked form is indexed by the DMA engine, never sliced by XLA.
     """
     *lead, k = x.shape
+    assert k % Q_BLOCK == 0 and k >= 128 and w.shape[-1] % 128 == 0, (
+        f"untileable Q40 matmul: k={k}, n={w.shape[-1]} (see supported())"
+    )
     m = 1
     for d in lead:
         m *= d

@@ -1,6 +1,10 @@
 """Microbench harness for Q40 matmul kernel variants on the real TPU.
 
-Usage: python experiments/kbench.py [variant ...]
+Usage: python experiments/kbench.py M SHAPE [variant ...]
+  variants: A  production dispatch (q40_matmul auto: blockdot for m<=16, deq above)
+            DQ forced deq-style kernel      BD forced blockdot kernel
+            B  legacy fma-f32 kernel        D  bf16-weights roofline reference
+            E  XLA dequantize-then-dot
 Measures achieved HBM GB/s (packed+scales bytes) for decode (m=8) and
 prefill (m=128) shapes of the 1B preset.
 """
@@ -15,7 +19,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from dllama_tpu.ops.quant import Q_BLOCK, QTensor
-from dllama_tpu.ops.pallas.q40_matmul import q40_matmul_2d as current_kernel
+from dllama_tpu.ops.pallas import q40_matmul as qmod
 from dllama_tpu.ops.pallas.tiling import pick_tile as _pick_tile
 
 
@@ -39,36 +43,6 @@ def _kernel_b(x_ref, packed_ref, scales_ref, out_ref, acc_ref, *, tk, tn):
     f = codes.astype(jnp.float32)
     w = (f * s - 8.0 * s).reshape(tk, tn)
     acc_ref[:] += jnp.dot(x_ref[:].astype(jnp.float32), w, preferred_element_type=jnp.float32)
-
-    @pl.when(kb == pl.num_programs(2) - 1)
-    def _():
-        out_ref[:] = acc_ref[:]
-
-
-# ---------------------------------------------------------------- variant C
-# like B but scale applied after the per-block dot (block-diag batched dot)
-def _kernel_c(x_ref, packed_ref, scales_ref, out_ref, acc_ref, *, tk, tn):
-    kb = pl.program_id(2)
-
-    @pl.when(kb == 0)
-    def _():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
-    p = packed_ref[:].astype(jnp.int32)
-    lo = (p & 0x0F)
-    hi = (p >> 4)
-    nb = tk // Q_BLOCK
-    codes = jnp.concatenate(
-        [lo.reshape(nb, Q_BLOCK // 2, tn), hi.reshape(nb, Q_BLOCK // 2, tn)], axis=1
-    ).astype(jnp.float32).astype(jnp.bfloat16)  # [nb, 32, tn]
-    m = x_ref.shape[0]
-    xb = x_ref[:].reshape(m, nb, Q_BLOCK).transpose(1, 0, 2).astype(jnp.bfloat16)  # [nb, m, 32]
-    y = jax.lax.dot_general(
-        xb, codes, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
-    )  # [nb, m, tn]
-    s = scales_ref[:].astype(jnp.float32)  # [nb, tn]
-    y = y - 8.0 * jnp.sum(xb.astype(jnp.float32), axis=2, keepdims=True)
-    acc_ref[:] += jnp.sum(y * s[:, None, :], axis=0)
 
     @pl.when(kb == pl.num_programs(2) - 1)
     def _():
@@ -155,9 +129,18 @@ def main():
     qbytes = k * n // 2 + (k // Q_BLOCK) * n * 4  # packed + f32 scales
     rows = []
     for v in variants:
-        if v == "A":
-            t = bench(lambda x, p, s: current_kernel(x, p, s), (x, w.packed, w.scales))
-            rows.append(("A current", t, qbytes))
+        if v in ("A", "DQ", "BD"):
+            style = {"A": "auto", "DQ": "deq", "BD": "blockdot"}[v]
+
+            def prod(x, w=w, style=style):
+                qmod.STYLE = style
+                try:
+                    return qmod.q40_matmul(x, w)
+                finally:
+                    qmod.STYLE = "auto"
+
+            t = bench(prod, (x,))
+            rows.append((f"{v} {style}", t, qbytes))
         elif v == "B":
             call = make_call(_kernel_b, m, k, n)
             t = bench(call, (x, w.packed, w.scales))
@@ -173,6 +156,8 @@ def main():
                 (x, w),
             )
             rows.append(("E xla-deq", t, qbytes))
+        else:
+            raise SystemExit(f"unknown variant {v!r}; see module docstring")
     out = f"m={m} {label}: "
     for name, t, nb in rows:
         out += f"{name}={t*1e6:.0f}us({nb/t/1e9:.0f}GB/s) "

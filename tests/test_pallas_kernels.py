@@ -66,6 +66,7 @@ def test_q40_matmul_exact_on_roundtrip_values(rng):
         (1, 16, 8, 8, 64, 128, 0),  # MHA prefill chunk
         (2, 64, 8, 2, 128, 256, 64),  # GQA batched prefill mid-sequence
         (1, 3, 4, 4, 64, 128, 5),  # odd T -> row-pad path
+        (1, 1, 8, 4, 64, 1024, 3),  # decode in a long cache: most kv tiles pruned
     ],
 )
 def test_flash_attention_matches_jnp(rng, b, t, hq, hkv, hd, s, pos):
