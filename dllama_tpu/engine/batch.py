@@ -86,48 +86,40 @@ class BatchEngine:
 
             self._col_fn = make_q80_col_matmul(shardings.mesh)
 
-        attn_fn = None
-        if shardings is None and attn_impl != "jnp":
-            # Pallas flash attention for the serving tier (VERDICT r1 weak #5);
-            # same gating as InferenceEngine: auto only unsharded on real TPU.
-            from dllama_tpu.ops.pallas.flash_attention import flash_gqa_attention, supported
+        # kernel selection shared with InferenceEngine (engine/kernel_select.py)
+        from dllama_tpu.engine.kernel_select import resolve_kernels
 
-            on_tpu = jax.devices()[0].platform == "tpu"
-            if supported((cfg.n_heads, cfg.head_size), self.seq_len) and (
-                attn_impl == "flash" or on_tpu
-            ):
-                attn_fn = partial(flash_gqa_attention, interpret=not on_tpu)
-
-        # same per-engine backend resolution as InferenceEngine (sharded => xla)
-        from dllama_tpu.ops.matmul import engine_matmul
-
-        mm = engine_matmul(kernels, shardings)
-        self.backend = mm.keywords["backend"]
+        sel = resolve_kernels(cfg, self.seq_len, n_slots, kernels, attn_impl, shardings)
+        mm, mm_in, attn_fn = sel.mm, sel.mm_in, sel.attn_fn
+        self.backend = sel.backend
 
         self._prefill_step = jax.jit(
-            partial(self._prefill_impl, cfg, attn_fn, self._col_fn, mm), donate_argnums=(1,)
+            partial(self._prefill_impl, cfg, attn_fn, self._col_fn, mm, mm_in),
+            donate_argnums=(1,),
         )
         self._decode = jax.jit(
-            partial(self._decode_impl, cfg, attn_fn, self._col_fn, mm),
+            partial(self._decode_impl, cfg, attn_fn, self._col_fn, mm, mm_in),
             static_argnums=(8,), donate_argnums=(1,),
         )
 
     # ------------------------------------------------------------- jitted fns
 
     @staticmethod
-    def _prefill_impl(cfg, attn_fn, col_fn, mm, params, cache, tokens, pos_vec, active, rope):
+    def _prefill_impl(cfg, attn_fn, col_fn, mm, mm_in, params, cache, tokens, pos_vec,
+                      active, rope):
         logits, cache = forward(cfg, params, tokens, pos_vec, cache, rope, attn_fn,
-                                active=active, col_fn=col_fn, mm=mm, last_only=True)
+                                active=active, col_fn=col_fn, mm=mm, mm_in=mm_in,
+                                last_only=True)
         return logits[:, -1], cache
 
     @staticmethod
-    def _decode_impl(cfg, attn_fn, col_fn, mm, params, cache, tokens, pos_vec, active, keys,
-                     temps, topps, n, rope):
+    def _decode_impl(cfg, attn_fn, col_fn, mm, mm_in, params, cache, tokens, pos_vec,
+                     active, keys, temps, topps, n, rope):
         def body(carry, _):
             tok, cache, p, keys = carry
             logits, cache = forward(cfg, params, tok, p, cache, rope, attn_fn,
                                     active=jnp.asarray(active), col_fn=col_fn, mm=mm,
-                                    last_only=True)
+                                    mm_in=mm_in, last_only=True)
             splits = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
             keys, subs = splits[:, 0], splits[:, 1]
             nxt = _sample_rows(logits[:, -1], subs, temps, topps)[:, None]

@@ -92,30 +92,14 @@ class InferenceEngine:
             self.cache = shardings.put_cache(self.cache)
             self.rope_cache = shardings.put_replicated(self.rope_cache)
 
-        # matmul backend resolved ONCE at construction (per-engine, not a
-        # process-global read at trace time): sharded engines force the XLA
-        # path — pallas_call has no GSPMD partitioning rule, so under a tp
-        # mesh the Pallas kernels would all-gather the sharded weights per
-        # layer (VERDICT r2 weak #1; same reasoning as the flash gating below).
-        from dllama_tpu.ops.matmul import engine_matmul
+        # matmul + attention kernels resolved ONCE at construction (per-engine,
+        # not a process-global read at trace time); gating rules shared with
+        # BatchEngine via engine/kernel_select.py.
+        from dllama_tpu.engine.kernel_select import resolve_kernels
 
-        mm = engine_matmul(kernels, shardings)
-        self.backend = mm.keywords["backend"]
-
-        attn_fn = shardings.attn_fn(batch) if shardings is not None else None
-        if attn_fn is None and attn_impl != "jnp":
-            # Pallas flash attention: auto only for UNSHARDED engines on real
-            # TPU — pallas_call has no GSPMD partitioning rule, so under a tp
-            # mesh the auto path would all-gather the head-sharded cache per
-            # layer (ADVICE r1). attn_impl='flash' stays an explicit override.
-            from dllama_tpu.ops.pallas.flash_attention import flash_gqa_attention, supported
-
-            on_tpu = jax.devices()[0].platform == "tpu"
-            if supported((cfg.n_heads, cfg.head_size), self.seq_len) and (
-                attn_impl == "flash" or (on_tpu and shardings is None)
-            ):
-                # off-TPU the Mosaic kernel can't lower; run the interpreter
-                attn_fn = partial(flash_gqa_attention, interpret=not on_tpu)
+        sel = resolve_kernels(cfg, self.seq_len, batch, kernels, attn_impl, shardings)
+        mm, mm_in, attn_fn = sel.mm, sel.mm_in, sel.attn_fn
+        self.backend = sel.backend
         if sync not in ("bf16", "q80"):
             raise ValueError(f"sync must be 'bf16' or 'q80', got {sync!r}")
         col_fn = None
@@ -147,7 +131,7 @@ class InferenceEngine:
         else:
             def fwd(params, cache, tokens, pos, rope_cache, last_only=False):
                 return forward(cfg, params, tokens, pos, cache, rope_cache, attn_fn,
-                               unroll=layer_unroll, col_fn=col_fn, mm=mm,
+                               unroll=layer_unroll, col_fn=col_fn, mm=mm, mm_in=mm_in,
                                last_only=last_only)
 
         donate = (1,) if donate_cache else ()

@@ -72,17 +72,22 @@ from dllama_tpu.ops.quant import slice_leaf as _slice_layer
 
 
 def _layer(cfg: LlamaConfig, x, layers, li, k_cache, v_cache, rope, pos_base, attn_fn,
-           active=None, col_fn=None, mm=None):
+           active=None, col_fn=None, mm=None, mm_in=None):
     """One decoder layer. `layers` is the full stacked params dict and `li`
     the traced layer index — quantized weights are NOT sliced here: the matmul
     dispatcher either DMA-indexes the stack (Pallas scalar prefetch) or slices
     lazily (XLA path). Slicing stacked weights before a pallas_call would make
     XLA materialize a full HBM copy of every weight, every layer, every token.
+
+    `mm_in` is the matmul for the INPUT-dim-sharded weights (wo/w2 — the
+    reference's col slices with merge-add): under sharded-Pallas it psums
+    partials inside shard_map; default is plain `mm` (GSPMD inserts the
+    collective itself on the XLA path).
     """
     mm = mm or matmul
     if col_fn is None:
-        colmm = mm  # wo/w2 col-sharded matmuls; `--sync q80` swaps in the
-        # Q80-exchange shard_map (parallel/collectives.make_q80_col_matmul)
+        colmm = mm_in or mm  # `--sync q80` swaps in the Q80-exchange
+        # shard_map instead (parallel/collectives.make_q80_col_matmul)
     else:
         def colmm(h, w, layer=None):
             return col_fn(h, _slice_layer(w, layer) if layer is not None else w)
@@ -129,6 +134,7 @@ def run_layers(
     unroll: int | bool = 1,
     col_fn=None,  # wo/w2 matmul override (Q80 quantized exchange)
     mm=None,  # quantized-matmul fn (x, w, layer) -> out; default ops.matmul
+    mm_in=None,  # matmul for input-dim-sharded weights (see _layer)
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Scan the decoder layers (any contiguous stack — the full model, or one
     pipeline stage's slice). Returns (x, k_cache, v_cache).
@@ -146,7 +152,7 @@ def run_layers(
         x = carry
         li, kc, vc = xs
         x, kc, vc = _layer(cfg, x, layer_params, li, kc, vc, rope, pos_base, attn_fn,
-                           active, col_fn, mm)
+                           active, col_fn, mm, mm_in)
         return x, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(
@@ -170,6 +176,7 @@ def forward(
     unroll: int | bool = 1,  # lax.scan unroll over layers (see run_layers)
     col_fn=None,  # wo/w2 matmul override (Q80 quantized exchange)
     mm=None,  # quantized-matmul fn (x, w, layer) -> out; default ops.matmul
+    mm_in=None,  # matmul for input-dim-sharded weights (see _layer)
     last_only: bool = False,  # project logits for the last position only
 ) -> tuple[jax.Array, KVCache]:
     """Returns (logits f32 [B, T, vocab], updated cache).
@@ -193,7 +200,7 @@ def forward(
         rope = jax.lax.dynamic_slice_in_dim(rope_cache, pos_base, t, axis=0)
     x, k_new, v_new = run_layers(
         cfg, params["layers"], x, pos_base, cache.k, cache.v, rope, attn_fn, active,
-        unroll=unroll, col_fn=col_fn, mm=mm,
+        unroll=unroll, col_fn=col_fn, mm=mm, mm_in=mm_in,
     )
     if last_only:
         x = x[:, -1:]

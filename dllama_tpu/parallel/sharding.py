@@ -14,11 +14,13 @@ nn-network.cpp:521-554) becomes a reduce-scatter/all-gather pair on ICI.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dllama_tpu.models.config import LlamaConfig
 from dllama_tpu.ops.quant import QTensor
 from dllama_tpu.models.llama import KVCache
+
 
 # specs for stacked per-layer weights: leading L axis, then (in, out)
 _ROW_SHARD = P(None, None, "tp")  # output-dim sharded (reference "row" slice)
@@ -169,3 +171,99 @@ class LlamaShardings:
 
     def tokens_spec(self) -> P:
         return P("dp", None)
+
+    # ---------------------------------------------- sharded Pallas kernels
+    #
+    # pallas_call has no GSPMD partitioning rule, so under a mesh the fused
+    # Q40 kernels must run inside shard_map: each chip executes the kernel on
+    # its local weight shard and XLA only sees the manual region's collectives.
+    # This keeps the reference's TP decomposition (llm.cpp:133-141) fused:
+    # out-dim-sharded matmuls (wq/wk/wv/w1/w3/wcls) are embarrassingly
+    # parallel, in-dim-sharded ones (wo/w2) psum their partials — the
+    # SYNC_NODE_SLICES + OP_MERGE_ADD exchange (nn-network.cpp:521-554) as one
+    # ICI psum per call.
+
+    def supports_sharded_pallas(self) -> bool:
+        """tp/dp meshes only: sp needs ring attention (its own shard_map) and
+        pp replaces the layer scan with the stage schedule."""
+        return self.mesh.shape["sp"] == 1 and self.mesh.shape["pp"] == 1
+
+    def pallas_mms(self, batch: int):
+        """(mm, mm_in) shard_map-wrapped Pallas matmuls for the model forward.
+
+        mm:    x @ w with w sharded on the OUTPUT dim -> out sharded on 'tp'
+        mm_in: x @ w with w sharded on the INPUT dim  -> psum('tp'), replicated
+        Both take (x[B,T,K], w: QTensor 2-D or [L,...] stacked, layer) like
+        ops.matmul.matmul; untileable shards fall back to the XLA path inside
+        the manual region (ops.matmul dispatch runs per-shard).
+        """
+        from functools import partial
+
+        from dllama_tpu.ops.matmul import matmul
+
+        mesh = self.mesh
+        b_ax = self._batch_axis(batch)
+        pmm = partial(matmul, backend="pallas")
+
+        def make(shard_dim: int, reduce_over_tp: bool):
+            """shard_dim: weight dim carrying 'tp' (-1 out-shard, -2 in-shard)."""
+
+            def call(x, w, layer=None):
+                is_q = isinstance(w, QTensor)
+                nd = w.packed.ndim if is_q else jnp.ndim(w)
+                axes = [None] * nd
+                axes[shard_dim] = "tp"
+                wspec = P(*axes)
+                wspec_t = QTensor(wspec, wspec) if is_q else wspec
+                x_spec = P(b_ax, None, "tp" if reduce_over_tp else None)
+                out_spec = P(b_ax, None, None if reduce_over_tp else "tp")
+
+                def body(x, w, li=None):
+                    out = pmm(x, w, li)
+                    return jax.lax.psum(out, "tp") if reduce_over_tp else out
+
+                if nd == 3:  # layer-stacked weight: the layer index rides along
+                    fn = jax.shard_map(
+                        body, mesh=mesh, in_specs=(x_spec, wspec_t, P()),
+                        out_specs=out_spec, check_vma=False,
+                    )
+                    return fn(x, w, jnp.asarray(layer, jnp.int32))
+                fn = jax.shard_map(
+                    lambda x, w: body(x, w), mesh=mesh,
+                    in_specs=(x_spec, wspec_t), out_specs=out_spec, check_vma=False,
+                )
+                return fn(x, w)
+
+            return call
+
+        return make(-1, False), make(-2, True)
+
+    def pallas_attn(self, batch: int, interpret: bool = False):
+        """Head-sharded flash attention: each chip runs the online-softmax
+        kernel on its local kv-head shard (attention is per-head local — the
+        reference's sliceMultiHeadAtt, nn-core.cpp:215-238)."""
+        from dllama_tpu.ops.pallas.flash_attention import flash_gqa_attention
+
+        mesh = self.mesh
+        b_ax = self._batch_axis(batch)
+
+        def attn(q, k_cache, v_cache, pos_base):
+            b = q.shape[0]
+            pos_vec = jnp.broadcast_to(
+                jnp.atleast_1d(jnp.asarray(pos_base, jnp.int32)), (b,)
+            )
+            fn = jax.shard_map(
+                lambda q, k, v, p: flash_gqa_attention(q, k, v, p, interpret=interpret),
+                mesh=mesh,
+                in_specs=(
+                    P(b_ax, None, "tp", None),   # q [B, T, Hq, hd]
+                    P(b_ax, "tp", None, None),   # k cache [B, Hkv, S, hd]
+                    P(b_ax, "tp", None, None),
+                    P(b_ax),                     # per-row positions
+                ),
+                out_specs=P(b_ax, None, "tp", None),
+                check_vma=False,
+            )
+            return fn(q, k_cache, v_cache, pos_vec)
+
+        return attn

@@ -1,0 +1,74 @@
+"""TP-sharded engines with the fused Pallas kernels (interpret mode on CPU)
+must match the single-device XLA engine — proof that tensor parallelism keeps
+the fused Q40/flash kernels instead of falling back or gathering weights
+(the reference capability at stake: the whole TP decomposition,
+llm.cpp:133-141 + nn-network.cpp:521-554).
+
+kernels='pallas' on a mesh routes every matmul through
+parallel/sharding.pallas_mms (shard_map over 'tp': local kernel + psum for
+wo/w2) and attention through pallas_attn (head-sharded flash). Off-TPU the
+kernels run in interpret mode — same code path as the real chip minus Mosaic.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dllama_tpu.engine.engine import InferenceEngine
+from dllama_tpu.models.config import LlamaConfig
+from dllama_tpu.models.llama import random_params
+from dllama_tpu.parallel.mesh import MeshConfig, make_mesh
+from dllama_tpu.parallel.sharding import LlamaShardings
+
+# sized so the per-shard shapes stay tileable at tp=4 (n_local % 128 == 0 for
+# wq/w1/wcls; wk/wv shards fall back to XLA inside the shard_map — also a
+# correctness path worth covering)
+CFG = LlamaConfig(dim=512, hidden_dim=1024, n_layers=2, n_heads=8, n_kv_heads=4,
+                  vocab_size=512, seq_len=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return random_params(CFG, seed=7, dtype=jnp.float32, quantize=True)
+
+
+@pytest.mark.parametrize("mesh_cfg", [MeshConfig(tp=4), MeshConfig(dp=2, tp=2)])
+def test_tp_pallas_matches_single_device(params, mesh_cfg):
+    prompt = np.arange(1, 33, dtype=np.int32)[None]  # 32 tokens: deq-style path
+
+    ref = InferenceEngine(CFG, params, cache_dtype=jnp.float32, kernels="xla",
+                          attn_impl="jnp")
+    ref_logits = np.asarray(ref.prefill(prompt))
+
+    mesh = make_mesh(mesh_cfg)
+    sh = LlamaShardings(mesh, CFG)
+    eng = InferenceEngine(CFG, params, cache_dtype=jnp.float32, shardings=sh,
+                          kernels="pallas")
+    assert eng.backend == "pallas"  # the fused path, not a fallback
+    got = np.asarray(eng.prefill(prompt))
+    np.testing.assert_allclose(got, ref_logits, atol=3e-3, rtol=3e-3)
+
+    # decode steps exercise the blockdot (m<=16) kernel + head-sharded flash
+    for tok in (11, 42):
+        ref_l = np.asarray(ref.decode_step(np.array([[tok]])))
+        got_l = np.asarray(eng.decode_step(np.array([[tok]])))
+        np.testing.assert_allclose(got_l, ref_l, atol=3e-3, rtol=3e-3)
+
+
+def test_tp_pallas_batch_engine_matches(params):
+    """The serving tier on a tp mesh with fused kernels: same continuation as
+    the unsharded XLA BatchEngine (per-slot seeds make this deterministic)."""
+    from dllama_tpu.engine.batch import BatchEngine
+
+    mesh = make_mesh(MeshConfig(tp=4))
+    sh = LlamaShardings(mesh, CFG)
+    prompt = list(range(1, 9))
+
+    def run(shardings, kernels):
+        eng = BatchEngine(CFG, params, n_slots=2, cache_dtype=jnp.float32,
+                          shardings=shardings, kernels=kernels)
+        first = eng.add(0, prompt, temperature=0.0, seed=123)
+        toks = eng.decode(4)
+        return [first] + [int(t) for t in toks[:, 0]]
+
+    assert run(None, "xla") == run(sh, "pallas")
