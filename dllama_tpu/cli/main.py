@@ -53,6 +53,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slots", type=int, default=0,
                    help="serve mode: continuous-batching slots (0 = single-request + prefix cache)")
     p.add_argument("--kernels", choices=["auto", "pallas", "xla"], default="auto")
+    p.add_argument("--moe", choices=["auto", "dispatch", "dense"], default="auto",
+                   help="MoE compute: capacity-bucketed dispatch (O(k) FLOPs, rare "
+                        "capacity drops) or exact dense all-experts")
     p.add_argument("--sync", choices=["bf16", "q80"], default="bf16",
                    help="tp activation exchange: native bf16 collectives or the "
                         "reference's Q80 quantized payload (half the ICI bytes)")
@@ -88,6 +91,8 @@ def _load(args):
         dequantize=args.dequantize,
         max_prefill_chunk=args.max_prefill_chunk,
         sync=args.sync,
+        kernels=args.kernels,
+        moe_impl=args.moe,
     )
 
 
@@ -146,6 +151,15 @@ def cmd_inference(args) -> int:
         print(
             f"🔗 est. inter-chip payload: {est['kb_per_token_per_chip']:.0f} kB/token/chip "
             f"(tp={tp} sp={sp})",
+            file=sys.stderr,
+        )
+        # measured counterpart: the collective ops in the compiled step
+        # (nn-network.cpp:483-492 counts real socket bytes; this counts the
+        # real HLO collectives — scan bodies once per trip, see docstring)
+        meas = m.engine.measured_collective_report()
+        ops = ", ".join(f"{k}={v / 1024:.0f}kB" for k, v in meas["per_op"].items()) or "none"
+        print(
+            f"🔗 measured in compiled step: {meas['total_bytes'] / 1024:.0f} kB ({ops})",
             file=sys.stderr,
         )
     return 0

@@ -49,6 +49,7 @@ class BatchEngine:
         attn_impl: str = "auto",  # 'auto' | 'jnp' | 'flash' (same as InferenceEngine)
         sync: str = "bf16",  # 'bf16' | 'q80' quantized tp exchange (as InferenceEngine)
         kernels: str = "auto",  # 'auto' | 'pallas' | 'xla' matmul backend
+        moe_impl: str = "auto",  # 'auto' | 'dispatch' | 'dense' (ops.layers.moe_ffn)
     ):
         from dllama_tpu.ops.layers import build_rope_cache
 
@@ -94,32 +95,32 @@ class BatchEngine:
         self.backend = sel.backend
 
         self._prefill_step = jax.jit(
-            partial(self._prefill_impl, cfg, attn_fn, self._col_fn, mm, mm_in),
+            partial(self._prefill_impl, cfg, attn_fn, self._col_fn, mm, mm_in, moe_impl),
             donate_argnums=(1,),
         )
         self._decode = jax.jit(
-            partial(self._decode_impl, cfg, attn_fn, self._col_fn, mm, mm_in),
+            partial(self._decode_impl, cfg, attn_fn, self._col_fn, mm, mm_in, moe_impl),
             static_argnums=(8,), donate_argnums=(1,),
         )
 
     # ------------------------------------------------------------- jitted fns
 
     @staticmethod
-    def _prefill_impl(cfg, attn_fn, col_fn, mm, mm_in, params, cache, tokens, pos_vec,
-                      active, rope):
+    def _prefill_impl(cfg, attn_fn, col_fn, mm, mm_in, moe_impl, params, cache, tokens,
+                      pos_vec, active, rope):
         logits, cache = forward(cfg, params, tokens, pos_vec, cache, rope, attn_fn,
                                 active=active, col_fn=col_fn, mm=mm, mm_in=mm_in,
-                                last_only=True)
+                                moe_impl=moe_impl, last_only=True)
         return logits[:, -1], cache
 
     @staticmethod
-    def _decode_impl(cfg, attn_fn, col_fn, mm, mm_in, params, cache, tokens, pos_vec,
-                     active, keys, temps, topps, n, rope):
+    def _decode_impl(cfg, attn_fn, col_fn, mm, mm_in, moe_impl, params, cache, tokens,
+                     pos_vec, active, keys, temps, topps, n, rope):
         def body(carry, _):
             tok, cache, p, keys = carry
             logits, cache = forward(cfg, params, tok, p, cache, rope, attn_fn,
                                     active=jnp.asarray(active), col_fn=col_fn, mm=mm,
-                                    mm_in=mm_in, last_only=True)
+                                    mm_in=mm_in, moe_impl=moe_impl, last_only=True)
             splits = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
             keys, subs = splits[:, 0], splits[:, 1]
             nxt = _sample_rows(logits[:, -1], subs, temps, topps)[:, None]

@@ -76,6 +76,7 @@ class InferenceEngine:
         layer_unroll: int | bool = 1,  # lax.scan unroll over layers
         sync: str = "bf16",  # 'bf16' (native collectives) | 'q80' (quantized exchange)
         kernels: str = "auto",  # 'auto' | 'pallas' | 'xla' matmul backend
+        moe_impl: str = "auto",  # 'auto' | 'dispatch' | 'dense' (ops.layers.moe_ffn)
     ):
         self.cfg = cfg
         self.params = params
@@ -132,7 +133,7 @@ class InferenceEngine:
             def fwd(params, cache, tokens, pos, rope_cache, last_only=False):
                 return forward(cfg, params, tokens, pos, cache, rope_cache, attn_fn,
                                unroll=layer_unroll, col_fn=col_fn, mm=mm, mm_in=mm_in,
-                               last_only=last_only)
+                               moe_impl=moe_impl, last_only=last_only)
 
         donate = (1,) if donate_cache else ()
         self._step = jax.jit(partial(self._step_impl, fwd), donate_argnums=donate)
@@ -203,6 +204,27 @@ class InferenceEngine:
     def reset(self, pos: int = 0) -> None:
         """Rewind to `pos` (prefix-cache reuse keeps cache contents ≤ pos valid)."""
         self.pos = pos
+
+    def measured_collective_report(self) -> dict:
+        """Collective bytes MEASURED from the compiled decode step's HLO (the
+        ops XLA actually emitted after SPMD partitioning), vs the analytic
+        model in utils.profiling.collective_bytes_per_token. Collectives
+        inside the layer scan are counted once per loop trip — construct the
+        engine with layer_unroll=True for exact per-token totals.
+
+        Costs one extra AOT compile of the T=1 step on first call (lower().
+        compile() does not reuse the jit executable cache); memoized after."""
+        if not hasattr(self, "_collective_report"):
+            from dllama_tpu.utils.profiling import measured_collective_bytes
+
+            tokens = jnp.zeros((self.batch, 1), jnp.int32)
+            lowered = self._step.lower(
+                self.params, self.cache, tokens, jnp.int32(0), self.rope_cache
+            )
+            self._collective_report = measured_collective_bytes(
+                lowered.compile().as_text()
+            )
+        return self._collective_report
 
     # ------------------------------------------------------------- checkpoint
 

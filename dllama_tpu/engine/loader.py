@@ -59,6 +59,8 @@ def load_model(
     dequantize: bool = False,
     max_prefill_chunk: int = 128,
     sync: str = "bf16",
+    kernels: str = "auto",
+    moe_impl: str = "auto",
 ) -> LoadedModel:
     cfg, header_size = read_header(model_path, max_seq_len)
     log.info("model: %s", cfg.describe())
@@ -83,5 +85,7 @@ def load_model(
         max_prefill_chunk=max_prefill_chunk,
         shardings=shardings,
         sync=sync,
+        kernels=kernels,
+        moe_impl=moe_impl,
     )
     return LoadedModel(cfg, engine, tokenizer, shardings, sync=sync)

@@ -72,7 +72,7 @@ from dllama_tpu.ops.quant import slice_leaf as _slice_layer
 
 
 def _layer(cfg: LlamaConfig, x, layers, li, k_cache, v_cache, rope, pos_base, attn_fn,
-           active=None, col_fn=None, mm=None, mm_in=None):
+           active=None, col_fn=None, mm=None, mm_in=None, moe_impl="auto"):
     """One decoder layer. `layers` is the full stacked params dict and `li`
     the traced layer index — quantized weights are NOT sliced here: the matmul
     dispatcher either DMA-indexes the stack (Pallas scalar prefetch) or slices
@@ -113,6 +113,7 @@ def _layer(cfg: LlamaConfig, x, layers, li, k_cache, v_cache, rope, pos_base, at
             _slice_layer(layers["moe_w1"], li),
             _slice_layer(layers["moe_w2"], li),
             _slice_layer(layers["moe_w3"], li),
+            impl=moe_impl,
         )
     else:
         gate = activation(mm(h, layers["w1"], li).astype(jnp.float32), cfg.hidden_act).astype(x.dtype)
@@ -135,6 +136,7 @@ def run_layers(
     col_fn=None,  # wo/w2 matmul override (Q80 quantized exchange)
     mm=None,  # quantized-matmul fn (x, w, layer) -> out; default ops.matmul
     mm_in=None,  # matmul for input-dim-sharded weights (see _layer)
+    moe_impl: str = "auto",  # MoE compute scheme (ops.layers.moe_ffn)
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Scan the decoder layers (any contiguous stack — the full model, or one
     pipeline stage's slice). Returns (x, k_cache, v_cache).
@@ -152,7 +154,7 @@ def run_layers(
         x = carry
         li, kc, vc = xs
         x, kc, vc = _layer(cfg, x, layer_params, li, kc, vc, rope, pos_base, attn_fn,
-                           active, col_fn, mm, mm_in)
+                           active, col_fn, mm, mm_in, moe_impl)
         return x, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(
@@ -177,6 +179,7 @@ def forward(
     col_fn=None,  # wo/w2 matmul override (Q80 quantized exchange)
     mm=None,  # quantized-matmul fn (x, w, layer) -> out; default ops.matmul
     mm_in=None,  # matmul for input-dim-sharded weights (see _layer)
+    moe_impl: str = "auto",  # MoE compute scheme (ops.layers.moe_ffn)
     last_only: bool = False,  # project logits for the last position only
 ) -> tuple[jax.Array, KVCache]:
     """Returns (logits f32 [B, T, vocab], updated cache).
@@ -200,7 +203,7 @@ def forward(
         rope = jax.lax.dynamic_slice_in_dim(rope_cache, pos_base, t, axis=0)
     x, k_new, v_new = run_layers(
         cfg, params["layers"], x, pos_base, cache.k, cache.v, rope, attn_fn, active,
-        unroll=unroll, col_fn=col_fn, mm=mm, mm_in=mm_in,
+        unroll=unroll, col_fn=col_fn, mm=mm, mm_in=mm_in, moe_impl=moe_impl,
     )
     if last_only:
         x = x[:, -1:]

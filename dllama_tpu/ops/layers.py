@@ -91,42 +91,84 @@ def apply_rope(x: jax.Array, rope: jax.Array) -> jax.Array:
     return jnp.stack([r0, r1], axis=-1).reshape(b, t, h, hs).astype(x.dtype)
 
 
+def _dense_w(w, dtype):
+    from dllama_tpu.ops.quant import QTensor
+
+    return w.dequantize(dtype) if isinstance(w, QTensor) else w.astype(dtype)
+
+
 def moe_ffn(
     cfg: LlamaConfig,
     h: jax.Array,  # [B, T, D] (already rms-normed)
     gate: jax.Array,  # router [D, E] f32
     w1, w2, w3,  # expert stacks: [E, D, F], [E, F, D], [E, D, F] (QTensor or dense)
+    impl: str = "auto",  # 'auto' | 'dispatch' | 'dense'
+    capacity_factor: float = 2.0,
 ) -> jax.Array:
     """Mixtral-style sparse MoE FFN: top-k router (softmax over the top-k
     logits), SwiGLU experts, probability-weighted combine.
 
     The reference *parses* N_EXPERTS from the header and its converter emits
     expert tensors, but the runtime has no MoE graph (SURVEY.md §2.4 — EP row);
-    this is the capability it never shipped. Compute is dense over all experts
-    (every expert runs on every token, combine weights zero the unrouted ones):
-    static shapes, no gather/scatter, and expert-axis sharding ('ep') turns the
-    expert einsums into psum-reduced partials under GSPMD. For E >> k a
-    sort-based dispatch kernel is the known next optimization.
-    """
-    from dllama_tpu.ops.quant import QTensor
+    this is the capability it never shipped.
 
+    Two compute schemes:
+    * ``dispatch`` (default for T*B >= E): GShard-style capacity-bucketed
+      dispatch — each expert processes a fixed buffer of C = ~cf*k*N/E token
+      rows (static shapes; the TPU way to be sparse), so FLOPs are O(k/E) of
+      dense. Tokens over an expert's capacity lose that expert's contribution
+      (standard switch-transformer semantics; cf=2 makes drops rare).
+    * ``dense``: every expert runs on every token, combine weights zero the
+      unrouted ones. Exact (no capacity drops) and gather-free — the
+      correctness reference, and the cheaper choice for tiny batches where
+      capacity C would equal N anyway.
+    """
     e, k = cfg.n_experts, cfg.n_active_experts
+    b, t, d = h.shape
+    n = b * t
+    if impl == "auto":
+        impl = "dispatch" if n >= e else "dense"
     logits = jnp.einsum(
         "btd,de->bte", h.astype(jnp.float32), gate.astype(jnp.float32)
     )
     topv, topi = jax.lax.top_k(logits, k)
     probs = jax.nn.softmax(topv, axis=-1)  # [B, T, k]
+
+    if impl == "dispatch":
+        import math
+
+        c = min(n, max(1, math.ceil(capacity_factor * k * n / e)))
+        if c > 8:
+            c = min(n, -(-c // 8) * 8)  # round up to the f32 sublane
+        hf = h.reshape(n, d)
+        assign = topi.reshape(-1)  # [N*k] expert ids, token-major
+        onehot = jax.nn.one_hot(assign, e, dtype=jnp.int32)
+        # arrival rank of each (token, choice) within its expert's buffer
+        rank = jnp.sum(onehot * (jnp.cumsum(onehot, axis=0) - onehot), axis=-1)
+        keep = rank < c
+        tok = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
+        ei = jnp.where(keep, assign, 0)
+        ri = jnp.where(keep, rank, 0)
+        # scatter token rows into [E, C, D] buffers; (ei, ri) pairs are unique
+        # among kept rows, dropped rows contribute zeros at (0, 0)
+        contrib = jnp.where(keep[:, None], hf[tok], 0).astype(h.dtype)
+        buf = jnp.zeros((e, c, d), h.dtype).at[ei, ri].add(contrib)
+        g = jnp.einsum("ecd,edf->ecf", buf, _dense_w(w1, h.dtype))
+        up = jnp.einsum("ecd,edf->ecf", buf, _dense_w(w3, h.dtype))
+        act = activation(g.astype(jnp.float32), cfg.hidden_act).astype(h.dtype)
+        y = jnp.einsum("ecf,efd->ecd", act * up, _dense_w(w2, h.dtype))  # [E, C, D]
+        y_tok = y[ei, ri].astype(jnp.float32)  # [N*k, D]
+        wgt = probs.reshape(-1) * keep  # dropped choices contribute nothing
+        out = jnp.zeros((n, d), jnp.float32).at[tok].add(y_tok * wgt[:, None])
+        return out.reshape(b, t, d).astype(h.dtype)
+
     weights = jnp.sum(
         jax.nn.one_hot(topi, e, dtype=probs.dtype) * probs[..., None], axis=-2
     )  # [B, T, E]
-
-    def dense(w):
-        return w.dequantize(h.dtype) if isinstance(w, QTensor) else w.astype(h.dtype)
-
-    g = jnp.einsum("btd,edf->btef", h, dense(w1))
-    up = jnp.einsum("btd,edf->btef", h, dense(w3))
+    g = jnp.einsum("btd,edf->btef", h, _dense_w(w1, h.dtype))
+    up = jnp.einsum("btd,edf->btef", h, _dense_w(w3, h.dtype))
     act = activation(g.astype(jnp.float32), cfg.hidden_act).astype(h.dtype)
-    y = jnp.einsum("btef,efd->bted", act * up, dense(w2))
+    y = jnp.einsum("btef,efd->bted", act * up, _dense_w(w2, h.dtype))
     out = jnp.einsum("bted,bte->btd", y.astype(jnp.float32), weights)
     return out.astype(h.dtype)
 

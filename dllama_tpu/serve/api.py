@@ -10,7 +10,8 @@ The **prefix cache** reproduces NaiveCache (dllama-api.cpp:264-309): the chat
 history from the previous request is kept with its KV-cache position; when a
 new request's messages extend the cached ones, only the delta is encoded and
 prefilled — the engine rewinds to the cached position instead of replaying
-the whole conversation.
+the whole conversation. The continuous-batching tier has the same capability
+per slot, at the token level, inside serve/scheduler.Scheduler.
 
 Built on stdlib http.server (the reference hand-rolls HTTP/1.1 the same
 spirit, dllama-api.cpp:104-179); requests are serialized with a lock because
@@ -182,8 +183,10 @@ class ApiServer:
                           extra_stops, emit, seed=None) -> dict:
         """Continuous-batching completion: submit to the scheduler, stream from
         the per-request queue. Per-request `seed` pins the slot's own PRNG
-        stream (reproducible regardless of batch-mates). No server-side prefix
-        cache in this tier (slots are recycled across conversations)."""
+        stream (reproducible regardless of batch-mates). Prefix reuse lives in
+        the scheduler here (token-level per-slot cache, Scheduler._pick_slot)
+        rather than in this handler — a multi-turn conversation prefills only
+        its delta whenever an idle slot still holds the matching rows."""
         generated = self.template.generate(
             [ChatItem(r, c) for r, c in messages], append_generation_prompt=True
         )

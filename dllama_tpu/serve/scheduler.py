@@ -10,6 +10,15 @@ only for chunk boundaries.
 Token-level stops (EOS ids, budget) are handled here; *string* stop sequences
 need decoded text, so the request handler runs its EosDetector on the stream
 and calls cancel() — generation overruns by at most one chunk.
+
+**Per-slot prefix cache** (the batched-tier NaiveCache, dllama-api.cpp:264-309):
+released slots keep their KV rows and the token history that produced them.
+Admission matches a new request's prompt against every idle slot's history and
+prefills only the delta from the matched position (BatchEngine.add's
+start_pos) — the second turn of a conversation re-encodes the whole chat but
+only computes the new tokens. Matching is at the TOKEN level, which subsumes
+the reference's whole-message matching: any retokenization drift just means
+no reuse, never wrong output (rows past the matched position are rewritten).
 """
 
 from __future__ import annotations
@@ -78,6 +87,10 @@ class Scheduler:
         self.admit_timeout = admit_timeout
         self.pending: queue.Queue[Request] = queue.Queue()
         self.slots: dict[int, Request] = {}
+        # per-slot token history whose KV rows are live (prefix-cache key);
+        # len(slot_tokens[s]) always == engine.pos[s] for idle slots
+        self.slot_tokens: dict[int, list[int]] = {}
+        self.reused_prefix_tokens = 0  # total prompt tokens served from cache
         self._completed: list[Request] = []  # ring of recent requests (metrics)
         self._metrics_lock = threading.Lock()
         self._wake = threading.Event()
@@ -106,6 +119,7 @@ class Scheduler:
             "completed": len(done),
             "ttft_ms_mean": mean(ttfts),
             "itl_ms_mean": mean(itls),
+            "reused_prefix_tokens": self.reused_prefix_tokens,
         }
 
     def cancel(self, req: Request) -> None:
@@ -122,6 +136,12 @@ class Scheduler:
     def _finish(self, req: Request, reason: str, keep_rows: int | None = None) -> None:
         if req.slot >= 0:
             self.engine.release(req.slot, keep_rows)
+            if keep_rows is not None:
+                # only the first keep_rows tokens have live KV rows (the last
+                # emitted token was sampled but never fed back)
+                self.slot_tokens[req.slot] = self.slot_tokens.get(req.slot, [])[:keep_rows]
+            else:
+                self.slot_tokens[req.slot] = []  # unknown state: never reuse
             self.slots.pop(req.slot, None)
             req.slot = -1
         req.finish_reason = req.finish_reason or reason
@@ -137,6 +157,8 @@ class Scheduler:
             req.first_token_at = time.monotonic()
         req.out.put(int(token))
         req.produced += 1
+        if req.slot >= 0:
+            self.slot_tokens.setdefault(req.slot, []).append(int(token))
         if token in req.eos_ids:
             self._finish(req, "stop", keep_rows=row_at_emit)
             return True
@@ -145,10 +167,28 @@ class Scheduler:
             return True
         return False
 
+    def _pick_slot(self, prompt: list[int]) -> tuple[int | None, int]:
+        """(slot, reusable_prefix_len): the idle slot whose cached token
+        history shares the longest full prefix with `prompt`; with no match,
+        the idle slot holding the least cached state (evict the cheapest)."""
+        idle = [s for s in range(self.engine.n_slots) if not self.engine.active[s]]
+        if not idle:
+            return None, 0
+        best, best_len = None, 0
+        for s in idle:
+            cached = self.slot_tokens.get(s, [])
+            # reusable rows = longest shared prefix, capped so at least one
+            # prompt token remains to prefill (stale rows past it are masked)
+            n = min(len(cached), len(prompt) - 1)
+            if n > best_len and prompt[:n] == cached[:n]:
+                best, best_len = s, n
+        if best is not None:
+            return best, best_len
+        return min(idle, key=lambda s: len(self.slot_tokens.get(s, []))), 0
+
     def _admit(self) -> None:
         while not self.pending.empty():
-            slot = self.engine.free_slot()
-            if slot is None:
+            if self.engine.free_slot() is None:
                 return
             try:
                 req = self.pending.get_nowait()
@@ -158,13 +198,19 @@ class Scheduler:
                 req.finish_reason = "cancelled"
                 req.out.put(_END)
                 continue
+            slot, reuse = self._pick_slot(req.prompt)
             try:
-                first = self.engine.add(slot, req.prompt, req.temperature, req.topp,
-                                        seed=req.seed)
+                first = self.engine.add(slot, req.prompt[reuse:], req.temperature,
+                                        req.topp, start_pos=reuse, seed=req.seed)
             except Exception as e:  # bad request (too long, …) — fail just this one
                 log.exception("prefill failed")
+                # the failed prefill may have overwritten rows past start_pos:
+                # the old history no longer describes the slot's KV contents
+                self.slot_tokens[slot] = []
                 req.out.put(e)
                 continue
+            self.reused_prefix_tokens += reuse
+            self.slot_tokens[slot] = list(req.prompt)
             req.slot = slot
             self.slots[slot] = req
             self._emit(req, first, int(self.engine.pos[slot]))

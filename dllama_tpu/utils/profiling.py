@@ -102,6 +102,62 @@ def collective_bytes_per_token(cfg, tp: int = 1, sp: int = 1, exchange_bytes: fl
     }
 
 
+_COLLECTIVE_OPS = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute", "all-to-all"
+)
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "pred": 1,
+}
+
+
+def measured_collective_bytes(compiled_text: str) -> dict:
+    """MEASURED inter-chip bytes: sum the result shapes of every collective op
+    in a compiled (post-SPMD-partitioning) HLO module — the real ops XLA
+    emitted, not the analytic model. The reference counts actual socket bytes
+    (nn-network.cpp:483-492); this is the compiled-program equivalent on ICI.
+
+    Pass ``jitted.lower(*args).compile().as_text()``. Collectives inside a
+    ``while`` loop (e.g. the layer scan) appear once in the text but run once
+    per iteration — lower the step with ``layer_unroll=True`` for exact
+    per-token totals, or treat the result as bytes *per loop trip*.
+    """
+    import re
+
+    per_op: dict[str, int] = {}
+    # e.g.:  %all-reduce.7 = bf16[1,2048]{1,0:T(8,128)} all-reduce(...
+    # (the shape group is lazy-greedy so TPU tiled layouts like
+    # {1,0:T(8,128)S(1)} are spanned). Async collectives appear as
+    # -start/-done pairs: count the -start (it carries the shapes), skip the
+    # -done (it aliases the same transfer).
+    pat = re.compile(
+        r"=\s*(.+?)\s+(" + "|".join(_COLLECTIVE_OPS) + r")(-start|-done)?[\.\(]"
+    )
+    shape_pat = re.compile(r"([a-z]+[0-9a-z]*)\[([0-9,]*)\]")
+    for line in compiled_text.splitlines():
+        m = pat.search(line)
+        if not m or m.group(3) == "-done":
+            continue
+        shapes, op = m.group(1), m.group(2)
+        found = shape_pat.findall(shapes)
+        if m.group(3) == "-start" and len(found) > 1:
+            # -start results are (aliased input, output, ...) tuples — only
+            # the output element is a transfer
+            found = found[-1:]
+        nbytes = 0
+        for dt, dims in found:
+            if dt not in _DTYPE_BYTES:
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            nbytes += n * _DTYPE_BYTES[dt]
+        per_op[op] = per_op.get(op, 0) + nbytes
+    return {"total_bytes": sum(per_op.values()), "per_op": per_op}
+
+
 def params_nbytes(params) -> int:
     return sum(
         x.size * x.dtype.itemsize for x in jax.tree.leaves(params) if hasattr(x, "size")

@@ -137,3 +137,49 @@ def test_scheduler_latency_metrics():
         assert agg["ttft_ms_mean"] == pytest.approx(req.ttft_ms)
     finally:
         sched.shutdown()
+
+
+def test_scheduler_prefix_cache_reuses_slot_rows():
+    """Second turn of a conversation prefills only the delta (VERDICT r2 #6):
+    the slot's kept KV rows are matched by token prefix and BatchEngine.add
+    starts from the cached position — and the continuation is identical to a
+    cold prefill of the full prompt."""
+    import jax.numpy as jnp
+
+    from dllama_tpu.engine.batch import BatchEngine
+    from dllama_tpu.models.config import LlamaConfig
+    from dllama_tpu.models.llama import random_params
+    from dllama_tpu.serve.scheduler import Scheduler
+
+    cfg = LlamaConfig(dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                      vocab_size=96, seq_len=64)
+    params = random_params(cfg, seed=2, dtype=jnp.float32, quantize=False)
+
+    turn1 = [1, 2, 3, 4, 5]
+
+    def run_turn2(sched, turn2):
+        req = sched.submit(turn2, 0.0, 0.9, 4, eos_ids=frozenset(), seed=0)
+        return list(req.tokens())
+
+    # warm scheduler: turn 1 completes, then turn 2 extends it
+    eng = BatchEngine(cfg, params, n_slots=2, cache_dtype=jnp.float32)
+    sched = Scheduler(eng, chunk=4)
+    try:
+        r1 = sched.submit(turn1, 0.0, 0.9, 4, eos_ids=frozenset(), seed=0)
+        gen1 = list(r1.tokens())
+        # the conversation so far, as its KV rows saw it (last token unfed)
+        fed = turn1 + gen1[:-1]
+        turn2 = turn1 + gen1 + [7, 8]
+        warm = run_turn2(sched, turn2)
+        assert sched.reused_prefix_tokens == len(fed)
+        # cold engine: full prefill of the same turn-2 prompt
+        eng2 = BatchEngine(cfg, params, n_slots=2, cache_dtype=jnp.float32)
+        sched2 = Scheduler(eng2, chunk=4)
+        try:
+            cold = run_turn2(sched2, turn2)
+            assert sched2.reused_prefix_tokens == 0
+        finally:
+            sched2.shutdown()
+        assert warm == cold
+    finally:
+        sched.shutdown()

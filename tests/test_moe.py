@@ -154,3 +154,36 @@ def test_hf_moe_tensor_stacking():
     x = hf_tensor_for("layers.0.moe_w1", cfg, store.__getitem__)
     assert x.shape == (2, cfg.hidden_dim, cfg.dim)
     assert x[1].min() == 1.0 and x[0].max() == 0.0
+
+
+def test_moe_dispatch_matches_dense_when_capacity_suffices(rng):
+    """The O(k) dispatch path must agree with the dense reference whenever no
+    token exceeds expert capacity (cf = E/k makes C = N: drop-free)."""
+    cfg = moe_cfg(experts=8, active=2)
+    h = jnp.asarray(rng.standard_normal((2, 8, cfg.dim)), jnp.float32)
+    gate = jnp.asarray(rng.standard_normal((cfg.dim, 8)), jnp.float32)
+    ws = [jnp.asarray(rng.standard_normal(s), jnp.float32) * 0.1
+          for s in [(8, cfg.dim, cfg.hidden_dim), (8, cfg.hidden_dim, cfg.dim),
+                    (8, cfg.dim, cfg.hidden_dim)]]
+    got = moe_ffn(cfg, h, gate, *ws, impl="dispatch", capacity_factor=8 / 2)
+    want = moe_ffn(cfg, h, gate, *ws, impl="dense")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4)
+
+
+def test_moe_dispatch_capacity_drop_semantics(rng):
+    """Tokens beyond an expert's capacity lose that expert's contribution
+    (switch-transformer semantics): route everything to expert 0 with k=1 and
+    a tight capacity — the first C tokens match dense, the rest are zero."""
+    cfg = moe_cfg(experts=4, active=1)
+    n = 8
+    # positive activations so the all-ones gate column wins for every token
+    h = jnp.asarray(np.abs(rng.standard_normal((1, n, cfg.dim))), jnp.float32)
+    gate = jnp.zeros((cfg.dim, 4), jnp.float32).at[:, 0].set(1.0)  # all -> e0
+    ws = [jnp.asarray(rng.standard_normal(s), jnp.float32) * 0.1
+          for s in [(4, cfg.dim, cfg.hidden_dim), (4, cfg.hidden_dim, cfg.dim),
+                    (4, cfg.dim, cfg.hidden_dim)]]
+    got = np.asarray(moe_ffn(cfg, h, gate, *ws, impl="dispatch", capacity_factor=1.0))
+    dense = np.asarray(moe_ffn(cfg, h, gate, *ws, impl="dense"))
+    c = 2  # ceil(1 * 1 * 8 / 4)
+    np.testing.assert_allclose(got[0, :c], dense[0, :c], atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(got[0, c:], 0.0, atol=1e-6)

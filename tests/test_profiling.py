@@ -45,3 +45,45 @@ def test_memory_report(rng=np.random.default_rng(0)):
     cache = KVCache.create(cfg, 1)
     rep = profiling.memory_report(cfg, params, cache)
     assert "params" in rep and "kv-cache" in rep and "GB" in rep
+
+
+def test_measured_collective_bytes_tp_step():
+    """The compiled tp=4 decode step must contain real collectives whose
+    summed bytes are nonzero; the unsharded step must contain none."""
+    import jax.numpy as jnp
+
+    from dllama_tpu.engine.engine import InferenceEngine
+    from dllama_tpu.models.config import LlamaConfig
+    from dllama_tpu.models.llama import random_params
+    from dllama_tpu.parallel.mesh import MeshConfig, make_mesh
+    from dllama_tpu.parallel.sharding import LlamaShardings
+
+    cfg = LlamaConfig(dim=128, hidden_dim=256, n_layers=2, n_heads=4, n_kv_heads=4,
+                      vocab_size=256, seq_len=32)
+    params = random_params(cfg, seed=0, dtype=jnp.float32, quantize=True)
+
+    solo = InferenceEngine(cfg, params, cache_dtype=jnp.float32)
+    assert solo.measured_collective_report()["total_bytes"] == 0
+
+    mesh = make_mesh(MeshConfig(tp=4))
+    sh = LlamaShardings(mesh, cfg)
+    eng = InferenceEngine(cfg, params, cache_dtype=jnp.float32, shardings=sh)
+    meas = eng.measured_collective_report()
+    assert meas["total_bytes"] > 0
+    assert meas["per_op"]  # at least one collective kind identified
+
+
+def test_measured_collective_bytes_parser():
+    from dllama_tpu.utils import profiling
+
+    text = """
+  %ar = bf16[1,2048]{1,0:T(8,128)} all-reduce(bf16[1,2048]{1,0} %x), replica_groups={}
+  %ags = (f32[256]{0}, f32[1024]{0:T(8)S(1)}) all-gather-start(f32[256]{0} %y), dimensions={0}
+  %agd = f32[1024]{0} all-gather-done((f32[256]{0}, f32[1024]{0}) %ags)
+  %other = f32[8]{0} add(f32[8]{0} %a, f32[8]{0} %b)
+"""
+    out = profiling.measured_collective_bytes(text)
+    assert out["per_op"]["all-reduce"] == 2048 * 2  # TPU tiled layout spanned
+    assert out["per_op"]["all-gather"] == 1024 * 4  # -start input alias skipped
+    assert "all-gather-done" not in out["per_op"]
+    assert out["total_bytes"] == 2048 * 2 + 1024 * 4
