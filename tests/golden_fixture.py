@@ -1,0 +1,91 @@
+"""Deterministic golden fixtures: an in-repo byte-level BPE in the llama3
+tiktoken format, and a tiny seeded `.m` checkpoint.
+
+The reference pins encode goldens against the real llama3 vocabulary
+(tokenizer-test.cpp:44-80, gated behind DEV_TESTS because it needs the 128k
+vocab file). This environment has no network, so the fixture vocabulary is
+*trained here* — classic byte-pair merging over a fixed multilingual corpus
+(ASCII, UTF-8 accents, CJK, emoji — the same stress classes as the reference
+cases) with deterministic tie-breaking. The training is pure arithmetic: the
+resulting ranks are stable across platforms/versions, so the golden token ids
+in test_golden.py pin the WHOLE pipeline — tiktoken-format parsing
+(convert_llama3_tokenizer), score assignment, special-token scan, and the
+heap-BPE merge order (python and native) — exactly like the reference's
+dev tests pin its tokenizer.cpp.
+"""
+
+from __future__ import annotations
+
+import base64
+
+CORPUS = (
+    "The quick brown fox jumps over the lazy dog. "
+    "Pack my box with five dozen liquor jugs!? "
+    "user assistant system header the and ing er est ly tion "
+    "hello world hello there what is the meaning of life? "
+    "<|start_header_id|>user<|end_header_id|> nonsense plain text form "
+    "!!&&@(*x)^^! punctuation (parens) [brackets] {braces} *stars* "
+    "Zwölf Boxkämpfer jagen Viktor quer über den großen Sylter Deich. "
+    "Voyez le brick géant que j'examine près du wharf. "
+    "Стремглав наш банк грозит, вчуже объём. "
+    "色は匂へど 散りぬるを 我が世誰ぞ 常ならむ "
+    "天地玄黄 宇宙洪荒 日月盈昃 辰宿列张 "
+    "😃!😇x 😀😃😄😁 🚀🌍✨ ❤️🔥 "
+    "numbers 0123456789 12345 3.14159 2026-07-30 "
+).encode("utf-8") * 2
+
+
+def train_bpe(corpus: bytes = CORPUS, n_merges: int = 700) -> list[bytes]:
+    """Greedy byte-pair merging; ties broken by smallest pair bytes. Returns
+    the rank-ordered vocab: 256 single bytes, then one token per merge."""
+    seq: list[bytes] = [bytes([b]) for b in corpus]
+    vocab: list[bytes] = [bytes([i]) for i in range(256)]
+    for _ in range(n_merges):
+        counts: dict[tuple[bytes, bytes], int] = {}
+        for a, b in zip(seq, seq[1:]):
+            counts[(a, b)] = counts.get((a, b), 0) + 1
+        if not counts:
+            break
+        pair = min(counts, key=lambda p: (-counts[p], p))
+        if counts[pair] < 2:
+            break
+        merged = pair[0] + pair[1]
+        vocab.append(merged)
+        out: list[bytes] = []
+        i = 0
+        while i < len(seq):
+            if i + 1 < len(seq) and seq[i] == pair[0] and seq[i + 1] == pair[1]:
+                out.append(merged)
+                i += 2
+            else:
+                out.append(seq[i])
+                i += 1
+        seq = out
+    return vocab
+
+
+def write_tiktoken_file(path: str, vocab: list[bytes] | None = None) -> None:
+    """The llama3 `tokenizer.model` wire format: `base64(token) rank` lines."""
+    vocab = vocab or train_bpe()
+    with open(path, "w", encoding="utf-8") as f:
+        for rank, token in enumerate(vocab):
+            f.write(f"{base64.b64encode(token).decode()} {rank}\n")
+
+
+def naive_bpe_encode(vocab: list[bytes], scores: list[float], data: bytes) -> list[int]:
+    """Independent O(n^2) reference encoder: seed with the longest-prefix
+    single-byte path, then repeatedly apply the single best-scoring merge.
+    Used as a differential oracle against the production heap/native BPE."""
+    index = {v: i for i, v in enumerate(vocab)}
+    toks = [index[bytes([b])] for b in data]
+    while True:
+        best = None
+        for j in range(len(toks) - 1):
+            tid = index.get(vocab[toks[j]] + vocab[toks[j + 1]])
+            if tid is not None and (best is None or scores[tid] > best[0]):
+                best = (scores[tid], tid, j)
+        if best is None:
+            break
+        _, tid, j = best
+        toks[j : j + 2] = [tid]
+    return toks

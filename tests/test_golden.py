@@ -1,0 +1,132 @@
+"""Golden-artifact tests — the reference's dev tokenizer tests and macbeth.sh
+determinism check, runnable offline (see tests/golden_fixture.py for why the
+vocabulary is trained in-repo).
+
+* encode goldens pin exact token ids for the reference's own test strings
+  (tokenizer-test.cpp:44-80's case0/1/2 shapes: chat headers between special
+  tokens, dense punctuation, emoji split across tokens) through the REAL
+  llama3-tiktoken converter path (convert_llama3_tokenizer).
+* a differential oracle checks the production BPE (python heap loop and the
+  native C++ one, whichever is active) against an independent O(n^2) encoder
+  on multilingual + random-bytes input.
+* a committed tiny `.m` (tests/fixtures/golden_tiny.m, seed 20260730) pins a
+  temperature-0 continuation — the macbeth.sh analog: fails if the file
+  format, Q40 numerics, or the forward pass drift between rounds.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from tests.golden_fixture import naive_bpe_encode, train_bpe, write_tiktoken_file
+
+FIXTURE_M = os.path.join(os.path.dirname(__file__), "fixtures", "golden_tiny.m")
+
+# reference test strings (tokenizer-test.cpp:48-66) under the in-repo vocab
+GOLDEN_ENCODES = {
+    "<|start_header_id|>user<|end_header_id|>\n\nhello<|eot_id|>"
+    "<|start_header_id|>assistant<|end_header_id|>\n\n": [
+        801, 807, 330, 256, 808, 10, 10, 320, 810, 807,
+        97, 115, 115, 105, 265, 268, 116, 808, 10, 10,
+    ],
+    "!!&&@(*x)^^!": [801, 33, 33, 38, 38, 64, 40, 42, 120, 41, 94, 94, 33],
+    "\U0001f603!\U0001f607x": [801, 263, 131, 33, 263, 135, 120],
+    "Zwölf Boxkämpfer": [
+        801, 90, 119, 195, 182, 108, 102, 342, 287, 107, 195, 164, 327, 102, 256,
+    ],
+    "天地玄黄": [
+        801, 229, 164, 169, 229, 156, 176, 231, 142, 132, 233, 187, 132,
+    ],
+}
+
+GOLDEN_PROMPT = [801, 799, 777, 46]
+GOLDEN_CONTINUATION = [573, 932, 583, 990, 121, 209, 314, 633, 274, 831,
+                       499, 615, 643, 349, 143, 357]
+
+
+@pytest.fixture(scope="module")
+def llama3_tok(tmp_path_factory):
+    from dllama_tpu.tools.convert_tokenizer import convert_llama3_tokenizer
+
+    path = tmp_path_factory.mktemp("golden") / "tokenizer.model"
+    write_tiktoken_file(str(path))
+    return convert_llama3_tokenizer(str(path))
+
+
+def test_vocab_is_deterministic():
+    v = train_bpe()
+    assert len(v) == 801
+    assert v[256:260] == [b"er", b"e ", b"\xf0\x9f", b"er "]  # first merges pinned
+
+
+def test_golden_encodes(llama3_tok):
+    for text, want in GOLDEN_ENCODES.items():
+        got = llama3_tok.encode(text, add_bos=True, add_special_tokens=True)
+        assert got == want, f"{text!r}: {got} != {want}"
+
+
+def test_golden_roundtrip_through_t_file(llama3_tok, tmp_path):
+    """Converter output -> .t file -> runtime load must preserve encodes and
+    chat-eos detection (the converter-vs-runtime agreement VERDICT r2 #3)."""
+    from dllama_tpu.tokenizer.tokenizer import Tokenizer
+
+    path = tmp_path / "golden.t"
+    llama3_tok.save(str(path))
+    tok2 = Tokenizer.load(str(path))
+    for text, want in GOLDEN_ENCODES.items():
+        assert tok2.encode(text, add_bos=True, add_special_tokens=True) == want
+    assert tok2.is_eos(810)  # <|eot_id|>
+    assert tok2.bos_id == 801
+
+
+def test_streaming_decoder_emoji(llama3_tok):
+    """dev_testDecoderEmoji semantics: partial UTF-8 buffers across tokens,
+    complete codepoints flush (tokenizer-test.cpp:72-90)."""
+    llama3_tok.reset_decoder()
+    ids = llama3_tok.encode("\U0001f603!\U0001f607x", add_bos=False)
+    pieces = [llama3_tok.decode(t) for t in ids]
+    assert pieces == [None, "\U0001f603", "!", None, "\U0001f607", "x"]
+    assert llama3_tok.decode_all(ids) == "\U0001f603!\U0001f607x"
+
+
+def test_production_bpe_matches_independent_oracle(llama3_tok):
+    """Differential test: the production encoder (heap BPE; native C++ when
+    loaded) against the O(n^2) oracle, on text AND raw random bytes."""
+    rng = np.random.default_rng(0)
+    samples = [
+        "hello world, the meaning of life!",
+        "éèê 宴会 \U0001f680\U0001f30d",
+        "mixed 12345 !!&& über",
+    ]
+    vocab_n = llama3_tok.regular_vocab_size
+    scores = llama3_tok.scores
+    for s in samples:
+        data = s.encode("utf-8")
+        want = naive_bpe_encode(list(llama3_tok.vocab[:vocab_n]), scores, data)
+        got = llama3_tok.encode(s, add_bos=False, add_special_tokens=False)
+        assert got == want, s
+    for _ in range(5):
+        data = bytes(rng.integers(0, 256, 64, dtype=np.uint8))
+        want = naive_bpe_encode(list(llama3_tok.vocab[:vocab_n]), scores, data)
+        got = llama3_tok.encode(data, add_bos=False, add_special_tokens=False)
+        assert got == want
+
+
+def test_golden_model_temp0_continuation():
+    """macbeth.sh analog: the committed .m + greedy decode must reproduce the
+    pinned continuation bit-for-bit (CPU: CI's platform)."""
+    import jax.numpy as jnp
+
+    from dllama_tpu.engine.engine import InferenceEngine
+    from dllama_tpu.models import formats
+
+    assert jax.devices()[0].platform == "cpu"
+    cfg, hs = formats.read_header(FIXTURE_M)
+    params = formats.load_params(FIXTURE_M, cfg, hs)
+    eng = InferenceEngine(cfg, params, cache_dtype=jnp.float32)
+    logits = eng.prefill(np.asarray([GOLDEN_PROMPT], np.int32))
+    tok = int(np.argmax(np.asarray(logits)[0]))
+    ids = [tok] + [int(t) for t in eng.decode_greedy_n(np.array([[tok]]), 15)[:, 0]]
+    assert ids == GOLDEN_CONTINUATION
