@@ -20,6 +20,8 @@ Env knobs:
                        8b batched sweep, budget permitting
   BENCH_SLOTS          comma list for the batched sweep (default '8,32')
   BENCH_DECODE_TOKENS  timed fused-decode length (default 128)
+  BENCH_KERNELS        auto (default) | pallas | xla — engine matmul backend
+  BENCH_Q40_STYLE      auto (default) | deq | blockdot — Pallas decode kernel
   BENCH_UNROLL         lax.scan unroll over layers: int, or 'full' (default 1)
   BENCH_BUDGET_S       total wall-clock budget for the parent (default 840 —
                        fits under the driver's `timeout 900 python bench.py`)
@@ -42,6 +44,9 @@ def _cpu_env():
     env = dict(os.environ)
     env["PYTHONPATH"] = ""  # skip the axon sitecustomize entirely
     env["JAX_PLATFORMS"] = "cpu"
+    # the fallback must stay cheap and honest: no Pallas-interpret on CPU
+    env.pop("BENCH_KERNELS", None)
+    env.pop("BENCH_Q40_STYLE", None)
     return env
 
 
@@ -175,7 +180,8 @@ def bench_engine(cfg, params, n_decode, unroll, prompt_len=512):
     import jax.numpy as jnp
 
     eng = InferenceEngine(cfg, params, cache_dtype=jnp.bfloat16,
-                          max_prefill_chunk=512, layer_unroll=unroll)
+                          max_prefill_chunk=512, layer_unroll=unroll,
+                          kernels=os.environ.get("BENCH_KERNELS", "auto"))
     prompt_len = min(prompt_len, cfg.seq_len // 2)
     prompt = (np.arange(1, prompt_len + 1, dtype=np.int32)[None]) % cfg.vocab_size
     t0 = time.perf_counter()
@@ -226,7 +232,8 @@ def bench_batched(cfg, params, slots, n_decode=64):
     import jax.numpy as jnp
 
     eng = BatchEngine(cfg, params, n_slots=slots, cache_dtype=jnp.bfloat16,
-                      max_prefill_chunk=64)
+                      max_prefill_chunk=64,
+                      kernels=os.environ.get("BENCH_KERNELS", "auto"))
     rng = np.random.default_rng(0)
     t0 = time.perf_counter()
     for s in range(slots):
@@ -269,6 +276,14 @@ def worker():
                 f"BENCH_PRESET must be 'all' or one of {sorted(PRESETS)}, got {name!r}"
             )
 
+    q40_style = os.environ.get("BENCH_Q40_STYLE", "auto")
+    if q40_style not in ("auto", "deq", "blockdot"):
+        raise SystemExit(f"BENCH_Q40_STYLE must be auto|deq|blockdot, got {q40_style!r}")
+    if q40_style != "auto":
+        from dllama_tpu.ops.pallas import q40_matmul as _qmod
+
+        _qmod.STYLE = q40_style
+
     dev = jax.devices()[0]
     results = {}
     batch_results = []
@@ -284,11 +299,17 @@ def worker():
         t0 = time.perf_counter()
         params = random_params_fast(cfg, seed=0, dtype=jnp.bfloat16)
         setup_s += time.perf_counter() - t0
-        r = bench_engine(cfg, params, n_decode, unroll)
-        results[name] = r
-        north = 1000.0 * (8.03e9 / (r["params_b"] * 1e9))
-        if r["decode_tok_s"] / north > best[0]:
-            best = (r["decode_tok_s"] / north, f"{LABELS[name]} batch=1 decode", r["decode_tok_s"])
+        north = 1000.0 * (8.03e9 / params_count(cfg))
+        try:
+            r = bench_engine(cfg, params, n_decode, unroll)
+            results[name] = r
+            if r["decode_tok_s"] / north > best[0]:
+                best = (r["decode_tok_s"] / north, f"{LABELS[name]} batch=1 decode",
+                        r["decode_tok_s"])
+        except Exception as e:  # keep other configs' numbers (e.g. kernel
+            # compile failure on one tier must not zero the whole record)
+            print(f"preset {name} failed: {e!r}"[:500], file=sys.stderr)
+            results[name] = {"error": repr(e)[:200]}
         # batched sweep on the LAST preset (the 8B north-star config), while
         # its params are live; skip slots we no longer have budget for
         if name == run_presets[-1] and name != "tiny":
@@ -296,7 +317,12 @@ def worker():
                 if time.monotonic() > deadline - 120:
                     batch_results.append({"slots": slots, "skipped": "budget"})
                     continue
-                br = bench_batched(cfg, params, slots)
+                try:
+                    br = bench_batched(cfg, params, slots)
+                except Exception as e:
+                    print(f"batched slots={slots} failed: {e!r}"[:500], file=sys.stderr)
+                    batch_results.append({"slots": slots, "error": repr(e)[:200]})
+                    continue
                 br["preset"] = name
                 batch_results.append(br)
                 if br["agg_tok_s"] / north > best[0]:
@@ -306,6 +332,11 @@ def worker():
     # bytes/token is part of the benchmark contract (SURVEY.md §5.1/§6): on
     # one chip it's 0; multi-chip runs report the analytic ICI payload.
     from dllama_tpu.utils.profiling import collective_bytes_per_token
+
+    if not best[1]:
+        # every config failed: no JSON — the parent falls back to the honest
+        # CPU record instead of publishing a success-shaped 0.0
+        raise SystemExit("all bench configs failed; see stderr")
 
     cfg8 = LlamaConfig(**PRESETS[run_presets[-1]])
     kb = collective_bytes_per_token(cfg8, tp=jax.device_count())["kb_per_token_per_chip"]
@@ -319,6 +350,8 @@ def worker():
         "device": str(dev),
         "setup_s": round(setup_s, 1),
         "unroll": unroll_env,
+        "kernels": os.environ.get("BENCH_KERNELS", "auto"),
+        "q40_style": q40_style,
         "kb_per_token_per_chip": round(kb, 1),
     }
     print(json.dumps(result))

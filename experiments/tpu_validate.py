@@ -1,0 +1,103 @@
+"""One-shot TPU validation of every Pallas kernel path — run when the axon
+tunnel is alive; designed to finish inside a short window (tiny shapes, few
+compiles, one process).
+
+Checks, each vs the XLA reference:
+  1. q40_matmul blockdot (m=8 decode) on a stacked weight + layer index
+  2. q40_matmul deq (m=128 prefill) on the same stacked weight
+  3. flash attention with KV-tile pruning at a small pos in a long cache
+  4. a 2-layer tiny engine end-to-end greedy parity (pallas vs xla)
+
+Prints PASS/FAIL per item; exits nonzero on any FAIL.
+"""
+import sys
+import time
+
+import numpy as np
+
+t_start = time.time()
+import jax
+import jax.numpy as jnp
+
+print("devices:", jax.devices(), flush=True)
+
+from dllama_tpu.ops.pallas import q40_matmul as qmod
+from dllama_tpu.ops.quant import QTensor
+
+failures = []
+
+
+def check(name, got, want, atol=3e-2, rtol=3e-2):
+    try:
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            atol=atol, rtol=rtol,
+        )
+        print(f"PASS {name} ({time.time() - t_start:.0f}s)", flush=True)
+    except Exception as e:
+        failures.append(name)
+        print(f"FAIL {name}: {str(e)[:300]}", flush=True)
+
+
+rng = np.random.default_rng(0)
+L, K, N = 2, 512, 512
+ws = [QTensor.quantize((rng.standard_normal((K, N)) * 0.05).astype(np.float32)) for _ in range(L)]
+stacked = QTensor(jnp.stack([w.packed for w in ws]), jnp.stack([w.scales for w in ws]))
+wd1 = ws[1].dequantize(jnp.float32)
+
+_interp = jax.devices()[0].platform != "tpu"
+for style, m in (("blockdot", 8), ("deq", 128)):
+    x = jnp.asarray(rng.standard_normal((m, K)), jnp.bfloat16)
+    qmod.STYLE = style
+    try:
+        got = qmod.q40_matmul(x, stacked, layer=jnp.int32(1), interpret=_interp)
+        want = jnp.dot(x, wd1.astype(jnp.bfloat16), preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+        check(f"q40 {style} m={m}", got, want)
+    except Exception as e:
+        failures.append(style)
+        print(f"FAIL q40 {style} m={m} (compile/run): {str(e)[:400]}", flush=True)
+    finally:
+        qmod.STYLE = "auto"
+
+# flash attention with pruning
+from dllama_tpu.ops.layers import gqa_attention
+from dllama_tpu.ops.pallas.flash_attention import flash_gqa_attention
+
+q = jnp.asarray(rng.standard_normal((1, 1, 8, 64)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((1, 4, 1024, 64)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((1, 4, 1024, 64)), jnp.bfloat16)
+try:
+    got = flash_gqa_attention(q, k, v, jnp.int32(3), interpret=_interp)
+    check("flash pruned pos=3 S=1024", got, gqa_attention(q, k, v, jnp.int32(3)))
+except Exception as e:
+    failures.append("flash")
+    print(f"FAIL flash (compile/run): {str(e)[:400]}", flush=True)
+
+# end-to-end tiny engine parity
+from dllama_tpu.engine.engine import InferenceEngine
+from dllama_tpu.models.config import LlamaConfig
+from dllama_tpu.models.llama import random_params
+
+cfg = LlamaConfig(dim=256, hidden_dim=512, n_layers=2, n_heads=4, n_kv_heads=2,
+                  vocab_size=512, seq_len=128)
+params = random_params(cfg, seed=1, dtype=jnp.bfloat16, quantize=True)
+prompt = np.arange(1, 9, dtype=np.int32)[None]
+try:
+    outs = {}
+    for kern in ("pallas", "xla"):
+        eng = InferenceEngine(cfg, params, cache_dtype=jnp.bfloat16, kernels=kern)
+        eng.prefill(prompt)
+        outs[kern] = [int(t) for t in eng.decode_greedy_n(np.array([[1]]), 8)[:, 0]]
+    print("pallas greedy:", outs["pallas"], flush=True)
+    print("xla    greedy:", outs["xla"], flush=True)
+    if outs["pallas"] == outs["xla"]:
+        print(f"PASS engine greedy parity ({time.time() - t_start:.0f}s)", flush=True)
+    else:
+        failures.append("engine-parity")
+        print("FAIL engine greedy parity (token mismatch)", flush=True)
+except Exception as e:
+    failures.append("engine")
+    print(f"FAIL engine (compile/run): {str(e)[:400]}", flush=True)
+
+print("TOTAL", "FAIL " + ",".join(failures) if failures else "ALL PASS", flush=True)
+sys.exit(1 if failures else 0)
