@@ -175,10 +175,89 @@ def decode_dense(raw: np.ndarray, shape: tuple, ft: FloatType) -> np.ndarray:
     raise ValueError(f"unsupported weight type: {ft}")
 
 
-def _load_matmul(raw: np.ndarray, shape: tuple[int, int], ft: FloatType, dtype, dequantize: bool):
+class LazyQ40:
+    """A Q40 matmul weight still living as bytes on the `.m` memmap.
+
+    Shards decode ON DEMAND in the device layout (packed u8[k/2, n], scales
+    f32[k/32, n]): `jax.make_array_from_callback` asks only for the shards a
+    host's devices own, so a model bigger than one host's RAM never fully
+    decodes anywhere — the byte-range analog of the reference's
+    slice-then-ship (nn-network.cpp:775-869), with the mmap as the wire.
+    Both device dims map to contiguous/strided ranges of the file's
+    [n_out, k_in/32, 18-byte-block] record array, so a shard read touches
+    only its own byte ranges.
+    """
+
+    def __init__(self, raw: np.ndarray, n_out: int, k_in: int):
+        self.rec = raw.reshape(n_out, k_in // Q_BLOCK, 2 + Q_BLOCK // 2)
+        self.n_out = n_out
+        self.k_in = k_in
+
+    @property
+    def packed_shape(self) -> tuple[int, ...]:
+        return (self.k_in // 2, self.n_out)
+
+    @property
+    def scales_shape(self) -> tuple[int, ...]:
+        return (self.k_in // Q_BLOCK, self.n_out)
+
+    @staticmethod
+    def _aligned(sl: slice, total: int, unit: int) -> tuple[int, int]:
+        start = sl.start or 0
+        stop = total if sl.stop is None else sl.stop
+        assert start % unit == 0 and stop % unit == 0, (sl, unit)
+        return start // unit, stop // unit
+
+    def packed_shard(self, k2_sl: slice, n_sl: slice) -> np.ndarray:
+        """Device-layout packed rows [k2_sl, n_sl] (k2 units of half-blocks)."""
+        b0, b1 = self._aligned(k2_sl, self.k_in // 2, Q_BLOCK // 2)
+        sub = np.ascontiguousarray(self.rec[n_sl, b0:b1, 2:])  # [n, nb, 16]
+        return np.transpose(sub, (1, 2, 0)).reshape(-1, sub.shape[0])
+
+    def scales_shard(self, kb_sl: slice, n_sl: slice) -> np.ndarray:
+        sub = np.ascontiguousarray(self.rec[n_sl, kb_sl, :2])  # [n, nb, 2]
+        return sub.view(np.float16)[..., 0].T.astype(np.float32)  # [nb, n]
+
+    def eager(self) -> QTensor:
+        full = slice(None)
+        return QTensor(self.packed_shard(full, full), self.scales_shard(full, full))
+
+
+class LazyQ40Stack:
+    """Layer-stacked LazyQ40s: one more leading axis on every shard request
+    (sharded over 'pp' on pipeline meshes — a host decodes only its stage)."""
+
+    def __init__(self, members: list[LazyQ40]):
+        self.members = members
+
+    @property
+    def packed_shape(self) -> tuple[int, ...]:
+        return (len(self.members), *self.members[0].packed_shape)
+
+    @property
+    def scales_shape(self) -> tuple[int, ...]:
+        return (len(self.members), *self.members[0].scales_shape)
+
+    def packed_shard(self, l_sl: slice, k2_sl: slice, n_sl: slice) -> np.ndarray:
+        return np.stack([m.packed_shard(k2_sl, n_sl) for m in self.members[l_sl]])
+
+    def scales_shard(self, l_sl: slice, kb_sl: slice, n_sl: slice) -> np.ndarray:
+        return np.stack([m.scales_shard(kb_sl, n_sl) for m in self.members[l_sl]])
+
+    def eager(self) -> QTensor:
+        parts = [m.eager() for m in self.members]
+        return QTensor(
+            np.stack([p.packed for p in parts]), np.stack([p.scales for p in parts])
+        )
+
+
+def _load_matmul(raw: np.ndarray, shape: tuple[int, int], ft: FloatType, dtype, dequantize: bool,
+                 lazy: bool = False):
     """File [out, in] -> host-resident x@W operand: QTensor or dense [in, out]."""
     n_out, k_in = shape
     if ft == FloatType.Q40 and not dequantize:
+        if lazy:
+            return LazyQ40(raw, n_out, k_in)
         rec = raw.reshape(n_out * k_in // Q_BLOCK, 2 + Q_BLOCK // 2)
         scales = rec[:, :2].copy().view(np.float16)
         packed = rec[:, 2:]
@@ -211,14 +290,21 @@ def load_params(
     `lax.scan` over layers (one XLA while-loop instead of n_layers copies of
     the graph — the TPU analog of the reference's per-layer segment list).
 
-    `put(name, leaf)` receives each finished leaf as a *host* (numpy-backed)
-    pytree and decides device placement — the shard-direct path passes
-    LlamaShardings.param_put so every tensor goes straight from the memmap to
-    its device shards (no whole-model staging on device 0; the reference's
-    analog is slice-then-ship, nn-network.cpp:775-869). Default: plain
+    `put(name, leaf)` receives each finished leaf as a *host* (numpy-backed or
+    :class:`LazyQ40`/:class:`LazyQ40Stack`) pytree and decides device
+    placement — the shard-direct path passes LlamaShardings.param_put so every
+    tensor goes straight from the memmap to its device shards, and Q40 matmul
+    weights stay LAZY: only the byte ranges of a host's own shards are ever
+    decoded (no whole-model staging on any host or device; the reference's
+    analog is slice-then-ship, nn-network.cpp:775-869). Default: eager
     host->default-device.
     """
-    put = put or (lambda name, x: jax.tree.map(jnp.asarray, x))
+    def default_put(name, x):
+        if isinstance(x, (LazyQ40, LazyQ40Stack)):
+            x = x.eager()
+        return jax.tree.map(jnp.asarray, x)
+
+    put = put or default_put
     layer_acc: dict[str, list] = {}
     params: dict = {}
     for name, shape, ft, raw in iter_tensors(path, config, header_size):
@@ -227,7 +313,7 @@ def load_params(
         elif name in ("final_norm",):
             params["final_norm"] = put(name, decode_dense(raw, shape, ft))
         elif name == "wcls":
-            params["wcls"] = put(name, _load_matmul(raw, shape, ft, dtype, dequantize))
+            params["wcls"] = put(name, _load_matmul(raw, shape, ft, dtype, dequantize, lazy=True))
         else:
             _, _, short = name.split(".")
             if short in ("rms_att", "rms_ffn"):
@@ -238,12 +324,15 @@ def load_params(
             elif short.startswith("moe_"):
                 leaf = _load_expert_matmul(raw, shape, ft, dtype, dequantize)
             else:
-                leaf = _load_matmul(raw, shape, ft, dtype, dequantize)
+                leaf = _load_matmul(raw, shape, ft, dtype, dequantize, lazy=True)
             layer_acc.setdefault(short, []).append(leaf)
 
     layers = {}
     for short, leaves in layer_acc.items():
-        stacked = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *leaves)
+        if isinstance(leaves[0], LazyQ40):
+            stacked = LazyQ40Stack(leaves)
+        else:
+            stacked = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *leaves)
         layers[short] = put(f"layers.{short}", stacked)
     params["layers"] = layers
     return params

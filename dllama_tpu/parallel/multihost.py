@@ -8,9 +8,11 @@ runtime (coordinator elected via --coordinator or TPU-pod metadata), and one
 Mesh spans all chips; GSPMD collectives over ICI/DCN replace the socket mesh.
 
 Weight loading on a multi-host mesh: each host mmaps the same `.m` file and
-materializes only the shards its local chips own
-(`jax.make_array_from_callback`) — the root→worker weight shipping protocol
-(nn-network.cpp:775-869) becomes local file reads.
+materializes only the shards its local chips own — Q40 matmul weights decode
+per-shard byte ranges straight off the memmap (models/formats.LazyQ40 via
+`jax.make_array_from_callback` in sharding.param_put); smaller replicated
+tensors go through :func:`device_put_sharded` below. The root→worker weight
+shipping protocol (nn-network.cpp:775-869) becomes local file reads.
 """
 
 from __future__ import annotations

@@ -72,16 +72,23 @@ class LlamaShardings:
 
     def _expand(self, spec: P, leaf):
         """Spec for one leaf (QTensor packed/scales share one spec — both are
-        [in?, out] shaped)."""
-        if isinstance(leaf, QTensor):
+        [in?, out] shaped). Lazy (memmap-backed) Q40 leaves follow the same
+        rule."""
+        from dllama_tpu.models.formats import LazyQ40, LazyQ40Stack
+
+        if isinstance(leaf, (QTensor, LazyQ40, LazyQ40Stack)):
             tp = self.mesh.shape["tp"]
             axes = tuple(spec)
-            if len(axes) >= 2 and axes[-2] == "tp" and leaf.scales.shape[-2] % tp != 0:
+            kdim = (
+                leaf.scales.shape[-2] if isinstance(leaf, QTensor)
+                else leaf.scales_shape[-2]
+            )
+            if len(axes) >= 2 and axes[-2] == "tp" and kdim % tp != 0:
                 # 'tp' on the contraction dim splits the 32-elem quant-block
                 # axis: it must hold tp whole blocks (col-shard, moe_w2)
                 raise ValueError(
                     f"Q40 col-shard needs in_dim % (32*tp) == 0; "
-                    f"got {leaf.scales.shape[-2] * 32} with tp={tp}"
+                    f"got {kdim * 32} with tp={tp}"
                 )
             return QTensor(spec, spec)
         return spec
@@ -117,10 +124,37 @@ class LlamaShardings:
         """Shard-direct placement of one host-resident param leaf: each device
         receives only its shard — a model bigger than one chip's HBM never
         materializes on a single device (the reference's slice-then-ship,
-        nn-network.cpp:775-869, without the wire)."""
+        nn-network.cpp:775-869, without the wire). Lazy Q40 leaves go further:
+        each shard's bytes are decoded straight off the `.m` memmap on demand,
+        so a multi-host load never materializes the full tensor on ANY host."""
+        from dllama_tpu.models.formats import LazyQ40, LazyQ40Stack
         from dllama_tpu.parallel.multihost import device_put_sharded
 
         spec = self.param_spec(name, leaf)
+        if isinstance(leaf, (LazyQ40, LazyQ40Stack)):
+            sh = self._named(spec.packed)  # QTensor(spec, spec): shared P
+
+            def memo(fn):
+                # make_array_from_callback invokes the callback once PER
+                # addressable device with no dedup — replicated mesh axes
+                # (dp, pp-replicated wcls) would re-decode identical bytes
+                cache: dict = {}
+
+                def cb(idx):
+                    key = tuple((s.start, s.stop, s.step) for s in idx)
+                    if key not in cache:
+                        cache[key] = fn(*idx)
+                    return cache[key]
+
+                return cb
+
+            packed = jax.make_array_from_callback(
+                leaf.packed_shape, sh, memo(leaf.packed_shard)
+            )
+            scales = jax.make_array_from_callback(
+                leaf.scales_shape, sh, memo(leaf.scales_shard)
+            )
+            return QTensor(packed, scales)
         return jax.tree.map(
             lambda x, s: device_put_sharded(x, self._named(s)),
             leaf,

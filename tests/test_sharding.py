@@ -141,13 +141,18 @@ def test_shard_direct_load_never_stages_on_one_device(tmp_path):
     path = str(tmp_path / "tiny.m")
     formats.save_model(path, cfg, tensors)
 
-    # 1) the leaves reaching `put` are host arrays, not device arrays
+    # 1) the leaves reaching `put` are host-resident: numpy arrays, or (for
+    # Q40 matmul weights) LAZY memmap-backed handles that decode per shard
+    from dllama_tpu.models.formats import LazyQ40, LazyQ40Stack
+
     seen = {}
 
     def spy_put(name, leaf):
+        seen[name] = leaf
+        if isinstance(leaf, (LazyQ40, LazyQ40Stack)):
+            leaf = leaf.eager()  # undecode-until-sharded is the strongest form
         for x in jax.tree.leaves(leaf):
             assert isinstance(x, np.ndarray), (name, type(x))
-        seen[name] = leaf
         return jax.tree.map(jnp.asarray, leaf)
 
     cfg2, hs = read_header(path)
