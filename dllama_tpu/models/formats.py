@@ -211,11 +211,22 @@ class LazyQ40:
     def packed_shard(self, k2_sl: slice, n_sl: slice) -> np.ndarray:
         """Device-layout packed rows [k2_sl, n_sl] (k2 units of half-blocks)."""
         b0, b1 = self._aligned(k2_sl, self.k_in // 2, Q_BLOCK // 2)
-        sub = np.ascontiguousarray(self.rec[n_sl, b0:b1, 2:])  # [n, nb, 16]
+        n0, n1 = self._aligned(n_sl, self.n_out, 1)
+        from dllama_tpu.utils import native
+
+        if native.has_q40_shard():
+            return native.q40_shard(self.rec, n0, n1, b0, b1, True, False)[0]
+        sub = np.ascontiguousarray(self.rec[n0:n1, b0:b1, 2:])  # [n, nb, 16]
         return np.transpose(sub, (1, 2, 0)).reshape(-1, sub.shape[0])
 
     def scales_shard(self, kb_sl: slice, n_sl: slice) -> np.ndarray:
-        sub = np.ascontiguousarray(self.rec[n_sl, kb_sl, :2])  # [n, nb, 2]
+        b0, b1 = self._aligned(kb_sl, self.k_in // Q_BLOCK, 1)
+        n0, n1 = self._aligned(n_sl, self.n_out, 1)
+        from dllama_tpu.utils import native
+
+        if native.has_q40_shard():
+            return native.q40_shard(self.rec, n0, n1, b0, b1, False, True)[1]
+        sub = np.ascontiguousarray(self.rec[n0:n1, b0:b1, :2])  # [n, nb, 2]
         return sub.view(np.float16)[..., 0].T.astype(np.float32)  # [nb, n]
 
     def eager(self) -> QTensor:

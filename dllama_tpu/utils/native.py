@@ -75,6 +75,14 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, u8p, ctypes.c_int32, ctypes.c_int32,
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
     lib.dllama_tok_encode.restype = ctypes.c_int32
+    # optional symbol: older prebuilt libraries (DLLAMA_NATIVE_LIB) predate
+    # it; callers gate on has_q40_shard(), everything else keeps working
+    if hasattr(lib, "dllama_q40_shard"):
+        lib.dllama_q40_shard.argtypes = [
+            u8p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            u8p, ctypes.POINTER(ctypes.c_float)]
+        lib.dllama_q40_shard.restype = None
 
 
 def available() -> bool:
@@ -113,6 +121,34 @@ def quantize_q80(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     shape = x.shape
     return (codes.reshape(*shape[:-1], shape[-1] // 32, 32),
             scales.view(np.float16).reshape(*shape[:-1], shape[-1] // 32))
+
+
+def has_q40_shard() -> bool:
+    lib = _load()
+    return lib is not None and hasattr(lib, "dllama_q40_shard")
+
+
+def q40_shard(rec: np.ndarray, n0: int, n1: int, b0: int, b1: int,
+              want_packed: bool, want_scales: bool):
+    """Decode a device-layout shard from a `.m` Q40 record array
+    rec u8[n_out, nb_total, 18] — the C++ twin of LazyQ40's numpy path.
+    Returns (packed u8[(b1-b0)*16, n1-n0] | None, scales f32[...] | None)."""
+    lib = _load()
+    assert lib is not None
+    assert rec.ndim == 3 and rec.shape[2] == 18 and rec.dtype == np.uint8
+    assert rec.flags["C_CONTIGUOUS"]  # the C++ kernel assumes row stride nb*18
+    ns, nbs = n1 - n0, b1 - b0
+    packed = np.empty((nbs * 16, ns), np.uint8) if want_packed else None
+    scales = np.empty((nbs, ns), np.float32) if want_scales else None
+    null_u8 = ctypes.cast(None, ctypes.POINTER(ctypes.c_uint8))
+    null_f = ctypes.cast(None, ctypes.POINTER(ctypes.c_float))
+    lib.dllama_q40_shard(
+        rec.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), rec.shape[1],
+        n0, n1, b0, b1,
+        packed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)) if want_packed else null_u8,
+        scales.ctypes.data_as(ctypes.POINTER(ctypes.c_float)) if want_scales else null_f,
+    )
+    return packed, scales
 
 
 class NativeBpe:

@@ -24,6 +24,30 @@
 
 namespace {
 
+float f16_to_f32(uint16_t h) {
+    uint32_t sign = (uint32_t)(h & 0x8000u) << 16;
+    uint32_t exp = (h >> 10) & 0x1Fu;
+    uint32_t man = h & 0x3FFu;
+    uint32_t bits;
+    if (exp == 0) {
+        if (man == 0) {
+            bits = sign;  // +-0
+        } else {  // subnormal: normalize
+            int shift = 0;
+            while (!(man & 0x400u)) { man <<= 1; shift++; }
+            man &= 0x3FFu;
+            bits = sign | ((127 - 15 - shift + 1) << 23) | (man << 13);
+        }
+    } else if (exp == 31) {
+        bits = sign | 0x7F800000u | (man << 13);  // inf/nan
+    } else {
+        bits = sign | ((exp - 15 + 127) << 23) | (man << 13);
+    }
+    float f;
+    std::memcpy(&f, &bits, 4);
+    return f;
+}
+
 uint16_t f32_to_f16(float f) {
     uint32_t x;
     std::memcpy(&x, &f, 4);
@@ -84,6 +108,32 @@ void dllama_quantize_q40(const float* x, int64_t n, uint8_t* packed, uint16_t* s
             q[j] = (uint8_t)v;  // truncation == numpy astype(uint8) after clip
         }
         for (int j = 0; j < 16; j++) packed[b * 16 + j] = (uint8_t)(q[j] | (q[j + 16] << 4));
+    }
+}
+
+// .m Q40 record blob [n_out, nb_total, 18B] -> device-layout shard slices
+// rows [n0,n1) x blocks [b0,b1): packed u8[(b1-b0)*16, n1-n0] (device row
+// 16*b + j holds codes for input dims 32*b + j low / +16 high) and scales
+// f32[b1-b0, n1-n0]. Either output may be null to skip its pass. This is the
+// hot loop of checkpoint loading (a strided gather-transpose numpy does with
+// several large temporaries); one C++ pass streams only the shard's bytes.
+void dllama_q40_shard(const uint8_t* rec, int64_t nb_total,
+                      int64_t n0, int64_t n1, int64_t b0, int64_t b1,
+                      uint8_t* packed, float* scales) {
+    const int64_t ns = n1 - n0;
+    for (int64_t n = 0; n < ns; n++) {
+        const uint8_t* row = rec + ((n0 + n) * nb_total + b0) * 18;
+        for (int64_t b = 0; b < b1 - b0; b++) {
+            const uint8_t* blk = row + b * 18;
+            if (scales) {
+                uint16_t s16 = (uint16_t)blk[0] | ((uint16_t)blk[1] << 8);
+                scales[b * ns + n] = f16_to_f32(s16);
+            }
+            if (packed) {
+                for (int j = 0; j < 16; j++)
+                    packed[(b * 16 + j) * ns + n] = blk[2 + j];
+            }
+        }
     }
 }
 

@@ -115,3 +115,45 @@ def test_native_write_tensor_roundtrip(tmp_path, rng):
     finally:
         nat._lib, nat._tried = old
     assert buf_native.getvalue() == buf_np.getvalue()
+
+
+def test_native_q40_shard_matches_numpy():
+    """C++ shard decoder == the numpy LazyQ40 path, incl. f16->f32 scale
+    widening, on full and partial (row+block) slices."""
+    import numpy as np
+    import pytest
+
+    from dllama_tpu.models.formats import LazyQ40
+    from dllama_tpu.utils import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(3)
+    n_out, k_in = 96, 256
+    nb = k_in // 32
+    raw = rng.integers(0, 256, n_out * nb * 18, dtype=np.uint8)
+    # plant edge-case scale bit patterns: zero, subnormal, large
+    rec = raw.reshape(n_out, nb, 18)
+    rec[0, 0, :2] = [0x00, 0x00]
+    rec[1, 0, :2] = [0x01, 0x00]  # smallest subnormal
+    rec[2, 0, :2] = [0xFF, 0x7B]  # f16 max
+    lazy = LazyQ40(raw, n_out, k_in)
+
+    for k2_sl, n_sl in [
+        (slice(None), slice(None)),
+        (slice(0, 64), slice(32, 96)),
+        (slice(64, 128), slice(0, 48)),
+    ]:
+        kb_sl = slice((k2_sl.start or 0) // 16,
+                      None if k2_sl.stop is None else k2_sl.stop // 16)
+        got_p = lazy.packed_shard(k2_sl, n_sl)
+        got_s = lazy.scales_shard(kb_sl, n_sl)
+        old = native._lib, native._tried
+        try:
+            native._lib, native._tried = None, True  # force python path
+            want_p = lazy.packed_shard(k2_sl, n_sl)
+            want_s = lazy.scales_shard(kb_sl, n_sl)
+        finally:
+            native._lib, native._tried = old
+        np.testing.assert_array_equal(got_p, want_p)
+        np.testing.assert_array_equal(got_s, want_s)
