@@ -166,8 +166,15 @@ PRESETS = {
                vocab_size=128256, seq_len=1024),
     "8b": dict(dim=4096, hidden_dim=14336, n_layers=32, n_heads=32, n_kv_heads=8,
                vocab_size=128256, seq_len=1024),
+    # the long --max-seq-len config class (BASELINE "DeepSeek R1 Distill 8B,
+    # long"): 8 Ki context, 2 Ki prompt — exercises chunked prefill + the
+    # flash kernel's pos-based KV-tile pruning at depth
+    "8b_long": dict(dim=4096, hidden_dim=14336, n_layers=32, n_heads=32, n_kv_heads=8,
+                    vocab_size=128256, seq_len=8192),
 }
-LABELS = {"tiny": "tiny", "1b": "Llama-3.2-1B", "8b": "Llama-3.1-8B"}
+PROMPT_LENS = {"8b_long": 2048}  # default 512 elsewhere
+LABELS = {"tiny": "tiny", "1b": "Llama-3.2-1B", "8b": "Llama-3.1-8B",
+          "8b_long": "Llama-8B-8k"}
 
 
 def bench_engine(cfg, params, n_decode, unroll, prompt_len=512):
@@ -268,7 +275,14 @@ def worker():
     unroll = True if unroll_env == "full" else int(unroll_env)
     n_decode = int(os.environ.get("BENCH_DECODE_TOKENS", "128"))
     slot_list = [int(s) for s in os.environ.get("BENCH_SLOTS", "8,32").split(",")]
-    run_presets = ["1b", "8b"] if preset == "all" else [preset]
+    run_presets = ["1b", "8b", "8b_long"] if preset == "all" else [preset]
+    # the batched serving sweep runs on the north-star config; never on a
+    # long-seq preset (n_slots * 8Ki KV exceeds one chip's HBM)
+    sweep_on = "8b" if "8b" in run_presets else (
+        run_presets[-1]
+        if run_presets[-1] != "tiny" and PRESETS[run_presets[-1]]["seq_len"] < 4096
+        else None
+    )
 
     for name in run_presets:
         if name not in PRESETS:
@@ -301,7 +315,8 @@ def worker():
         setup_s += time.perf_counter() - t0
         north = 1000.0 * (8.03e9 / params_count(cfg))
         try:
-            r = bench_engine(cfg, params, n_decode, unroll)
+            r = bench_engine(cfg, params, n_decode, unroll,
+                             prompt_len=PROMPT_LENS.get(name, 512))
             results[name] = r
             if r["decode_tok_s"] / north > best[0]:
                 best = (r["decode_tok_s"] / north, f"{LABELS[name]} batch=1 decode",
@@ -310,9 +325,9 @@ def worker():
             # compile failure on one tier must not zero the whole record)
             print(f"preset {name} failed: {e!r}"[:500], file=sys.stderr)
             results[name] = {"error": repr(e)[:200]}
-        # batched sweep on the LAST preset (the 8B north-star config), while
-        # its params are live; skip slots we no longer have budget for
-        if name == run_presets[-1] and name != "tiny":
+        # batched sweep while the north-star config's params are live; skip
+        # slots we no longer have budget for
+        if name == sweep_on:
             for slots in slot_list:
                 if time.monotonic() > deadline - 120:
                     batch_results.append({"slots": slots, "skipped": "budget"})

@@ -126,8 +126,8 @@ def test_native_q40_shard_matches_numpy():
     from dllama_tpu.models.formats import LazyQ40
     from dllama_tpu.utils import native
 
-    if not native.available():
-        pytest.skip("native library unavailable")
+    if not native.has_q40_shard():
+        pytest.skip("native q40_shard unavailable")
     rng = np.random.default_rng(3)
     n_out, k_in = 96, 256
     nb = k_in // 32
