@@ -21,7 +21,8 @@ Env knobs:
   BENCH_SLOTS          comma list for the batched sweep (default '8,32')
   BENCH_DECODE_TOKENS  timed fused-decode length (default 128)
   BENCH_KERNELS        auto (default) | pallas | xla — engine matmul backend
-  BENCH_Q40_STYLE      auto (default) | deq | blockdot — Pallas decode kernel
+  BENCH_Q40_STYLE      auto (default) | deq | blockdot | maskdot — Pallas
+                       decode-kernel style (prefill always uses deq)
   BENCH_UNROLL         lax.scan unroll over layers: int, or 'full' (default 1)
   BENCH_BUDGET_S       total wall-clock budget for the parent (default 840 —
                        fits under the driver's `timeout 900 python bench.py`)
@@ -291,8 +292,10 @@ def worker():
             )
 
     q40_style = os.environ.get("BENCH_Q40_STYLE", "auto")
-    if q40_style not in ("auto", "deq", "blockdot"):
-        raise SystemExit(f"BENCH_Q40_STYLE must be auto|deq|blockdot, got {q40_style!r}")
+    if q40_style not in ("auto", "deq", "blockdot", "maskdot"):
+        raise SystemExit(
+            f"BENCH_Q40_STYLE must be auto|deq|blockdot|maskdot, got {q40_style!r}"
+        )
     if q40_style != "auto":
         from dllama_tpu.ops.pallas import q40_matmul as _qmod
 
@@ -319,7 +322,10 @@ def worker():
         # downgrades the number instead of erasing it
         from dllama_tpu.ops.pallas import q40_matmul as _qm
 
-        attempts = [(q40_style, None), ("deq", None), ("auto", "xla")]
+        attempts = [(q40_style, None)] + [
+            a for a in (("maskdot", None), ("deq", None), ("auto", "xla"))
+            if a != (q40_style, None)
+        ]
         for style, kern in attempts:
             _qm.STYLE = style
             try:

@@ -64,7 +64,11 @@ from dllama_tpu.ops.quant import Q_BLOCK, QTensor
 _EXP_BITS = 0x4B000000
 _V_OFFSET = 8388608.0 + 8.0
 
-# kernel-style override for benchmarks: 'auto' | 'deq' | 'blockdot'
+# kernel-style override for benchmarks: 'auto' | 'deq' | 'blockdot' | 'maskdot'
+# ('maskdot' = blockdot's math with the per-block partial dots expressed as
+# ONE plain dot on a block-masked activation matrix — a fallback in case
+# Mosaic rejects the batched dot_general; MXU does nb x redundant zero MACs,
+# irrelevant while decode is HBM/VPU-bound)
 STYLE = "auto"
 
 
@@ -164,6 +168,67 @@ def _deq_call(layer, x, packed, scales, *, interpret: bool = False):
     )(layer, x, packed, scales)
 
 
+def _maskdot_kernel(
+    layer_ref, x_ref, packed_ref, scales_ref, out_ref, acc_ref, *, tk, tn
+):
+    del layer_ref
+    kb = pl.program_id(1)
+
+    @pl.when(kb == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    m = x_ref.shape[0]
+    nb = tk // Q_BLOCK
+    w = _unpack_codes(packed_ref[:], tk, tn).astype(x_ref.dtype).reshape(tk, tn)
+    # x replicated per block row, masked to that block's 32 lanes: one big dot
+    # then computes every per-block partial y[b] = x_b @ codes_b at once
+    lane = jax.lax.broadcasted_iota(jnp.int32, (nb, m, tk), 2)
+    blk = jax.lax.broadcasted_iota(jnp.int32, (nb, m, tk), 0)
+    xaug = jnp.where(lane // Q_BLOCK == blk, x_ref[:][None], 0).reshape(nb * m, tk)
+    y = jnp.dot(xaug, w, preferred_element_type=jnp.float32).reshape(nb, m, tn)
+    acc_ref[:] += jnp.sum(y * scales_ref[:][:, None, :], axis=0)
+
+    @pl.when(kb == pl.num_programs(1) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _maskdot_call(layer, x, packed, scales, *, interpret: bool = False):
+    """blockdot fallback: same math, plain-dot-only lowering (m <= 16)."""
+    m, k = x.shape
+    n = packed.shape[-1]
+    tn = _pick_tile(n, (512, 256, 128))
+    tk = _pick_tile(k, (512, 256, 128, 64, 32))
+    grid = (n // tn, k // tk)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((m, tk), lambda j, kb, L: (0, kb)),
+            pl.BlockSpec((None, tk // 2, tn), lambda j, kb, L: (L[0], kb, j)),
+            pl.BlockSpec((None, tk // Q_BLOCK, tn), lambda j, kb, L: (L[0], kb, j)),
+        ],
+        out_specs=pl.BlockSpec((m, tn), lambda j, kb, L: (0, j)),
+        scratch_shapes=[pltpu.VMEM((m, tn), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_maskdot_kernel, tk=tk, tn=tn),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * n * k * (tk // Q_BLOCK),  # nb-masked redundant MACs
+            bytes_accessed=m * k * x.dtype.itemsize + k * n // 2 + (k // Q_BLOCK) * n * 4 + m * n * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(layer, x, packed, scales)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _blockdot_call(layer, x, packed, scales, *, interpret: bool = False):
     """Decode-shaped path: x[m<=16, k] against stacked Q40 weights."""
@@ -241,8 +306,15 @@ def q40_matmul(
     style = STYLE
     if style == "auto":
         style = "blockdot" if mp <= 16 else "deq"
+    elif style in ("blockdot", "maskdot") and mp > 16:
+        # forced decode-shaped styles apply only to decode-shaped calls; a
+        # forced style is a DECODE-kernel selector, prefill always uses deq
+        # (callers labeling results must report per-m paths, see bench.py)
+        style = "deq"
     if style == "blockdot":
         out = _blockdot_call(layer_arr, x2, packed, scales, interpret=interpret)
+    elif style == "maskdot":
+        out = _maskdot_call(layer_arr, x2, packed, scales, interpret=interpret)
     else:
         out = _deq_call(layer_arr, x2, packed, scales, interpret=interpret)
     if pad:

@@ -129,8 +129,10 @@ def main():
     qbytes = k * n // 2 + (k // Q_BLOCK) * n * 4  # packed + f32 scales
     rows = []
     for v in variants:
-        if v in ("A", "DQ", "BD"):
-            style = {"A": "auto", "DQ": "deq", "BD": "blockdot"}[v]
+        if v in ("A", "DQ", "BD", "MD"):
+            # NOTE: forced decode styles (BD/MD) apply only when m <= 16;
+            # larger m silently uses deq (the dispatcher's prefill rule)
+            style = {"A": "auto", "DQ": "deq", "BD": "blockdot", "MD": "maskdot"}[v]
 
             def prod(x, w=w, style=style):
                 qmod.STYLE = style

@@ -140,3 +140,21 @@ def test_rms_norm_pallas_matches_jnp(rng, shape, dtype):
     np.testing.assert_allclose(
         np.asarray(got, np.float32), np.asarray(want, np.float32), atol=2e-2, rtol=2e-2
     )
+
+
+@pytest.mark.parametrize("style", ["blockdot", "maskdot", "deq"])
+def test_q40_styles_agree(rng, style):
+    """Every decode-kernel style computes the same product (maskdot is the
+    plain-dot fallback for blockdot's batched dot_general)."""
+    from dllama_tpu.ops.pallas import q40_matmul as qmod
+
+    x = jnp.asarray(rng.standard_normal((3, 512)), jnp.float32)
+    w = QTensor.quantize(rng.standard_normal((512, 384)).astype(np.float32) * 0.1)
+    want = jnp.dot(x, w.dequantize(jnp.float32))
+    old = qmod.STYLE
+    try:
+        qmod.STYLE = style
+        got = q40_matmul(x, w, interpret=True)
+    finally:
+        qmod.STYLE = old
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-2, rtol=2e-2)
