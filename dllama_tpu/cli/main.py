@@ -157,9 +157,9 @@ def cmd_inference(args) -> int:
         # (nn-network.cpp:483-492 counts real socket bytes; this counts the
         # real HLO collectives — scan bodies once per trip, see docstring)
         meas = m.engine.measured_collective_report()
-        ops = ", ".join(f"{k}={v / 1024:.0f}kB" for k, v in meas["per_op"].items()) or "none"
+        ops = ", ".join(f"{k}={v / 1024:.1f}kB" for k, v in meas["per_op"].items()) or "none"
         print(
-            f"🔗 measured in compiled step: {meas['total_bytes'] / 1024:.0f} kB ({ops})",
+            f"🔗 measured in compiled step: {meas['total_bytes'] / 1024:.1f} kB ({ops})",
             file=sys.stderr,
         )
     return 0

@@ -70,6 +70,23 @@ class LlamaShardings:
     def _named(self, spec: P) -> NamedSharding:
         return NamedSharding(self.mesh, spec)
 
+    def _sanitize(self, spec: P, *shapes) -> P:
+        """Replicate any spec axis that does not evenly divide the leaf's dim
+        (for every given shape): device placement requires exact tiling, and
+        an oddly-sized tensor (e.g. a non-power-of-two vocab on wcls) should
+        load replicated rather than crash — the reference simply refuses such
+        configs (nNodes must divide every slice, nn-core.cpp:170-238)."""
+        n = max(len(s) for s in shapes)
+        axes = list(spec) + [None] * (n - len(spec))
+        out = []
+        for i, ax in enumerate(axes):
+            if ax is not None and any(
+                len(s) > i and s[i] % self.mesh.shape[ax] != 0 for s in shapes
+            ):
+                ax = None
+            out.append(ax)
+        return P(*out)
+
     def _expand(self, spec: P, leaf):
         """Spec for one leaf (QTensor packed/scales share one spec — both are
         [in?, out] shaped). Lazy (memmap-backed) Q40 leaves follow the same
@@ -79,10 +96,12 @@ class LlamaShardings:
         if isinstance(leaf, (QTensor, LazyQ40, LazyQ40Stack)):
             tp = self.mesh.shape["tp"]
             axes = tuple(spec)
-            kdim = (
-                leaf.scales.shape[-2] if isinstance(leaf, QTensor)
-                else leaf.scales_shape[-2]
-            )
+            if isinstance(leaf, QTensor):
+                kdim = leaf.scales.shape[-2]
+                shapes = (leaf.packed.shape, leaf.scales.shape)
+            else:
+                kdim = leaf.scales_shape[-2]
+                shapes = (leaf.packed_shape, leaf.scales_shape)
             if len(axes) >= 2 and axes[-2] == "tp" and kdim % tp != 0:
                 # 'tp' on the contraction dim splits the 32-elem quant-block
                 # axis: it must hold tp whole blocks (col-shard, moe_w2)
@@ -90,7 +109,10 @@ class LlamaShardings:
                     f"Q40 col-shard needs in_dim % (32*tp) == 0; "
                     f"got {kdim * 32} with tp={tp}"
                 )
+            spec = self._sanitize(spec, *shapes)
             return QTensor(spec, spec)
+        if hasattr(leaf, "shape"):
+            spec = self._sanitize(spec, leaf.shape)
         return spec
 
     def param_spec(self, name: str, leaf):

@@ -197,3 +197,31 @@ def test_engine_sync_q80_matches_within_quantization_noise():
     ref_toks = ref.decode_greedy_n(np.array([[int(np.argmax(ref_logits))]]), 8)
     got_toks = eng.decode_greedy_n(np.array([[int(np.argmax(got))]]), 8)
     assert ref_toks.tolist() == got_toks.tolist()
+
+
+def test_uneven_vocab_replicates_instead_of_crashing(tmp_path):
+    """A vocab that doesn't divide tp must load with wcls replicated (the
+    reference refuses such configs outright; we sanitize the spec). Caught by
+    driving the CLI with the odd-vocab golden fixture on a tp=2 mesh."""
+    from dllama_tpu.engine.loader import load_model
+    from dllama_tpu.models import formats
+    from dllama_tpu.ops.quant import FloatType
+
+    cfg = LlamaConfig(dim=128, hidden_dim=256, n_layers=2, n_heads=8, n_kv_heads=4,
+                      vocab_size=129, seq_len=64, weight_type=FloatType.Q40)
+    rng = np.random.default_rng(0)
+    tensors = {n: (rng.standard_normal(s) * 0.05).astype(np.float32)
+               for n, s, _ in formats.tensor_plan(cfg)}
+    path = str(tmp_path / "odd.m")
+    formats.save_model(path, cfg, tensors)
+
+    loaded = load_model(path, mesh="tp=2")  # must not raise
+    wcls = loaded.engine.params["wcls"]
+    # replicated: every device holds the full (odd) vocab dim
+    assert wcls.packed.sharding.shard_shape(wcls.packed.shape) == wcls.packed.shape
+    ref = load_model(path, mesh=None)
+    prompt = np.array([[5, 9, 2]], dtype=np.int32)
+    np.testing.assert_allclose(
+        np.asarray(loaded.engine.prefill(prompt)),
+        np.asarray(ref.engine.prefill(prompt)), atol=2e-4, rtol=1e-3,
+    )
