@@ -1,0 +1,26 @@
+#!/bin/sh
+# One TPU window, fully scripted: validate kernels, micro-bench decode styles,
+# then the full benchmark. Run from the repo root when the axon tunnel is
+# alive (probe first!). Each stage tolerates failure and moves on; everything
+# is logged to experiments/logs/.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p experiments/logs
+TS=$(date +%H%M%S)
+L=experiments/logs
+
+echo "== 1. probe"
+timeout 60 python -c "import jax; print('PROBE', jax.devices())" || { echo "tunnel down"; exit 1; }
+
+echo "== 2. kernel validation (compile + parity, ~3-5 min)"
+timeout 600 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/tpu_validate.py 2>&1 | tee "$L/validate_$TS.log"
+
+echo "== 3. decode-style micro-bench (1B shapes, m=8)"
+for v in A BD MD DQ D E; do
+  timeout 420 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/kbench.py 8 w1 "$v" 2>&1 | tail -1
+done | tee "$L/kbench_$TS.log"
+
+echo "== 4. full benchmark (1b + 8b + long + batched sweep)"
+timeout 900 python bench.py 2>&1 | tee "$L/bench_$TS.log" | tail -1
+
+echo "== done; logs in $L/*_$TS.log"
