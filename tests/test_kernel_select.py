@@ -1,0 +1,65 @@
+"""Contract tests for engine/kernel_select.resolve_kernels — the single
+resolution point both engine tiers share (backend, shard_map wrappers, flash
+gating, interpret mode). On CPU the platform branch is fixed, so these pin
+the sharded/forced combinations."""
+
+import jax.numpy as jnp
+import pytest
+
+from dllama_tpu.engine.kernel_select import resolve_kernels
+from dllama_tpu.models.config import LlamaConfig
+from dllama_tpu.parallel.mesh import MeshConfig, make_mesh
+from dllama_tpu.parallel.sharding import LlamaShardings
+
+CFG = LlamaConfig(dim=256, hidden_dim=512, n_layers=2, n_heads=8, n_kv_heads=4,
+                  vocab_size=512, seq_len=128)
+
+
+def sh(spec):
+    return LlamaShardings(make_mesh(MeshConfig(**spec)), CFG)
+
+
+def test_unsharded_cpu_defaults_to_xla_no_flash():
+    sel = resolve_kernels(CFG, 128, 1)
+    assert sel.backend == "xla" and sel.mm_in is None and sel.attn_fn is None
+
+
+def test_forced_pallas_unsharded_matmuls_only():
+    # kernels= picks the MATMUL backend; attention stays attn_impl's choice
+    sel = resolve_kernels(CFG, 128, 1, kernels="pallas")
+    assert sel.backend == "pallas"
+    assert sel.mm_in is None  # unsharded: plain kernels, no shard_map
+    assert sel.attn_fn is None  # flash off-TPU needs attn_impl='flash'
+    sel2 = resolve_kernels(CFG, 128, 1, kernels="pallas", attn_impl="flash")
+    assert sel2.attn_fn is not None  # interpret-mode flash when asked
+
+
+def test_forced_pallas_tp_mesh_uses_shard_map():
+    sel = resolve_kernels(CFG, 128, 1, kernels="pallas", shardings=sh(dict(tp=4)))
+    assert sel.backend == "pallas"
+    assert sel.mm_in is not None  # in-dim-sharded matmul (psum) wrapper
+    assert sel.attn_fn is not None  # head-sharded flash
+
+
+def test_auto_tp_mesh_on_cpu_stays_xla():
+    # auto never picks pallas off-TPU; GSPMD handles the sharded math
+    sel = resolve_kernels(CFG, 128, 1, shardings=sh(dict(tp=4)))
+    assert sel.backend == "xla" and sel.mm_in is None and sel.attn_fn is None
+
+
+def test_sp_mesh_keeps_ring_attention_even_forced():
+    sel = resolve_kernels(CFG, 128, 1, kernels="pallas", shardings=sh(dict(sp=2, tp=2)))
+    assert sel.backend == "pallas"  # explicit override respected for matmuls…
+    assert sel.mm_in is None  # …but NOT the shard_map tier (sp unsupported)
+    assert sel.attn_fn is not None  # the sp ring attention, not flash
+
+
+def test_attn_impl_jnp_disables_flash_everywhere():
+    sel = resolve_kernels(CFG, 128, 1, kernels="pallas", attn_impl="jnp")
+    assert sel.attn_fn is None
+
+
+def test_seq_len_untileable_skips_flash():
+    # flash needs cache_seq_len % 64 == 0
+    sel = resolve_kernels(CFG, 96, 1, kernels="pallas")
+    assert sel.backend == "pallas" and sel.attn_fn is None
