@@ -46,7 +46,9 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--no-mesh", action="store_true", help="single-device even if more exist")
     p.add_argument("--cache-dtype", choices=["bf16", "f32"], default="bf16")
-    p.add_argument("--max-prefill-chunk", type=int, default=128)
+    p.add_argument("--max-prefill-chunk", type=int, default=256,
+                   help="prefill chunk cap (pow-2 chunks; larger = better MXU "
+                        "utilization, more HBM for activations)")
     p.add_argument("--dequantize", action="store_true", help="load Q40 weights as bf16 (faster prefill, 4x HBM)")
     p.add_argument("--port", type=int, default=9990, help="HTTP port (serve mode)")
     p.add_argument("--host", default="127.0.0.1", help="HTTP bind address (serve mode)")

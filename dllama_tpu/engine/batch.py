@@ -43,7 +43,7 @@ class BatchEngine:
         n_slots: int = 4,
         cache_dtype=jnp.bfloat16,
         max_seq_len: int | None = None,
-        max_prefill_chunk: int = 128,
+        max_prefill_chunk: int = 256,
         seed: int = 0,
         shardings=None,  # parallel/sharding.LlamaShardings: multi-chip serving
         attn_impl: str = "auto",  # 'auto' | 'jnp' | 'flash' (same as InferenceEngine)

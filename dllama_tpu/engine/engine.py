@@ -69,7 +69,7 @@ class InferenceEngine:
         batch: int = 1,
         cache_dtype=jnp.bfloat16,
         max_seq_len: int | None = None,
-        max_prefill_chunk: int = 128,
+        max_prefill_chunk: int = 256,
         shardings=None,
         donate_cache: bool = True,
         attn_impl: str = "auto",  # 'auto' | 'jnp' | 'flash' (Pallas online-softmax)
@@ -77,6 +77,7 @@ class InferenceEngine:
         sync: str = "bf16",  # 'bf16' (native collectives) | 'q80' (quantized exchange)
         kernels: str = "auto",  # 'auto' | 'pallas' | 'xla' matmul backend
         moe_impl: str = "auto",  # 'auto' | 'dispatch' | 'dense' (ops.layers.moe_ffn)
+        pp_micro: int = 1,  # GPipe microbatches on pp meshes (batch % pp_micro == 0)
     ):
         self.cfg = cfg
         self.params = params
@@ -116,14 +117,21 @@ class InferenceEngine:
         if shardings is not None and shardings.mesh.shape["pp"] > 1:
             # stage-split forward: GPipe shard_map over 'pp' (manual axis),
             # tp/dp composed by GSPMD inside each stage (parallel/pipeline.py).
-            # n_micro=1 — the engine drives one request; microbatch overlap
-            # belongs to the serving tier. layer_unroll does not apply (the
-            # stage schedule replaces the layer scan).
+            # pp_micro > 1 splits the batch into GPipe microbatches so prefill
+            # and batched decode fill the pipeline bubble (B=1 decode keeps
+            # pp_micro=1: pure sequential layer split). layer_unroll does not
+            # apply (the stage schedule replaces the layer scan).
             if col_fn is not None:
                 raise ValueError("--sync q80 is not supported on pp meshes yet")
+            if pp_micro < 1 or batch % pp_micro != 0:
+                raise ValueError(
+                    f"pp_micro must be >= 1 and divide batch; got pp_micro={pp_micro} "
+                    f"batch={batch}"
+                )
             from dllama_tpu.parallel.pipeline import make_pp_forward
 
-            pp_fwd = make_pp_forward(cfg, shardings.mesh, n_micro=1, attn_fn=attn_fn, mm=mm)
+            pp_fwd = make_pp_forward(cfg, shardings.mesh, n_micro=pp_micro,
+                                     attn_fn=attn_fn, mm=mm)
 
             def fwd(params, cache, tokens, pos, rope_cache, last_only=False):
                 # pp computes all positions (stage schedule); callers slice

@@ -57,10 +57,12 @@ def load_model(
     batch: int = 1,
     cache_dtype=jnp.bfloat16,
     dequantize: bool = False,
-    max_prefill_chunk: int = 128,
+    max_prefill_chunk: int = 256,
     sync: str = "bf16",
     kernels: str = "auto",
     moe_impl: str = "auto",
+    pp_micro: int = 1,  # GPipe microbatches (library callers with batch > 1;
+    # the CLI always drives batch=1, so it exposes no flag for this)
 ) -> LoadedModel:
     cfg, header_size = read_header(model_path, max_seq_len)
     log.info("model: %s", cfg.describe())
@@ -87,5 +89,6 @@ def load_model(
         sync=sync,
         kernels=kernels,
         moe_impl=moe_impl,
+        pp_micro=pp_micro,
     )
     return LoadedModel(cfg, engine, tokenizer, shardings, sync=sync)
