@@ -23,6 +23,9 @@ Env knobs:
   BENCH_KERNELS        auto (default) | pallas | xla — engine matmul backend
   BENCH_Q40_STYLE      auto (default) | deq | blockdot | maskdot — Pallas
                        decode-kernel style (prefill always uses deq)
+  BENCH_XLA_PREFILL_M  int: route Pallas matmuls with flattened m >= this
+                       through the XLA dequant-dot GEMM (prefill tier A/B;
+                       unset = always fused kernels)
   BENCH_UNROLL         lax.scan unroll over layers: int, or 'full' (default 1)
   BENCH_BUDGET_S       total wall-clock budget for the parent (default 840 —
                        fits under the driver's `timeout 900 python bench.py`)
@@ -301,6 +304,12 @@ def worker():
 
         _qmod.STYLE = q40_style
 
+    xla_prefill_m = os.environ.get("BENCH_XLA_PREFILL_M")
+    if xla_prefill_m:
+        from dllama_tpu.ops import matmul as _mmod
+
+        _mmod.XLA_PREFILL_MIN_M = int(xla_prefill_m)
+
     dev = jax.devices()[0]
     results = {}
     batch_results = []
@@ -392,6 +401,7 @@ def worker():
         "unroll": unroll_env,
         "kernels": os.environ.get("BENCH_KERNELS", "auto"),
         "q40_style": q40_style,
+        "xla_prefill_m": int(xla_prefill_m) if xla_prefill_m else None,
         "kb_per_token_per_chip": round(kb, 1),
     }
     print(json.dumps(result))

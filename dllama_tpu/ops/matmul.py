@@ -31,6 +31,14 @@ from dllama_tpu.ops.quant import QTensor, slice_leaf
 # module-level backend switch; the CLI sets this once at startup.
 BACKEND = "auto"
 
+# prefill GEMM routing (VERDICT r2 #4 / reference's llamafile sgemm tier,
+# nn-cpu-ops.cpp:1003-1019): at or above this flattened batch*seq, a Pallas-
+# backed matmul routes to the XLA dequant-dot instead — prefill is FLOPs-bound
+# and the plain MXU GEMM beats in-kernel unpacking once the packed-bytes
+# saving stops mattering. None = always fused (the pre-measurement default);
+# bench.py overrides via BENCH_XLA_PREFILL_M to A/B it on hardware.
+XLA_PREFILL_MIN_M: int | None = None
+
 
 def _platform() -> str:
     try:
@@ -73,7 +81,11 @@ def matmul(x: jax.Array, w, layer=None, backend: str | None = None) -> jax.Array
         if resolve_backend(backend) == "pallas":
             from dllama_tpu.ops.pallas.q40_matmul import q40_matmul, supported
 
-            if supported(x.shape, w):
+            m = 1
+            for d in x.shape[:-1]:
+                m *= d
+            route_xla = XLA_PREFILL_MIN_M is not None and m >= XLA_PREFILL_MIN_M
+            if supported(x.shape, w) and not route_xla:
                 return q40_matmul(x, w, layer, interpret=_platform() != "tpu")
         if layer is not None and w.packed.ndim == 3:
             w = slice_leaf(w, layer)

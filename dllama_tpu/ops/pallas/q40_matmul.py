@@ -71,6 +71,12 @@ _V_OFFSET = 8388608.0 + 8.0
 # irrelevant while decode is HBM/VPU-bound)
 STYLE = "auto"
 
+# decode-kernel tile overrides for on-hardware autotuning (experiments/
+# kbench.py sweeps these): None = the pick_tile defaults. tk/tn must divide
+# the op's k/n; out-of-range overrides fall back to the default pick.
+BLOCKDOT_TK: int | None = None
+BLOCKDOT_TN: int | None = None
+
 
 def _unpack_codes(packed_block, tk: int, tn: int):
     """u8[tk/2, tn] nibbles -> f32[tk/32, 32, tn] of exact codes q - 8."""
@@ -229,14 +235,17 @@ def _maskdot_call(layer, x, packed, scales, *, interpret: bool = False):
     )(layer, x, packed, scales)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _blockdot_call(layer, x, packed, scales, *, interpret: bool = False):
-    """Decode-shaped path: x[m<=16, k] against stacked Q40 weights."""
+@functools.partial(jax.jit, static_argnames=("interpret", "tk", "tn"))
+def _blockdot_call(layer, x, packed, scales, *, interpret: bool = False,
+                   tk: int | None = None, tn: int | None = None):
+    """Decode-shaped path: x[m<=16, k] against stacked Q40 weights.
+    tk/tn are static tile overrides (from the module knobs, validated by the
+    dispatcher) — part of the jit key so an autotune sweep actually recompiles."""
     m, k = x.shape
     n = packed.shape[-1]
     nb = k // Q_BLOCK
-    tn = _pick_tile(n, (512, 256, 128))
-    tk = _pick_tile(k, (2048, 1024, 512, 256, 128, 64, 32))
+    tn = tn or _pick_tile(n, (512, 256, 128))
+    tk = tk or _pick_tile(k, (2048, 1024, 512, 256, 128, 64, 32))
     grid = (n // tn, k // tk)
     # pre-shaped outside the kernel: Mosaic can't split the lane dim in-kernel
     xb = x.reshape(m, nb, Q_BLOCK).transpose(1, 0, 2)
@@ -312,7 +321,12 @@ def q40_matmul(
         # (callers labeling results must report per-m paths, see bench.py)
         style = "deq"
     if style == "blockdot":
-        out = _blockdot_call(layer_arr, x2, packed, scales, interpret=interpret)
+        tk_o = BLOCKDOT_TK if (
+            BLOCKDOT_TK and k % BLOCKDOT_TK == 0 and BLOCKDOT_TK % Q_BLOCK == 0
+        ) else None
+        tn_o = BLOCKDOT_TN if (BLOCKDOT_TN and n % BLOCKDOT_TN == 0) else None
+        out = _blockdot_call(layer_arr, x2, packed, scales, interpret=interpret,
+                             tk=tk_o, tn=tn_o)
     elif style == "maskdot":
         out = _maskdot_call(layer_arr, x2, packed, scales, interpret=interpret)
     else:

@@ -1,12 +1,15 @@
 """Microbench harness for Q40 matmul kernel variants on the real TPU.
 
-Usage: python experiments/kbench.py M SHAPE [variant ...]
+Usage: python experiments/kbench.py suite
+       python experiments/kbench.py M SHAPE [variant ...]
+'suite' (what tpu_session.sh runs) benches the decode variants (m=8 on
+w1/wcls), the prefill tier comparison (m=256/512: in-kernel deq vs XLA
+dequant-dot), and a blockdot (tk, tn) tile autotune, all in one process.
   variants: A  production dispatch (q40_matmul auto: blockdot for m<=16, deq above)
             DQ forced deq-style kernel      BD forced blockdot kernel
             B  legacy fma-f32 kernel        D  bf16-weights roofline reference
             E  XLA dequantize-then-dot
-Measures achieved HBM GB/s (packed+scales bytes) for decode (m=8) and
-prefill (m=128) shapes of the 1B preset.
+Measures achieved HBM GB/s (packed+scales bytes) on 1B-preset shapes.
 """
 import functools
 import sys
@@ -117,54 +120,129 @@ SHAPES = {
 }
 
 
-def main():
-    # argv: m shape variant [variant...]
-    m = int(sys.argv[1])
-    label = sys.argv[2]
-    variants = sys.argv[3:] or ["A", "B", "D", "E"]
+def make_inputs(m, label):
+    """Shared test data for run_one and the tile sweep — ONE definition so the
+    sweep always benchmarks the same (w, x, qbytes) as the variant rows."""
     k, n = SHAPES[label]
     rng = np.random.default_rng(0)
     w = QTensor.quantize((rng.standard_normal((k, n)) * 0.02).astype(np.float32))
     x = jnp.asarray(rng.standard_normal((m, k)), jnp.bfloat16)
     qbytes = k * n // 2 + (k // Q_BLOCK) * n * 4  # packed + f32 scales
+    return w, x, qbytes
+
+
+def dispatch_closure(w, style, tk=None, tn=None):
+    """Production-dispatch closure with forced style (+ optional blockdot tile
+    overrides); a FRESH closure per combo so each re-traces its static args."""
+
+    def prod(x, w=w, style=style, tk=tk, tn=tn):
+        qmod.STYLE, qmod.BLOCKDOT_TK, qmod.BLOCKDOT_TN = style, tk, tn
+        try:
+            return qmod.q40_matmul(x, w)
+        finally:
+            qmod.STYLE = "auto"
+            qmod.BLOCKDOT_TK = qmod.BLOCKDOT_TN = None
+
+    return prod
+
+
+def run_one(m, label, variants):
+    k, n = SHAPES[label]
+    w, x, qbytes = make_inputs(m, label)
     rows = []
     for v in variants:
-        if v in ("A", "DQ", "BD", "MD"):
-            # NOTE: forced decode styles (BD/MD) apply only when m <= 16;
-            # larger m silently uses deq (the dispatcher's prefill rule)
-            style = {"A": "auto", "DQ": "deq", "BD": "blockdot", "MD": "maskdot"}[v]
-
-            def prod(x, w=w, style=style):
-                qmod.STYLE = style
-                try:
-                    return qmod.q40_matmul(x, w)
-                finally:
-                    qmod.STYLE = "auto"
-
-            t = bench(prod, (x,))
-            rows.append((f"{v} {style}", t, qbytes))
-        elif v == "B":
-            call = make_call(_kernel_b, m, k, n)
-            t = bench(call, (x, w.packed, w.scales))
-            rows.append(("B fma-f32", t, qbytes))
-        elif v == "D":
-            wb = w.dequantize(jnp.bfloat16)
-            call = make_call(_kernel_d, m, k, n, bf16=True)
-            t = bench(call, (x, wb))
-            rows.append(("D bf16-ref", t, k * n * 2))
-        elif v == "E":
-            t = bench(
-                lambda x, w: jnp.dot(x, w.dequantize(jnp.bfloat16), preferred_element_type=jnp.float32),
-                (x, w),
-            )
-            rows.append(("E xla-deq", t, qbytes))
-        else:
-            raise SystemExit(f"unknown variant {v!r}; see module docstring")
+        # per-variant isolation: one Mosaic rejection (MD exists because the
+        # batched dot_general might not lower) must not eat the row's other
+        # timings in a one-shot TPU window
+        try:
+            if v in ("A", "DQ", "BD", "MD"):
+                # NOTE: forced decode styles (BD/MD) apply only when m <= 16;
+                # larger m silently uses deq (the dispatcher's prefill rule)
+                style = {"A": "auto", "DQ": "deq", "BD": "blockdot", "MD": "maskdot"}[v]
+                t = bench(dispatch_closure(w, style), (x,))
+                rows.append((f"{v} {style}", t, qbytes))
+            elif v == "B":
+                call = make_call(_kernel_b, m, k, n)
+                t = bench(call, (x, w.packed, w.scales))
+                rows.append(("B fma-f32", t, qbytes))
+            elif v == "D":
+                wb = w.dequantize(jnp.bfloat16)
+                call = make_call(_kernel_d, m, k, n, bf16=True)
+                t = bench(call, (x, wb))
+                rows.append(("D bf16-ref", t, k * n * 2))
+            elif v == "E":
+                t = bench(
+                    lambda x, w: jnp.dot(x, w.dequantize(jnp.bfloat16), preferred_element_type=jnp.float32),
+                    (x, w),
+                )
+                rows.append(("E xla-deq", t, qbytes))
+            else:
+                raise SystemExit(f"unknown variant {v!r}; see module docstring")
+        except SystemExit:
+            raise
+        except Exception as e:
+            print(f"m={m} {label} {v}: FAILED {e!r}"[:250])
+            sys.stdout.flush()
     out = f"m={m} {label}: "
     for name, t, nb in rows:
         out += f"{name}={t*1e6:.0f}us({nb/t/1e9:.0f}GB/s) "
     print(out)
     sys.stdout.flush()
+
+
+SUITE = [
+    # decode shapes: the production dispatch + each forced style + rooflines
+    (8, "w1", ["A", "BD", "MD", "DQ", "D", "E"]),
+    (8, "wcls", ["A", "D", "E"]),  # the lm head is ~18% of 1B weight bytes
+    # prefill shapes: in-kernel deq vs the XLA dequant-dot the MXU loves
+    (256, "w1", ["DQ", "D", "E"]),
+    (512, "w1", ["DQ", "D", "E"]),
+]
+
+
+def sweep_blockdot_tiles(m=8, label="w1"):
+    """Autotune the decode kernel's (tk, tn) on hardware. Each combo prints
+    (flushed) as soon as it's measured — a session timeout mid-sweep keeps
+    everything already benchmarked — and a sorted summary lands at the end."""
+    k, n = SHAPES[label]
+    w, x, qbytes = make_inputs(m, label)
+    rows = []
+    for tk in (512, 1024, 2048):
+        for tn in (128, 256, 512):
+            if k % tk or n % tn:
+                continue
+            try:
+                t = bench(dispatch_closure(w, "blockdot", tk, tn), (x,))
+                rows.append((tk, tn, t))
+                print(f"  tile tk={tk} tn={tn}: {t*1e6:.0f}us ({qbytes/t/1e9:.0f}GB/s)")
+            except Exception as e:
+                print(f"  tile tk={tk} tn={tn}: FAILED {e!r}"[:200])
+            sys.stdout.flush()
+    rows.sort(key=lambda r: r[2])
+    out = f"tile sweep m={m} {label} best-first: "
+    for tk, tn, t in rows:
+        out += f"tk{tk}/tn{tn}={t*1e6:.0f}us({qbytes/t/1e9:.0f}GB/s) "
+    print(out)
+    sys.stdout.flush()
+
+
+def main():
+    # argv: 'suite' | M SHAPE [variant ...] — suite runs the whole decode +
+    # prefill matrix in ONE process (one ~2 min device init, not six)
+    if sys.argv[1:2] == ["suite"]:
+        for m, label, variants in SUITE:
+            try:
+                run_one(m, label, variants)
+            except Exception as e:
+                print(f"m={m} {label}: FAILED {e!r}"[:300])
+                sys.stdout.flush()
+        try:
+            sweep_blockdot_tiles()
+        except Exception as e:
+            print(f"tile sweep: FAILED {e!r}"[:300])
+            sys.stdout.flush()
+        return
+    run_one(int(sys.argv[1]), sys.argv[2], sys.argv[3:] or ["A", "B", "D", "E"])
 
 
 if __name__ == "__main__":

@@ -15,10 +15,8 @@ timeout 60 python -c "import jax; print('PROBE', jax.devices())" || { echo "tunn
 echo "== 2. kernel validation (compile + parity, ~3-5 min)"
 timeout 600 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/tpu_validate.py 2>&1 | tee "$L/validate_$TS.log"
 
-echo "== 3. decode-style micro-bench (1B shapes, m=8)"
-for v in A BD MD DQ D E; do
-  timeout 420 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/kbench.py 8 w1 "$v" 2>&1 | tail -1
-done | tee "$L/kbench_$TS.log"
+echo "== 3. kernel micro-bench suite (decode m=8 + prefill m=256/512, one process)"
+timeout 900 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/kbench.py suite 2>&1 | tee "$L/kbench_$TS.log"
 
 echo "== 4. full benchmark (1b + 8b + long + batched sweep)"
 timeout 900 python bench.py 2>&1 | tee "$L/bench_$TS.log" | tail -1

@@ -1,0 +1,23 @@
+#!/bin/sh
+# Probe the axon tunnel on a loop; the moment it's up, run the scripted
+# measurement session (experiments/tpu_session.sh). Designed to run nohup'd
+# in the background for hours: every probe and the session output land in
+# experiments/logs/ so a later shell can read the results.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p experiments/logs
+W=experiments/logs/watch.log
+i=0
+while [ "$i" -lt 120 ]; do
+  i=$((i + 1))
+  if timeout 240 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
+      >>"$W" 2>&1; then
+    echo "TUNNEL UP probe=$i $(date -u +%H:%M:%S)" >>"$W"
+    sh experiments/tpu_session.sh >>experiments/logs/session.log 2>&1
+    echo "SESSION DONE rc=$? $(date -u +%H:%M:%S)" >>"$W"
+    exit 0
+  fi
+  echo "probe $i down $(date -u +%H:%M:%S)" >>"$W"
+  sleep 200
+done
+echo "GAVE UP after $i probes" >>"$W"
