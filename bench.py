@@ -266,6 +266,40 @@ def bench_batched(cfg, params, slots, n_decode=64, kernels=None):
     }
 
 
+def bench_moe(n_tokens=256, iters=20):
+    """Micro-bench of the sparse-MoE FFN op: GShard-style dispatch (O(k/E)
+    FLOPs) vs the dense all-experts reference, Mixtral-shaped experts
+    (E=8, k=2) at 2048 width. One line in the result JSON."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dllama_tpu.models.config import LlamaConfig
+    from dllama_tpu.ops.layers import moe_ffn
+
+    cfg = LlamaConfig(dim=2048, hidden_dim=4096, n_layers=1, n_heads=16,
+                      n_kv_heads=8, vocab_size=256, seq_len=8,
+                      n_experts=8, n_active_experts=2)
+    rng = np.random.default_rng(0)
+    h = jnp.asarray(rng.standard_normal((1, n_tokens, cfg.dim)) * 0.1, jnp.bfloat16)
+    gate = jnp.asarray(rng.standard_normal((cfg.dim, 8)) * 0.1, jnp.float32)
+    ws = [jnp.asarray(rng.standard_normal(s) * 0.02, jnp.bfloat16)
+          for s in ((8, cfg.dim, cfg.hidden_dim), (8, cfg.hidden_dim, cfg.dim),
+                    (8, cfg.dim, cfg.hidden_dim))]
+    out = {}
+    for impl in ("dispatch", "dense"):
+        fn = jax.jit(lambda h, impl=impl: moe_ffn(cfg, h, gate, *ws, impl=impl))
+        jax.block_until_ready(fn(h))  # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn(h)
+        jax.block_until_ready(r)
+        out[f"{impl}_ms"] = round(1000 * (time.perf_counter() - t0) / iters, 3)
+    out["speedup"] = round(out["dense_ms"] / out["dispatch_ms"], 2)
+    out["tokens"] = n_tokens
+    return out
+
+
 def worker():
     import jax
     import jax.numpy as jnp
@@ -387,6 +421,13 @@ def worker():
         # CPU record instead of publishing a success-shaped 0.0
         raise SystemExit("all bench configs failed; see stderr")
 
+    moe = None
+    if preset != "tiny" and time.monotonic() < deadline - 90:
+        try:
+            moe = bench_moe()
+        except Exception as e:
+            moe = {"error": repr(e)[:200]}
+
     cfg8 = LlamaConfig(**PRESETS[run_presets[-1]])
     kb = collective_bytes_per_token(cfg8, tp=jax.device_count())["kb_per_token_per_chip"]
     result = {
@@ -402,6 +443,7 @@ def worker():
         "kernels": os.environ.get("BENCH_KERNELS", "auto"),
         "q40_style": q40_style,
         "xla_prefill_m": int(xla_prefill_m) if xla_prefill_m else None,
+        "moe": moe,
         "kb_per_token_per_chip": round(kb, 1),
     }
     print(json.dumps(result))

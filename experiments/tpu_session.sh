@@ -18,7 +18,10 @@ timeout 600 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/tpu_validat
 echo "== 3. kernel micro-bench suite (decode m=8 + prefill m=256/512, one process)"
 timeout 900 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/kbench.py suite 2>&1 | tee "$L/kbench_$TS.log"
 
-echo "== 4. full benchmark (1b + 8b + long + batched sweep)"
+echo "== 4. engine-knob A/B (1B, one process)"
+timeout 900 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/ebench.py 2>&1 | tee "$L/ebench_$TS.log"
+
+echo "== 5. full benchmark (1b + 8b + long + batched sweep)"
 timeout 900 python bench.py 2>&1 | tee "$L/bench_$TS.log" | tail -1
 
 echo "== done; logs in $L/*_$TS.log"
