@@ -266,6 +266,22 @@ def bench_batched(cfg, params, slots, n_decode=64, kernels=None):
     }
 
 
+def _widen_scales(params):
+    """QTensor leaves with f16 scales -> f32 copies (the Mosaic-u16 escape
+    hatch: Pallas keeps running, at f32-scale HBM traffic)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dllama_tpu.ops.quant import QTensor
+
+    def widen(leaf):
+        if isinstance(leaf, QTensor) and leaf.scales.dtype == jnp.float16:
+            return QTensor(leaf.packed, leaf.scales.astype(jnp.float32))
+        return leaf
+
+    return jax.tree.map(widen, params, is_leaf=lambda l: isinstance(l, QTensor))
+
+
 def bench_moe(n_tokens=256, iters=20):
     """Micro-bench of the sparse-MoE FFN op: GShard-style dispatch (O(k/E)
     FLOPs) vs the dense all-experts reference, Mixtral-shaped experts
@@ -361,20 +377,27 @@ def worker():
         setup_s += time.perf_counter() - t0
         north = 1000.0 * (8.03e9 / params_count(cfg))
         # graceful degradation: the fused auto path first, then the simpler
-        # deq-style Pallas kernel, then the XLA backend — a kernel regression
-        # downgrades the number instead of erasing it
+        # deq-style Pallas kernel, then Pallas with f32-widened scales (in
+        # case Mosaic rejects the u16 scale tiles), then the XLA backend — a
+        # kernel regression downgrades the number instead of erasing it
         from dllama_tpu.ops.pallas import q40_matmul as _qm
 
-        attempts = [(q40_style, None)] + [
-            a for a in (("maskdot", None), ("deq", None), ("auto", "xla"))
-            if a != (q40_style, None)
+        attempts = [(q40_style, None, False)] + [
+            a for a in (("maskdot", None, False), ("deq", None, False),
+                        ("auto", None, True), ("auto", "xla", False))
+            if a != (q40_style, None, False)
         ]
-        for style, kern in attempts:
+        wide_params = None
+        for style, kern, widen in attempts:
             _qm.STYLE = style
             try:
-                r = bench_engine(cfg, params, n_decode, unroll,
-                                 prompt_len=PROMPT_LENS.get(name, 512), kernels=kern)
-                r["path"] = f"style={style} kernels={kern or 'auto'}"
+                if widen and wide_params is None:
+                    wide_params = _widen_scales(params)
+                r = bench_engine(cfg, wide_params if widen else params, n_decode,
+                                 unroll, prompt_len=PROMPT_LENS.get(name, 512),
+                                 kernels=kern)
+                r["path"] = f"style={style} kernels={kern or 'auto'}" + (
+                    " scales=f32" if widen else "")
                 results[name] = r
                 if r["decode_tok_s"] / north > best[0]:
                     best = (r["decode_tok_s"] / north, f"{LABELS[name]} batch=1 decode",
@@ -410,7 +433,7 @@ def worker():
                 batch_results.append(br)
                 if br["agg_tok_s"] / north > best[0]:
                     best = (br["agg_tok_s"] / north, f"{LABELS[name]} {slots}-slot serving", br["agg_tok_s"])
-        del params
+        del params, wide_params
 
     # bytes/token is part of the benchmark contract (SURVEY.md §5.1/§6): on
     # one chip it's 0; multi-chip runs report the analytic ICI payload.

@@ -179,7 +179,7 @@ class LazyQ40:
     """A Q40 matmul weight still living as bytes on the `.m` memmap.
 
     Shards decode ON DEMAND in the device layout (packed u8[k/2, n], scales
-    f32[k/32, n]): `jax.make_array_from_callback` asks only for the shards a
+    f16[k/32, n]): `jax.make_array_from_callback` asks only for the shards a
     host's devices own, so a model bigger than one host's RAM never fully
     decodes anywhere — the byte-range analog of the reference's
     slice-then-ship (nn-network.cpp:775-869), with the mmap as the wire.
@@ -225,9 +225,10 @@ class LazyQ40:
         from dllama_tpu.utils import native
 
         if native.has_q40_shard():
-            return native.q40_shard(self.rec, n0, n1, b0, b1, False, True)[1]
+            # the C++ twin emits f32; narrowing back to f16 is exact
+            return native.q40_shard(self.rec, n0, n1, b0, b1, False, True)[1].astype(np.float16)
         sub = np.ascontiguousarray(self.rec[n0:n1, b0:b1, :2])  # [n, nb, 2]
-        return sub.view(np.float16)[..., 0].T.astype(np.float32)  # [nb, n]
+        return np.ascontiguousarray(sub.view(np.float16)[..., 0].T)  # f16 [nb, n]
 
     def eager(self) -> QTensor:
         full = slice(None)

@@ -226,10 +226,9 @@ def random_params_fast(cfg: LlamaConfig, seed: int = 0, dtype=jnp.bfloat16):
 
     def qw(lead, k, n):
         packed = rng.integers(0, 256, (*lead, k // 2, n), dtype=np.uint8)
-        # scales through f16 like the file format; small positive spread
+        # f16 scales like the file format; small positive spread
         scales = rng.random((*lead, k // Q_BLOCK, n), np.float32) * 0.02 + 1e-3
-        scales = scales.astype(np.float16).astype(np.float32)
-        return QTensor(jnp.asarray(packed), jnp.asarray(scales))
+        return QTensor(jnp.asarray(packed), jnp.asarray(scales.astype(np.float16)))
 
     L = cfg.n_layers
     layers: dict = {

@@ -2,8 +2,8 @@
 
 The reference's equivalent is matmul_Q80_Q40 (nn-cpu-ops.cpp:225-446) for
 decode plus llamafile sgemm (sgemm.cpp:819-1010) for prefill; on TPU the win
-is HBM bandwidth: the kernel streams the *packed* 4-bit weights (0.69
-bytes/weight incl. f32 scales) from HBM into VMEM and dequantizes on-chip
+is HBM bandwidth: the kernel streams the *packed* 4-bit weights (0.5625
+bytes/weight incl. f16 scales) from HBM into VMEM and dequantizes on-chip
 right before the MXU dot — ~3x less HBM traffic than bf16 weights, which is
 the whole game for small-batch decode.
 
@@ -37,7 +37,8 @@ Two TPU-specific design points beyond the reference's scheme:
 
 Layout (see ops/quant.QTensor): ``packed: u8[(L,) k/2, n]`` where packed row
 ``16*b + j`` holds codes for input dims ``32*b + j`` (low nibble) and
-``32*b + j + 16`` (high nibble); ``scales: f32[(L,) k/32, n]``.
+``32*b + j + 16`` (high nibble); ``scales: f16[(L,) k/32, n]`` (streamed as
+raw u16 bits, widened in-register by ``_scales_f32``).
 
 Grid is (m_tiles, n_tiles, k_tiles) with k innermost: the f32 accumulator
 block stays VMEM-resident across the k sweep and is written back once per
@@ -91,6 +92,32 @@ def _unpack_codes(packed_block, tk: int, tn: int):
     return jax.lax.bitcast_convert_type(codes, jnp.float32) - _V_OFFSET
 
 
+# 2^112: shifts an f16 exponent (bias 15) into the f32 field (bias 127) after
+# the mantissa/exponent bits are placed at f32 positions.
+_F16_WIDEN = 2.0 ** 112
+
+
+def _scales_f32(s):
+    """Widen a scales tile to f32 in-register.
+
+    QTensor scales live as f16 in HBM (half the scale bytes — ~10% of Q40
+    decode traffic) and reach the kernel bitcast to u16 (the dispatcher does
+    the bitcast; Mosaic support for f16 vectors is not assumed). The widening
+    places sign/exponent/mantissa at their f32 offsets and rescales by 2^112 —
+    exact for all normal AND subnormal f16 values (the classic half->float
+    exponent-scaling identity; the only mismatch would be f16 inf/nan, which
+    the Q40 quantizer never produces). Note: if the VPU flushes f32
+    subnormals, a subnormal f16 scale (<6.1e-5) decodes to 0 — affected
+    weights are < 5e-4 in magnitude, far below quantization noise.
+
+    f32 tiles pass through untouched (hand-built QTensors)."""
+    if s.dtype == jnp.uint16:
+        u = s.astype(jnp.uint32)
+        bits = ((u & 0x8000) << 16) | ((u & 0x7FFF) << 13)
+        return jax.lax.bitcast_convert_type(bits, jnp.float32) * _F16_WIDEN
+    return s.astype(jnp.float32)
+
+
 def _deq_kernel(layer_ref, x_ref, packed_ref, scales_ref, out_ref, acc_ref, *, tk, tn):
     del layer_ref  # consumed by the index maps
     kb = pl.program_id(2)
@@ -100,7 +127,7 @@ def _deq_kernel(layer_ref, x_ref, packed_ref, scales_ref, out_ref, acc_ref, *, t
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     c = _unpack_codes(packed_ref[:], tk, tn)  # [nb, 32, tn] exact q - 8
-    s = scales_ref[:][:, None, :]
+    s = _scales_f32(scales_ref[:])[:, None, :]
     w = (c * s).reshape(tk, tn).astype(x_ref.dtype)
     acc_ref[:] += jnp.dot(x_ref[:], w, preferred_element_type=jnp.float32)
 
@@ -127,7 +154,7 @@ def _blockdot_kernel(
     y = jax.lax.dot_general(
         xb_ref[:], c, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
     )  # [nb, m, tn]
-    s = scales_ref[:][:, None, :]  # [nb, 1, tn]
+    s = _scales_f32(scales_ref[:])[:, None, :]  # [nb, 1, tn]
     acc_ref[:] += jnp.sum(y * s, axis=0)
 
     @pl.when(kb == pl.num_programs(1) - 1)
@@ -166,7 +193,7 @@ def _deq_call(layer, x, packed, scales, *, interpret: bool = False):
             flops=2 * m * n * k,
             bytes_accessed=m * k * x.dtype.itemsize
             + k * n // 2
-            + (k // Q_BLOCK) * n * 4
+            + (k // Q_BLOCK) * n * scales.dtype.itemsize
             + m * n * 4,
             transcendentals=0,
         ),
@@ -193,7 +220,7 @@ def _maskdot_kernel(
     blk = jax.lax.broadcasted_iota(jnp.int32, (nb, m, tk), 0)
     xaug = jnp.where(lane // Q_BLOCK == blk, x_ref[:][None], 0).reshape(nb * m, tk)
     y = jnp.dot(xaug, w, preferred_element_type=jnp.float32).reshape(nb, m, tn)
-    acc_ref[:] += jnp.sum(y * scales_ref[:][:, None, :], axis=0)
+    acc_ref[:] += jnp.sum(y * _scales_f32(scales_ref[:])[:, None, :], axis=0)
 
     @pl.when(kb == pl.num_programs(1) - 1)
     def _():
@@ -228,7 +255,7 @@ def _maskdot_call(layer, x, packed, scales, *, interpret: bool = False):
         ),
         cost_estimate=pl.CostEstimate(
             flops=2 * m * n * k * (tk // Q_BLOCK),  # nb-masked redundant MACs
-            bytes_accessed=m * k * x.dtype.itemsize + k * n // 2 + (k // Q_BLOCK) * n * 4 + m * n * 4,
+            bytes_accessed=m * k * x.dtype.itemsize + k * n // 2 + (k // Q_BLOCK) * n * scales.dtype.itemsize + m * n * 4,
             transcendentals=0,
         ),
         interpret=interpret,
@@ -269,7 +296,7 @@ def _blockdot_call(layer, x, packed, scales, *, interpret: bool = False,
         ),
         cost_estimate=pl.CostEstimate(
             flops=2 * m * n * k,
-            bytes_accessed=m * k * 4 + k * n // 2 + (k // Q_BLOCK) * n * 4 + m * n * 4,
+            bytes_accessed=m * k * 4 + k * n // 2 + (k // Q_BLOCK) * n * scales.dtype.itemsize + m * n * 4,
             transcendentals=0,
         ),
         interpret=interpret,
@@ -305,6 +332,9 @@ def q40_matmul(
         packed, scales = w.packed, w.scales
         assert layer is not None, "stacked QTensor needs a layer index"
     n = packed.shape[-1]
+    if scales.dtype == jnp.float16:
+        # kernels take raw u16 bits (see _scales_f32); the bitcast is free
+        scales = jax.lax.bitcast_convert_type(scales, jnp.uint16)
     layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
     x2 = x.reshape(m, k)
     # pad rows up to the f32 sublane (8) so tiny decode batches still tile
@@ -340,5 +370,7 @@ def q40_matmul_2d(
     x: jax.Array, packed: jax.Array, scales: jax.Array, *, interpret: bool = False
 ) -> jax.Array:
     """Back-compat wrapper: x[m, k] @ dequant(packed, scales) -> f32[m, n]."""
+    if scales.dtype == jnp.float16:
+        scales = jax.lax.bitcast_convert_type(scales, jnp.uint16)
     layer = jnp.zeros((1,), jnp.int32)
     return _deq_call(layer, x, packed[None], scales[None], interpret=interpret)

@@ -177,9 +177,12 @@ class QTensor:
     """
 
     packed: jax.Array  # u8 [k//2, n]
-    scales: jax.Array  # f32 [k//32, n] — file stores f16, but TPU/Mosaic has no
-    # f16 support; every f16 value is exactly representable in f32, so device
-    # scales are widened at load with zero numeric drift.
+    scales: jax.Array  # f16 [k//32, n] — the file's own scale dtype, kept
+    # 2-byte in HBM so the decode kernels stream half the scale bytes (~10%
+    # of Q40 weight traffic). XLA paths widen with .astype (exact — every f16
+    # is representable in f32); the Pallas kernels take the scales bitcast to
+    # u16 and widen in-register (exact exponent-scaling trick, q40_matmul.py).
+    # f32 scales are still accepted everywhere for hand-built QTensors.
 
     def tree_flatten(self):
         return (self.packed, self.scales), None
@@ -209,7 +212,7 @@ class QTensor:
         packed, scales = quantize_q40_np(np.ascontiguousarray(w.T))  # [n, k/32, 16]
         k = w.shape[0]
         packed = np.transpose(packed, (1, 2, 0)).reshape(k // 2, w.shape[1])
-        scales = np.transpose(scales, (1, 0)).astype(np.float32)
+        scales = np.ascontiguousarray(np.transpose(scales, (1, 0)))  # f16
         return cls(jnp.asarray(packed), jnp.asarray(scales))
 
     @classmethod
@@ -222,7 +225,7 @@ class QTensor:
         packed = packed.reshape(n_out, k_in // Q_BLOCK, Q_BLOCK // 2)
         scales = scales.reshape(n_out, k_in // Q_BLOCK)
         packed = np.ascontiguousarray(np.transpose(packed, (1, 2, 0))).reshape(k_in // 2, n_out)
-        scales = np.ascontiguousarray(np.transpose(scales, (1, 0))).astype(np.float32)
+        scales = np.ascontiguousarray(np.transpose(scales, (1, 0)), dtype=np.float16)
         if not device:
             return cls(packed, scales)
         return cls(jnp.asarray(packed), jnp.asarray(scales))

@@ -158,3 +158,56 @@ def test_q40_styles_agree(rng, style):
     finally:
         qmod.STYLE = old
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-2, rtol=2e-2)
+
+
+class TestDispatchKnobs:
+    """Contracts for the measurement-session knobs: prefill GEMM routing
+    (ops.matmul.XLA_PREFILL_MIN_M) and blockdot tile overrides."""
+
+    def test_xla_prefill_routing_threshold(self, monkeypatch):
+        """Pins that the threshold actually ROUTES (not merely that both
+        paths agree numerically): the fused kernel is stubbed to raise, so a
+        m>=threshold call must bypass it and a m<threshold call must hit it."""
+        from dllama_tpu.ops import matmul as mm
+        from dllama_tpu.ops.pallas import q40_matmul as qm
+
+        w = QTensor.quantize((np.random.default_rng(0).standard_normal((256, 256)) * 0.05).astype(np.float32))
+        x = jnp.asarray(np.random.default_rng(1).standard_normal((64, 256)), jnp.bfloat16)
+        ref = np.asarray(mm.matmul(x, w, backend="xla"), np.float32)
+        monkeypatch.setattr(mm, "XLA_PREFILL_MIN_M", 32)
+
+        def boom(*a, **k):
+            raise AssertionError("fused kernel must not run at m >= threshold")
+
+        monkeypatch.setattr(qm, "q40_matmul", boom)
+        got = np.asarray(mm.matmul(x, w, backend="pallas"), np.float32)  # routed
+        np.testing.assert_allclose(got, ref, atol=3e-2, rtol=3e-2)
+        # below the threshold the fused kernel must be invoked
+        x8 = jnp.asarray(np.random.default_rng(2).standard_normal((8, 256)), jnp.bfloat16)
+        with pytest.raises(AssertionError, match="fused kernel"):
+            mm.matmul(x8, w, backend="pallas")
+
+    def test_blockdot_tile_override_matches_default(self, monkeypatch):
+        from dllama_tpu.ops.pallas import q40_matmul as qm
+
+        w = QTensor.quantize((np.random.default_rng(3).standard_normal((256, 256)) * 0.05).astype(np.float32))
+        x = jnp.asarray(np.random.default_rng(4).standard_normal((8, 256)), jnp.bfloat16)
+        monkeypatch.setattr(qm, "STYLE", "blockdot")
+        want = np.asarray(qm.q40_matmul(x, w, interpret=True), np.float32)
+        monkeypatch.setattr(qm, "BLOCKDOT_TK", 128)
+        monkeypatch.setattr(qm, "BLOCKDOT_TN", 128)
+        got = np.asarray(qm.q40_matmul(x, w, interpret=True), np.float32)
+        np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+    def test_invalid_tile_override_falls_back(self, monkeypatch):
+        from dllama_tpu.ops.pallas import q40_matmul as qm
+
+        w = QTensor.quantize((np.random.default_rng(5).standard_normal((256, 256)) * 0.05).astype(np.float32))
+        x = jnp.asarray(np.random.default_rng(6).standard_normal((8, 256)), jnp.bfloat16)
+        monkeypatch.setattr(qm, "STYLE", "blockdot")
+        monkeypatch.setattr(qm, "BLOCKDOT_TK", 96)   # not /32-aligned: ignored
+        monkeypatch.setattr(qm, "BLOCKDOT_TN", 100)  # does not divide n: ignored
+        got = np.asarray(qm.q40_matmul(x, w, interpret=True), np.float32)
+        ref = np.asarray(w.dequantize(jnp.float32), np.float32)
+        want = np.asarray(x, np.float32) @ ref
+        np.testing.assert_allclose(got, want, atol=3e-2, rtol=3e-2)
