@@ -418,13 +418,19 @@ def worker():
                     batch_results.append({"slots": slots, "skipped": "budget"})
                     continue
                 br = None
-                for kern in (None, "xla"):  # same degradation as batch=1
+                # same degradation as batch=1: fused auto -> widened-scales
+                # Pallas (Mosaic-u16 escape hatch) -> XLA backend
+                for kern, widen in ((None, False), (None, True), ("xla", False)):
                     try:
-                        br = bench_batched(cfg, params, slots, kernels=kern)
-                        br["path"] = f"kernels={kern or 'auto'}"
+                        if widen and wide_params is None:
+                            wide_params = _widen_scales(params)
+                        br = bench_batched(cfg, wide_params if widen else params,
+                                           slots, kernels=kern)
+                        br["path"] = f"kernels={kern or 'auto'}" + (
+                            " scales=f32" if widen else "")
                         break
                     except Exception as e:
-                        print(f"batched slots={slots} ({kern}) failed: {e!r}"[:500],
+                        print(f"batched slots={slots} ({kern},{widen}) failed: {e!r}"[:500],
                               file=sys.stderr)
                         batch_results.append({"slots": slots, "error": repr(e)[:200]})
                 if br is None:

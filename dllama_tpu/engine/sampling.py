@@ -13,11 +13,30 @@ import jax
 import jax.numpy as jnp
 
 
+# top-p candidate-set width: nucleus sampling restricts to the approx-top-K
+# logits instead of full-vocab sort (see sample_logits). At real-vocab sizes
+# and topp <= 0.99 the nucleus essentially never exceeds a few dozen tokens.
+NUCLEUS_K = 256
+
+
 def sample_logits(logits: jax.Array, key: jax.Array, temperature, topp) -> jax.Array:
     """logits f32 [B, V] -> tokens i32 [B]. Branchless in temperature/topp so
     both can be *traced* scalars — the fused decode loop and the API server
     never recompile when a request changes sampling params. Either may also be
-    an [B] vector (per-slot params in the continuous-batching engine)."""
+    an [B] vector (per-slot params in the continuous-batching engine).
+
+    Top-p is computed over the ``approx_max_k`` top-NUCLEUS_K candidates (the
+    TPU-native top-k; exact on CPU) with probabilities normalized against the
+    FULL vocab, instead of the reference's full-vocab sort
+    (tokenizer.cpp:389-395): an XLA sort of a 128k-vocab row per decode step
+    costs more than a whole transformer layer, and a nucleus wider than 256
+    tokens requires a distribution so flat that truncating it is noise. The
+    kept-set rule within the candidates is the reference's break-after-include.
+    If the candidates cover less than topp of the full-vocab mass (a nucleus
+    wider than K — very high temperature on a large vocab), the row falls back
+    to full-vocab temperature sampling rather than silently behaving as
+    top-k=K. Pure temperature sampling (topp <= 0 or >= 1) stays full-vocab
+    (categorical = gumbel-argmax, no sort)."""
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     temperature = jnp.asarray(temperature, jnp.float32)
@@ -27,19 +46,32 @@ def sample_logits(logits: jax.Array, key: jax.Array, temperature, topp) -> jax.A
     if topp.ndim == 1:
         topp = topp[:, None]
     scaled = logits / jnp.maximum(temperature, 1e-6)
-    probs = jax.nn.softmax(scaled, axis=-1)
-    sorted_probs = jnp.sort(probs, axis=-1, descending=True)
-    cum = jnp.cumsum(sorted_probs, axis=-1)
-    # keep tokens while the cumulative mass *before* them is < topp
-    # (i.e. include the token that first crosses topp, like sample_topp's
-    # break-after-include, tokenizer.cpp:389-395)
-    keep_sorted = (cum - sorted_probs) < topp
-    threshold = jnp.min(
-        jnp.where(keep_sorted, sorted_probs, jnp.inf), axis=-1, keepdims=True
-    )
-    use_topp = (topp > 0.0) & (topp < 1.0)
-    masked = jnp.where(use_topp & (probs < threshold), -jnp.inf, scaled)
-    sampled = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
+    key_p, key_t = jax.random.split(key)
+
+    # --- top-p among the top-K candidates, full-vocab-normalized
+    k = min(NUCLEUS_K, logits.shape[-1])
+    vals, idx = jax.lax.approx_max_k(scaled, k, recall_target=0.99,
+                                     aggregate_to_topk=True)  # sorted desc
+    lse = jax.scipy.special.logsumexp(scaled, axis=-1, keepdims=True)
+    pk = jnp.exp(vals - lse)  # true softmax probs of the candidates
+    cum = jnp.cumsum(pk, axis=-1)
+    # keep while cumulative mass *before* the token is < topp (include the
+    # token that crosses topp — the reference's break-after-include)
+    keep = (cum - pk) < topp
+    masked = jnp.where(keep, vals, -jnp.inf)
+    choice = jax.random.categorical(key_p, masked, axis=-1)
+    tok_topp = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+    # --- pure temperature sampling: full vocab, no truncation
+    tok_temp = jax.random.categorical(key_t, scaled, axis=-1).astype(jnp.int32)
+
+    # nucleus wider than K: candidates don't reach topp mass — fall back to
+    # untruncated temperature sampling for that row (see docstring)
+    covered = cum[:, -1:] >= topp
+    use_topp = (topp > 0.0) & (topp < 1.0) & covered
+    if use_topp.ndim == 2:
+        use_topp = use_topp[:, 0]
+    sampled = jnp.where(use_topp, tok_topp, tok_temp)
     t_is_zero = temperature == 0.0
     if t_is_zero.ndim == 2:
         t_is_zero = t_is_zero[:, 0]

@@ -18,22 +18,25 @@ from dllama_tpu.ops.quant import dequantize_q80_jnp, quantize_q80_jnp
 
 
 def q80_all_gather(x: jax.Array, axis_name: str, axis: int = 0, tiled: bool = True) -> jax.Array:
-    """all_gather(x) with the payload quantized to Q80 (codes i8 + f32 block
-    scales) — 1/2 the bytes of bf16, 1/4 of f32 on the wire."""
+    """all_gather(x) with the payload quantized to Q80 (codes i8 + f16 block
+    scales, the reference's own NnBlockQ80 wire format) — ~1/2 the bytes of
+    bf16, ~1/4 of f32 on the wire."""
     codes, scales = quantize_q80_jnp(x)
     codes_g = jax.lax.all_gather(codes, axis_name, axis=axis, tiled=tiled)
-    scales_g = jax.lax.all_gather(scales, axis_name, axis=axis, tiled=tiled)
-    return dequantize_q80_jnp(codes_g, scales_g, x.dtype)
+    scales_g = jax.lax.all_gather(scales.astype(jnp.float16), axis_name, axis=axis, tiled=tiled)
+    return dequantize_q80_jnp(codes_g, scales_g.astype(jnp.float32), x.dtype)
 
 
 def q80_all_reduce(x: jax.Array, axis_name: str) -> jax.Array:
     """The reference's all-reduce: all-gather Q80 partial sums, reduce locally
     (all-gather + merge-add ≡ all-reduce, SURVEY.md §3.4). Payload is the
-    quantized partials; the reduction itself is f32 on-chip."""
+    quantized partials with f16 scales (NnBlockQ80's wire dtype; the f32→f16
+    scale rounding is ~5e-4 relative, far inside Q80's ~1e-2 step); the
+    reduction itself is f32 on-chip."""
     codes, scales = quantize_q80_jnp(x)
     codes_g = jax.lax.all_gather(codes, axis_name, axis=0, tiled=False)
-    scales_g = jax.lax.all_gather(scales, axis_name, axis=0, tiled=False)
-    parts = dequantize_q80_jnp(codes_g, scales_g, jnp.float32)
+    scales_g = jax.lax.all_gather(scales.astype(jnp.float16), axis_name, axis=0, tiled=False)
+    parts = dequantize_q80_jnp(codes_g, scales_g.astype(jnp.float32), jnp.float32)
     return jnp.sum(parts, axis=0).astype(x.dtype)
 
 

@@ -205,7 +205,8 @@ class TestDispatchKnobs:
         w = QTensor.quantize((np.random.default_rng(5).standard_normal((256, 256)) * 0.05).astype(np.float32))
         x = jnp.asarray(np.random.default_rng(6).standard_normal((8, 256)), jnp.bfloat16)
         monkeypatch.setattr(qm, "STYLE", "blockdot")
-        monkeypatch.setattr(qm, "BLOCKDOT_TK", 96)   # not /32-aligned: ignored
+        monkeypatch.setattr(qm, "BLOCKDOT_TK", 16)   # divides k=256 but NOT
+        # Q_BLOCK-aligned (16 % 32 != 0): the alignment clause must reject it
         monkeypatch.setattr(qm, "BLOCKDOT_TN", 100)  # does not divide n: ignored
         got = np.asarray(qm.q40_matmul(x, w, interpret=True), np.float32)
         ref = np.asarray(w.dequantize(jnp.float32), np.float32)
