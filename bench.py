@@ -418,6 +418,7 @@ def worker():
                     batch_results.append({"slots": slots, "skipped": "budget"})
                     continue
                 br = None
+                last_err = None
                 # same degradation as batch=1: fused auto -> widened-scales
                 # Pallas (Mosaic-u16 escape hatch) -> XLA backend
                 for kern, widen in ((None, False), (None, True), ("xla", False)):
@@ -432,8 +433,9 @@ def worker():
                     except Exception as e:
                         print(f"batched slots={slots} ({kern},{widen}) failed: {e!r}"[:500],
                               file=sys.stderr)
-                        batch_results.append({"slots": slots, "error": repr(e)[:200]})
-                if br is None:
+                        last_err = e
+                if br is None:  # one record per slots value, only if ALL failed
+                    batch_results.append({"slots": slots, "error": repr(last_err)[:200]})
                     continue
                 br["preset"] = name
                 batch_results.append(br)

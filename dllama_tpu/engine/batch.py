@@ -98,6 +98,15 @@ class BatchEngine:
             partial(self._prefill_impl, cfg, attn_fn, self._col_fn, mm, mm_in, moe_impl),
             donate_argnums=(1,),
         )
+        self._prefill_slot = jax.jit(
+            partial(self._prefill_slot_impl, cfg, attn_fn, self._col_fn, mm, mm_in, moe_impl),
+            donate_argnums=(1,),
+        )
+        # admission prefill sliced to one slot runs the forward at B=1 —
+        # admission cost independent of n_slots. Needs the batch axis
+        # unsharded (a dp mesh shards slots across chips; slicing one slot
+        # would cross shards), so dp>1 keeps the masked full-width path.
+        self._use_slot_prefill = shardings is None or shardings.mesh.shape["dp"] == 1
         self._decode = jax.jit(
             partial(self._decode_impl, cfg, attn_fn, self._col_fn, mm, mm_in, moe_impl),
             static_argnums=(8,), donate_argnums=(1,),
@@ -112,6 +121,27 @@ class BatchEngine:
                                 active=active, col_fn=col_fn, mm=mm, mm_in=mm_in,
                                 moe_impl=moe_impl, last_only=True)
         return logits[:, -1], cache
+
+    @staticmethod
+    def _prefill_slot_impl(cfg, attn_fn, col_fn, mm, mm_in, moe_impl, params, cache,
+                           tokens, slot, pos, rope):
+        """Admission prefill for ONE slot: slice the slot's cache rows
+        (batch axis), run the forward at B=1, write the rows back. A 32-slot
+        engine admits a prompt at 1/32 the FLOPs of the masked full-width
+        step — the other slots' caches are untouched by construction, not by
+        masking. `slot` and `pos` are traced scalars (no per-slot recompiles).
+        """
+        sub = KVCache(
+            jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1),
+            jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1),
+        )
+        logits, sub = forward(cfg, params, tokens, pos, sub, rope, attn_fn,
+                              col_fn=col_fn, mm=mm, mm_in=mm_in,
+                              moe_impl=moe_impl, last_only=True)
+        return logits[:, -1], KVCache(
+            jax.lax.dynamic_update_slice_in_dim(cache.k, sub.k, slot, axis=1),
+            jax.lax.dynamic_update_slice_in_dim(cache.v, sub.v, slot, axis=1),
+        )
 
     @staticmethod
     def _decode_impl(cfg, attn_fn, col_fn, mm, mm_in, moe_impl, params, cache, tokens,
@@ -164,22 +194,33 @@ class BatchEngine:
             c = min(self.max_prefill_chunk, 1 << (n - off - 1).bit_length())
             while c > n - off:
                 c //= 2
-            chunk = np.zeros((self.n_slots, c), np.int32)
-            chunk[slot] = toks[off : off + c]
-            # rope/cache row indexing needs every row's pos valid; frozen rows
-            # pass their current pos (writes masked anyway).
-            # .copy() is load-bearing on every host->device handoff here:
-            # jnp.asarray can zero-copy ALIAS a numpy buffer on CPU, and this
-            # engine mutates pos/active/last_token in place after dispatching
-            # async device work — aliasing turns that into a read/write race.
-            pos_vec = jnp.asarray(self.pos.copy(), jnp.int32)
-            logits, self.cache = self._prefill_step(
-                self.params, self.cache,
-                jnp.asarray(chunk),
-                pos_vec,
-                jnp.asarray(onehot.copy()),
-                self.rope_cache,
-            )
+            if self._use_slot_prefill:
+                row, self.cache = self._prefill_slot(
+                    self.params, self.cache,
+                    jnp.asarray(toks[off : off + c][None]),
+                    jnp.int32(slot),
+                    jnp.int32(self.pos[slot]),
+                    self.rope_cache,
+                )
+                logits = row  # [1, V] — the slot's own row
+            else:
+                chunk = np.zeros((self.n_slots, c), np.int32)
+                chunk[slot] = toks[off : off + c]
+                # rope/cache row indexing needs every row's pos valid; frozen
+                # rows pass their current pos (writes masked anyway).
+                # .copy() is load-bearing on every host->device handoff here:
+                # jnp.asarray can zero-copy ALIAS a numpy buffer on CPU, and
+                # this engine mutates pos/active/last_token in place after
+                # dispatching async device work — aliasing turns that into a
+                # read/write race.
+                pos_vec = jnp.asarray(self.pos.copy(), jnp.int32)
+                logits, self.cache = self._prefill_step(
+                    self.params, self.cache,
+                    jnp.asarray(chunk),
+                    pos_vec,
+                    jnp.asarray(onehot.copy()),
+                    self.rope_cache,
+                )
             self.pos[slot] += c
             off += c
 
@@ -191,8 +232,9 @@ class BatchEngine:
         key, sub = jax.random.split(key)
         self.keys[slot] = np.array(key)  # np.array copies (np.asarray of a jax
         # array is a read-only view; this row is mutated on every add)
+        row = logits if self._use_slot_prefill else logits[slot : slot + 1]
         first = int(np.asarray(
-            sample_logits(logits[slot : slot + 1], sub, jnp.float32(temperature), jnp.float32(topp))
+            sample_logits(row, sub, jnp.float32(temperature), jnp.float32(topp))
         )[0])
         self.active[slot] = True
         self.last_token[slot] = first

@@ -16,6 +16,15 @@ import jax.numpy as jnp
 
 from dllama_tpu.ops.quant import dequantize_q80_jnp, quantize_q80_jnp
 
+_F16_MAX = 65504.0
+
+
+def _f16_wire(scales: jax.Array) -> jax.Array:
+    """f32 block scales -> f16 for the wire, saturation-safe: a block with
+    absmax > ~8.3e6 would otherwise overflow f16 to inf and poison the whole
+    reduced tensor. Clamping to f16-max keeps the block merely coarser."""
+    return jnp.clip(scales, -_F16_MAX, _F16_MAX).astype(jnp.float16)
+
 
 def q80_all_gather(x: jax.Array, axis_name: str, axis: int = 0, tiled: bool = True) -> jax.Array:
     """all_gather(x) with the payload quantized to Q80 (codes i8 + f16 block
@@ -23,7 +32,7 @@ def q80_all_gather(x: jax.Array, axis_name: str, axis: int = 0, tiled: bool = Tr
     bf16, ~1/4 of f32 on the wire."""
     codes, scales = quantize_q80_jnp(x)
     codes_g = jax.lax.all_gather(codes, axis_name, axis=axis, tiled=tiled)
-    scales_g = jax.lax.all_gather(scales.astype(jnp.float16), axis_name, axis=axis, tiled=tiled)
+    scales_g = jax.lax.all_gather(_f16_wire(scales), axis_name, axis=axis, tiled=tiled)
     return dequantize_q80_jnp(codes_g, scales_g.astype(jnp.float32), x.dtype)
 
 
@@ -35,7 +44,7 @@ def q80_all_reduce(x: jax.Array, axis_name: str) -> jax.Array:
     reduction itself is f32 on-chip."""
     codes, scales = quantize_q80_jnp(x)
     codes_g = jax.lax.all_gather(codes, axis_name, axis=0, tiled=False)
-    scales_g = jax.lax.all_gather(scales.astype(jnp.float16), axis_name, axis=0, tiled=False)
+    scales_g = jax.lax.all_gather(_f16_wire(scales), axis_name, axis=0, tiled=False)
     parts = dequantize_q80_jnp(codes_g, scales_g.astype(jnp.float32), jnp.float32)
     return jnp.sum(parts, axis=0).astype(x.dtype)
 

@@ -178,3 +178,29 @@ def test_batch_engine_rejects_sp_mesh():
     sh = LlamaShardings(mesh, CFG)
     with pytest.raises(ValueError, match="tp/dp"):
         BatchEngine(CFG, PARAMS, n_slots=2, shardings=sh)
+
+
+def test_slot_prefill_matches_masked_full_width():
+    """The B=1 slot-sliced admission prefill must produce the same cache rows
+    and first-token logits as the masked full-width step it replaces."""
+    be_slot = BatchEngine(CFG, PARAMS, n_slots=3, seed=5, cache_dtype=jnp.float32)
+    be_full = BatchEngine(CFG, PARAMS, n_slots=3, seed=5, cache_dtype=jnp.float32)
+    assert be_slot._use_slot_prefill
+    be_full._use_slot_prefill = False
+
+    prompt = [5, 6, 7, 8, 9]
+    t1 = be_slot.add(1, prompt, temperature=0.0, seed=11)
+    t2 = be_full.add(1, prompt, temperature=0.0, seed=11)
+    assert t1 == t2
+    np.testing.assert_allclose(
+        np.asarray(be_slot.cache.k, np.float32),
+        np.asarray(be_full.cache.k, np.float32), atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(be_slot.cache.v, np.float32),
+        np.asarray(be_full.cache.v, np.float32), atol=1e-5, rtol=1e-5)
+    # untouched slots remain zero
+    assert float(np.abs(np.asarray(be_slot.cache.k, np.float32)[:, 0]).max()) == 0.0
+    # and decode after slot-admission continues identically
+    d1 = be_slot.decode(4)
+    d2 = be_full.decode(4)
+    np.testing.assert_array_equal(d1[:, 1], d2[:, 1])
