@@ -7,10 +7,15 @@ kernel is the online-softmax (flash) formulation instead — the KV cache is
 streamed tile-by-tile through VMEM while a running (max, sum, acc) state stays
 resident, so nothing of size S ever leaves the chip.
 
-Layout: queries are folded to [B*Hq, T, hd] and the grid walks
-(head, q_tile, kv_tile) with the kv sweep innermost ("arbitrary" — it carries
-the accumulator). GQA is handled in the k/v index map: query head h reads
-cache head h // group, so no materialized repeat_kv.
+Layout: queries are folded to [B*Hkv, T*group, hd] — one program per KV
+head, with that head's `group` query heads interleaved t-major into the row
+axis (row = t*group + g) — and the grid walks (kv_head, q_tile, kv_tile)
+with the kv sweep innermost ("arbitrary" — it carries the accumulator). One
+kv sweep serves the WHOLE query group: folding per *query* head instead
+(the naive layout) re-DMAs every KV tile `group` times, which at decode
+makes cache traffic group x larger than the cache (GQA group is 4 on the
+llama 3 models; at 8 Ki context that redundancy costs more than the weight
+stream). No materialized repeat_kv either way.
 
 Causality follows gqa_attention's fixed-size-cache masking (ops/layers.py):
 query t sees cache slots s <= pos_base + t, which also masks the unwritten
@@ -41,7 +46,7 @@ from dllama_tpu.ops.pallas.tiling import pick_tile as _pick_tile
 _NEG_INF = -1e30  # large-finite: keeps fully-masked tiles NaN-free
 
 
-def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref, *, scale, tq, ts, hq):
+def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref, *, scale, tq, ts, hkv, group):
     iq = pl.program_id(1)
     ks = pl.program_id(2)
 
@@ -51,12 +56,13 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref, *, sca
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # query-row absolute positions (query row r is token pos[b] + iq*tq + r,
-    # b = this head's batch row; padded tail rows are discarded by the
+    # query-row absolute positions: row r holds (t, g) = divmod(iq*tq + r,
+    # group) interleaved t-major, so its token offset is (iq*tq + r) // group
+    # (b = this program's batch row; padded tail rows are discarded by the
     # wrapper) — computed OUTSIDE the pl.when (program_id can't lower inside
     # its branch in interpret mode)
-    pos_b = pos_ref[pl.program_id(0) // hq]
-    qpos_max = pos_b + iq * tq + tq - 1
+    pos_b = pos_ref[pl.program_id(0) // hkv]
+    qpos_max = pos_b + (iq * tq + tq - 1) // group
 
     # kv tiles fully past the last visible position are dead (their DMA was
     # elided by the clamped index map too): skip their compute
@@ -69,7 +75,8 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref, *, sca
         s = s * scale  # [tq, ts]
 
         # causal mask against absolute cache positions
-        qpos = pos_b + iq * tq + jax.lax.broadcasted_iota(jnp.int32, (tq, ts), 0)
+        row = jax.lax.broadcasted_iota(jnp.int32, (tq, ts), 0)
+        qpos = pos_b + (iq * tq + row) // group
         span = ks * ts + jax.lax.broadcasted_iota(jnp.int32, (tq, ts), 1)
         mask = span <= qpos
         s = jnp.where(mask, s, _NEG_INF)
@@ -90,21 +97,23 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref, *, sca
         out_ref[:] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("group", "hq", "interpret"))
-def _flash_folded(q, k, v, pos, *, group: int, hq: int, interpret: bool):
-    """q[BHq, Tp, hd] x cache[BHkv, S, hd] -> [BHq, Tp, hd] f32.
-    pos: i32[B] per-row base positions (replicated for the scalar case)."""
-    bhq, tp, hd = q.shape
+@functools.partial(jax.jit, static_argnames=("group", "hkv", "interpret"))
+def _flash_folded(q, k, v, pos, *, group: int, hkv: int, interpret: bool):
+    """q[BHkv, Tp*group, hd] x cache[BHkv, S, hd] -> [BHkv, Tp*group, hd] f32.
+    Query rows are t-major interleaved over the GQA group (row = t*group + g)
+    so one kv sweep serves the whole group. pos: i32[B] per-row base
+    positions (replicated for the scalar case)."""
+    bhkv, rows, hd = q.shape
     s = k.shape[1]
-    tq = _pick_tile(tp, (128, 64, 32, 16, 8))
+    tq = _pick_tile(rows, (128, 64, 32, 16, 8))
     ts = _pick_tile(s, (512, 256, 128, 64))
-    grid = (bhq, tp // tq, s // ts)
+    grid = (bhkv, rows // tq, s // ts)
 
     def kv_index(h, i, ks, pos):
         # clamp dead kv tiles to the last LIVE tile: the repeated block index
         # makes Pallas skip the DMA, and the kernel skips their compute
-        last_live = (pos[h // hq] + i * tq + tq - 1) // ts
-        return (h // group, jnp.minimum(ks, last_live), 0)
+        last_live = (pos[h // hkv] + (i * tq + tq - 1) // group) // ts
+        return (h, jnp.minimum(ks, last_live), 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,  # pos: i32[B]
@@ -122,17 +131,18 @@ def _flash_folded(q, k, v, pos, *, group: int, hq: int, interpret: bool):
         ],
     )
     return pl.pallas_call(
-        functools.partial(_kernel, scale=1.0 / math.sqrt(hd), tq=tq, ts=ts, hq=hq),
+        functools.partial(_kernel, scale=1.0 / math.sqrt(hd), tq=tq, ts=ts,
+                          hkv=hkv, group=group),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((bhq, tp, hd), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((bhkv, rows, hd), jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
-            flops=4 * bhq * tp * s * hd,
-            bytes_accessed=(bhq * tp * hd * 2) * q.dtype.itemsize
-            + 2 * (bhq // group) * s * hd * k.dtype.itemsize,
-            transcendentals=bhq * tp * s,
+            flops=4 * bhkv * rows * s * hd,
+            bytes_accessed=(bhkv * rows * hd * 2) * q.dtype.itemsize
+            + 2 * bhkv * s * hd * k.dtype.itemsize,
+            transcendentals=bhkv * rows * s,
         ),
         interpret=interpret,
     )(pos, q, k, v)
@@ -150,8 +160,15 @@ def flash_gqa_attention(
     b, t, hq, hd = q.shape
     hkv, s = k_cache.shape[1], k_cache.shape[2]
     group = hq // hkv
-    qf = q.transpose(0, 2, 1, 3).reshape(b * hq, t, hd)
-    pad = (-t) % 8
+    # fold the GQA group into the row axis, t-major: q head h = kv*group + g
+    # lands at row t*group + g of kv head kv (see module docstring)
+    qf = (
+        q.reshape(b, t, hkv, group, hd)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b * hkv, t * group, hd)
+    )
+    rows = t * group
+    pad = (-rows) % 8
     if pad:
         qf = jnp.pad(qf, ((0, 0), (0, pad), (0, 0)))
     pos = jnp.broadcast_to(jnp.atleast_1d(jnp.asarray(pos_base, jnp.int32)), (b,))
@@ -161,12 +178,17 @@ def flash_gqa_attention(
         v_cache.reshape(b * hkv, s, hd),
         pos,
         group=group,
-        hq=hq,
+        hkv=hkv,
         interpret=interpret,
     )
     if pad:
-        out = out[:, :t]
-    return out.reshape(b, hq, t, hd).transpose(0, 2, 1, 3).astype(q.dtype)
+        out = out[:, :rows]
+    return (
+        out.reshape(b, hkv, t, group, hd)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b, t, hq, hd)
+        .astype(q.dtype)
+    )
 
 
 def supported(q_shape: tuple[int, ...], cache_seq_len: int) -> bool:
