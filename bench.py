@@ -27,6 +27,7 @@ Env knobs:
                        through the XLA dequant-dot GEMM (prefill tier A/B;
                        unset = always fused kernels)
   BENCH_UNROLL         lax.scan unroll over layers: int, or 'full' (default 1)
+  BENCH_FUSE           '1': fused wqkv/w13 launches (unsharded engines)
   BENCH_BUDGET_S       total wall-clock budget for the parent (default 840 —
                        fits under the driver's `timeout 900 python bench.py`)
   BENCH_FORCE_CPU      '1': skip the TPU entirely (CI smoke)
@@ -194,6 +195,7 @@ def bench_engine(cfg, params, n_decode, unroll, prompt_len=512, kernels=None,
     eng = InferenceEngine(cfg, params, cache_dtype=jnp.bfloat16,
                           max_prefill_chunk=512, layer_unroll=unroll,
                           attn_impl=attn_impl,
+                          fuse_weights=os.environ.get("BENCH_FUSE") == "1",
                           kernels=kernels or os.environ.get("BENCH_KERNELS", "auto"))
     prompt_len = min(prompt_len, cfg.seq_len // 2)
     prompt = (np.arange(1, prompt_len + 1, dtype=np.int32)[None]) % cfg.vocab_size

@@ -78,9 +78,21 @@ class InferenceEngine:
         kernels: str = "auto",  # 'auto' | 'pallas' | 'xla' matmul backend
         moe_impl: str = "auto",  # 'auto' | 'dispatch' | 'dense' (ops.layers.moe_ffn)
         pp_micro: int = 1,  # GPipe microbatches on pp meshes (batch % pp_micro == 0)
+        fuse_weights: bool = False,  # wqkv/w13 fused launches (unsharded only;
+        # concatenates copies on device — caller keeps the originals alive)
     ):
         self.cfg = cfg
         self.params = params
+        if fuse_weights:
+            if shardings is not None:
+                raise ValueError("fuse_weights requires an unsharded engine "
+                                 "(tp shards q and kv blocks at different granularity)")
+            from dllama_tpu.models.llama import fuse_layer_weights
+
+            # session fingerprint must hash the CALLER's layout — a session
+            # saved unfused must resume on a fused engine and vice versa
+            self._params_digest()
+            self.params = dict(params, layers=fuse_layer_weights(params["layers"]))
         self.batch = batch
         self.seq_len = min(max_seq_len or cfg.seq_len, cfg.seq_len)
         self.max_prefill_chunk = max_prefill_chunk

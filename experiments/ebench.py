@@ -34,26 +34,27 @@ else:
 params = random_params_fast(cfg, seed=0, dtype=jnp.bfloat16)
 print(f"params ready ({time.time()-t0:.0f}s)", flush=True)
 
-# (label, unroll, attn_impl, style)
+# (label, unroll, attn_impl, style, fuse)
 COMBOS = [
-    ("base u1 flash bd", 1, "auto", "auto"),
-    ("u4", 4, "auto", "auto"),
-    ("ufull", True, "auto", "auto"),
-    ("jnp-attn", 1, "jnp", "auto"),
-    ("maskdot", 1, "auto", "maskdot"),
-    ("deq-decode", 1, "auto", "deq"),
+    ("base u1 flash bd", 1, "auto", "auto", False),
+    ("fused-qkv-w13", 1, "auto", "auto", True),
+    ("u4", 4, "auto", "auto", False),
+    ("ufull", True, "auto", "auto", False),
+    ("jnp-attn", 1, "jnp", "auto", False),
+    ("maskdot", 1, "auto", "maskdot", False),
+    ("deq-decode", 1, "auto", "deq", False),
 ]
 
 PROMPT_LEN = min(512, cfg.seq_len // 2)
 prompt = (np.arange(1, PROMPT_LEN + 1, dtype=np.int32)[None]) % cfg.vocab_size
 first = np.array([[1]], np.int32)
 
-for label, unroll, attn, style in COMBOS:
+for label, unroll, attn, style, fuse in COMBOS:
     qmod.STYLE = style
     try:
         eng = InferenceEngine(cfg, params, cache_dtype=jnp.bfloat16,
                               max_prefill_chunk=512, layer_unroll=unroll,
-                              attn_impl=attn)
+                              attn_impl=attn, fuse_weights=fuse)
         tc = time.perf_counter()
         eng.prefill(prompt)
         eng.decode_greedy_n(first, N_DECODE)

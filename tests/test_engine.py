@@ -190,3 +190,57 @@ def test_session_fingerprint_rejects_different_weights(tmp_path):
     e3 = make_engine(seed=0)
     e3.load_session(path)  # same weights: accepted
     assert e3.pos == e1.pos
+
+
+def test_fused_weights_match_unfused():
+    """fuse_weights=True (wqkv/w13 single launches) must reproduce the
+    unfused engine's logits and greedy continuation exactly."""
+    import numpy as np
+
+    from dllama_tpu.models.llama import random_params
+
+    cfg = LlamaConfig(dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                      vocab_size=96, seq_len=64)
+    params = random_params(cfg, seed=3, dtype=jnp.float32, quantize=True)
+    prompt = np.array([[1, 2, 3, 4, 5]], np.int32)
+    outs = {}
+    for fused in (False, True):
+        eng = InferenceEngine(cfg, params, cache_dtype=jnp.float32,
+                              fuse_weights=fused)
+        logits = eng.prefill(prompt)
+        toks = eng.decode_greedy_n(np.asarray(jnp.argmax(logits, -1), np.int32), 8)
+        outs[fused] = (np.asarray(logits), [int(t) for t in toks[:, 0]])
+    np.testing.assert_allclose(outs[False][0], outs[True][0], atol=1e-5, rtol=1e-5)
+    assert outs[False][1] == outs[True][1]
+
+
+def test_fused_weights_rejects_sharded():
+    from dllama_tpu.parallel.mesh import MeshConfig, make_mesh
+    from dllama_tpu.parallel.sharding import LlamaShardings
+    from dllama_tpu.models.llama import random_params
+
+    cfg = LlamaConfig(dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                      vocab_size=96, seq_len=64)
+    params = random_params(cfg, seed=3, dtype=jnp.float32, quantize=True)
+    sh = LlamaShardings(make_mesh(MeshConfig(tp=2)), cfg)
+    with pytest.raises(ValueError, match="unsharded"):
+        InferenceEngine(cfg, params, shardings=sh, fuse_weights=True)
+
+
+def test_session_portable_across_fuse_weights(tmp_path):
+    """A session saved by an unfused engine must resume on a fused one: the
+    weight fingerprint hashes the caller's layout, not the fused copies."""
+    import numpy as np
+
+    from dllama_tpu.models.llama import random_params
+
+    cfg = LlamaConfig(dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                      vocab_size=96, seq_len=64)
+    params = random_params(cfg, seed=3, dtype=jnp.float32, quantize=True)
+    e1 = InferenceEngine(cfg, params, cache_dtype=jnp.float32)
+    e1.prefill(np.array([[1, 2, 3]], np.int32))
+    path = str(tmp_path / "s.npz")
+    e1.save_session(path)
+    e2 = InferenceEngine(cfg, params, cache_dtype=jnp.float32, fuse_weights=True)
+    e2.load_session(path)  # must not raise
+    assert e2.pos == e1.pos
