@@ -248,6 +248,7 @@ def bench_batched(cfg, params, slots, n_decode=64, kernels=None):
 
     eng = BatchEngine(cfg, params, n_slots=slots, cache_dtype=jnp.bfloat16,
                       max_prefill_chunk=64,
+                      fuse_weights=os.environ.get("BENCH_FUSE") == "1",
                       kernels=kernels or os.environ.get("BENCH_KERNELS", "auto"))
     rng = np.random.default_rng(0)
     t0 = time.perf_counter()

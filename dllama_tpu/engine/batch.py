@@ -50,11 +50,20 @@ class BatchEngine:
         sync: str = "bf16",  # 'bf16' | 'q80' quantized tp exchange (as InferenceEngine)
         kernels: str = "auto",  # 'auto' | 'pallas' | 'xla' matmul backend
         moe_impl: str = "auto",  # 'auto' | 'dispatch' | 'dense' (ops.layers.moe_ffn)
+        fuse_weights: bool = False,  # wqkv/w13 fused launches (unsharded only,
+        # same contract as InferenceEngine)
     ):
         from dllama_tpu.ops.layers import build_rope_cache
 
         self.cfg = cfg
         self.params = params
+        if fuse_weights:
+            if shardings is not None:
+                raise ValueError("fuse_weights requires an unsharded engine "
+                                 "(tp shards q and kv blocks at different granularity)")
+            from dllama_tpu.models.llama import fuse_layer_weights
+
+            self.params = dict(params, layers=fuse_layer_weights(params["layers"]))
         self.n_slots = n_slots
         self.seq_len = min(max_seq_len or cfg.seq_len, cfg.seq_len)
         self.max_prefill_chunk = max_prefill_chunk

@@ -167,7 +167,7 @@ def _deq_call(layer, x, packed, scales, *, interpret: bool = False):
     """x[m, k] @ dequant(packed[layer], scales[layer]) -> f32[m, n]."""
     m, k = x.shape
     n = packed.shape[-1]
-    tm = _pick_tile(m, (256, 128, 64, 32, 16, 8))
+    tm = _pick_tile(m, (512, 256, 128, 64, 32, 16, 8))
     tn = _pick_tile(n, (512, 256, 128))
     tk = _pick_tile(k, (512, 256, 128, 64, 32))
     grid = (m // tm, n // tn, k // tk)

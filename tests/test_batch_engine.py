@@ -204,3 +204,15 @@ def test_slot_prefill_matches_masked_full_width():
     d1 = be_slot.decode(4)
     d2 = be_full.decode(4)
     np.testing.assert_array_equal(d1[:, 1], d2[:, 1])
+
+
+def test_batch_engine_fused_weights_parity():
+    """BatchEngine(fuse_weights=True) must match unfused decode exactly."""
+    outs = {}
+    for fused in (False, True):
+        be = BatchEngine(CFG, PARAMS, n_slots=2, seed=7, cache_dtype=jnp.float32,
+                         fuse_weights=fused)
+        first = be.add(0, [3, 4, 5], temperature=0.0, seed=1)
+        toks = be.decode(6)
+        outs[fused] = (first, [int(t) for t in toks[:, 0]])
+    assert outs[False] == outs[True]
