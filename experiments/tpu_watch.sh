@@ -8,8 +8,11 @@ cd "$(dirname "$0")/.."
 mkdir -p experiments/logs
 W=experiments/logs/watch.log
 i=0
-while [ "$i" -lt 120 ]; do
+while [ "$i" -lt 400 ]; do
   i=$((i + 1))
+  # probe timeout must cover a live-but-slow tunnel's backend init (~120 s
+  # measured); the short sleep keeps the window-catch latency low — a probe
+  # against a down tunnel just hangs until its timeout anyway.
   if timeout 240 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
       >>"$W" 2>&1; then
     echo "TUNNEL UP probe=$i $(date -u +%H:%M:%S)" >>"$W"
@@ -18,6 +21,6 @@ while [ "$i" -lt 120 ]; do
     exit 0
   fi
   echo "probe $i down $(date -u +%H:%M:%S)" >>"$W"
-  sleep 200
+  sleep 60
 done
 echo "GAVE UP after $i probes" >>"$W"
