@@ -55,6 +55,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slots", type=int, default=0,
                    help="serve mode: continuous-batching slots (0 = single-request + prefix cache)")
     p.add_argument("--kernels", choices=["auto", "pallas", "xla"], default="auto")
+    p.add_argument("--fuse-weights", action="store_true",
+                   help="fused wqkv/w13 kernel launches (single-device engines; "
+                        "ignored on a mesh)")
     p.add_argument("--moe", choices=["auto", "dispatch", "dense"], default="auto",
                    help="MoE compute: capacity-bucketed dispatch (O(k) FLOPs, rare "
                         "capacity drops) or exact dense all-experts")
@@ -95,6 +98,7 @@ def _load(args):
         sync=args.sync,
         kernels=args.kernels,
         moe_impl=args.moe,
+        fuse_weights=args.fuse_weights,
     )
 
 

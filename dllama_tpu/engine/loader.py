@@ -63,6 +63,7 @@ def load_model(
     moe_impl: str = "auto",
     pp_micro: int = 1,  # GPipe microbatches (library callers with batch > 1;
     # the CLI always drives batch=1, so it exposes no flag for this)
+    fuse_weights: bool = False,  # wqkv/w13 fused launches (unsharded engines)
 ) -> LoadedModel:
     cfg, header_size = read_header(model_path, max_seq_len)
     log.info("model: %s", cfg.describe())
@@ -90,5 +91,6 @@ def load_model(
         kernels=kernels,
         moe_impl=moe_impl,
         pp_micro=pp_micro,
+        fuse_weights=fuse_weights and shardings is None,
     )
     return LoadedModel(cfg, engine, tokenizer, shardings, sync=sync)
