@@ -99,5 +99,34 @@ except Exception as e:
     failures.append("engine")
     print(f"FAIL engine (compile/run): {str(e)[:400]}", flush=True)
 
+# fused wqkv/w13 launches: greedy continuation must match the unfused engine
+try:
+    eng_f = InferenceEngine(cfg, params, cache_dtype=jnp.bfloat16, kernels="pallas",
+                            fuse_weights=True)
+    eng_f.prefill(prompt)
+    fused_toks = [int(t) for t in eng_f.decode_greedy_n(np.array([[1]]), 8)[:, 0]]
+    if fused_toks == outs["pallas"]:
+        print(f"PASS fused-weights parity ({time.time() - t_start:.0f}s)", flush=True)
+    else:
+        failures.append("fused")
+        print(f"FAIL fused-weights parity: {fused_toks} != {outs['pallas']}", flush=True)
+except Exception as e:
+    failures.append("fused")
+    print(f"FAIL fused engine (compile/run): {str(e)[:400]}", flush=True)
+
+# continuous-batching tier: slot-sliced admission + fused multi-slot decode
+try:
+    from dllama_tpu.engine.batch import BatchEngine
+
+    be = BatchEngine(cfg, params, n_slots=4, cache_dtype=jnp.bfloat16, kernels="pallas")
+    for s_ in range(3):
+        be.add(s_, [1 + s_, 2, 3, 4], temperature=0.0, seed=s_)
+    toks = be.decode(4)
+    print(f"PASS batch engine 3/4 slots decode {toks.shape} ({time.time() - t_start:.0f}s)",
+          flush=True)
+except Exception as e:
+    failures.append("batch")
+    print(f"FAIL batch engine (compile/run): {str(e)[:400]}", flush=True)
+
 print("TOTAL", "FAIL " + ",".join(failures) if failures else "ALL PASS", flush=True)
 sys.exit(1 if failures else 0)
