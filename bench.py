@@ -359,11 +359,12 @@ def worker():
 
         _qmod.STYLE = q40_style
 
+    from dllama_tpu.ops import matmul as _mmod
+
     xla_prefill_m = os.environ.get("BENCH_XLA_PREFILL_M")
     if xla_prefill_m:
-        from dllama_tpu.ops import matmul as _mmod
-
         _mmod.XLA_PREFILL_MIN_M = int(xla_prefill_m)
+    prefill_tuned = False
 
     dev = jax.devices()[0]
     results = {}
@@ -428,6 +429,31 @@ def worker():
                 results[name] = {"error": repr(e)[:200]}
             finally:
                 _qm.STYLE = q40_style
+        # prefill-route self-tune (runs once, on the first preset that
+        # succeeded on a Pallas rung): re-measure with large-m matmuls routed
+        # through the XLA dequant-dot GEMM. If that beats the fused prefill
+        # by >20%, keep the routing for the remaining (bigger) presets. The
+        # driver's bench runs with default env, so the worker must learn this
+        # itself rather than rely on BENCH_XLA_PREFILL_M.
+        if (xla_prefill_m is None and not prefill_tuned
+                and name in results and "prefill_tok_s" in results[name]
+                and "kernels=auto" in results[name].get("path", "")
+                and time.monotonic() < deadline - 240):
+            prefill_tuned = True
+            try:
+                _mmod.XLA_PREFILL_MIN_M = 64
+                r2 = bench_engine(cfg, params, min(n_decode, 32), unroll,
+                                  prompt_len=PROMPT_LENS.get(name, 512))
+                r2["path"] = "style=auto kernels=auto xla_prefill_m=64"
+                results[name + "_xla_prefill"] = r2
+                if r2["prefill_tok_s"] > 1.2 * results[name]["prefill_tok_s"]:
+                    results["prefill_route"] = "xla (kept: fused deq slower)"
+                else:
+                    _mmod.XLA_PREFILL_MIN_M = None
+                    results["prefill_route"] = "fused deq"
+            except Exception as e:
+                _mmod.XLA_PREFILL_MIN_M = None
+                results[name + "_xla_prefill"] = {"error": repr(e)[:200]}
         # batched sweep while the north-star config's params are live; skip
         # slots we no longer have budget for
         if name == sweep_on:
