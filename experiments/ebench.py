@@ -38,6 +38,7 @@ print(f"params ready ({time.time()-t0:.0f}s)", flush=True)
 COMBOS = [
     ("base u1 flash bd", 1, "auto", "auto", False),
     ("fused-qkv-w13", 1, "auto", "auto", True),
+    ("fused+u4", 4, "auto", "auto", True),
     ("u4", 4, "auto", "auto", False),
     ("ufull", True, "auto", "auto", False),
     ("jnp-attn", 1, "jnp", "auto", False),
