@@ -139,6 +139,11 @@ class BatchEngine:
         engine admits a prompt at 1/32 the FLOPs of the masked full-width
         step — the other slots' caches are untouched by construction, not by
         masking. `slot` and `pos` are traced scalars (no per-slot recompiles).
+
+        The reference has no analog: its server prefills one request at a
+        time on the whole machine (dllama-api.cpp:380-431, single-request
+        blocking per SURVEY.md §7.4.6); this keeps admission O(prompt) while
+        the other slots' decode state waits untouched.
         """
         sub = KVCache(
             jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1),

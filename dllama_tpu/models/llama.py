@@ -140,7 +140,11 @@ def fuse_layer_weights(layers: dict) -> dict:
 
     The attention and gate/up matmuls share their input activation; fusing
     them turns 5 kernel launches per layer into 2 (decode at 1B runs ~113
-    Pallas calls per token — launch count is real money at 1 ms/token).
+    Pallas calls per token — launch count is real money at 1 ms/token). The
+    reference issues q/k/v and w1/w3 as separate MATMUL ops in its segment
+    graph (llm.cpp:198-312, 314-385) because each op is a unit of its
+    executor's thread-pool scheduling; here the unit is a kernel launch, so
+    concatenation is the analogous batching lever.
     QTensor concat is exact: packed nibbles and f16 scales both carry the
     output dim last. Unsharded engines only — under tp the q and kv blocks
     shard at different granularity, so fused weights would mis-slice.
