@@ -112,6 +112,20 @@ def cmd_info(args) -> int:
         for _, shape, ft in tensor_plan(cfg)
     )
     print(f"header: {header_size} B, weights: {total / 1e9:.2f} GB on disk")
+    # what would actually run here: resolved matmul backend / attention impl
+    # (the reference prints its CPU features at startup, nn-cpu-ops.cpp:
+    # 1276-1294 — this is the TPU-side equivalent)
+    try:
+        from dllama_tpu.engine.kernel_select import resolve_kernels
+
+        sel = resolve_kernels(cfg, cfg.seq_len, 1, args.kernels)
+        attn = "flash" if sel.attn_fn is not None else "jnp"
+        import jax
+
+        print(f"this host: {len(jax.devices())}x {jax.devices()[0].platform}; "
+              f"kernels={sel.backend} attention={attn}")
+    except Exception as e:  # info must never fail on backend trouble
+        print(f"this host: backend unavailable ({e!r})"[:120])
     return 0
 
 
