@@ -244,3 +244,44 @@ def test_session_portable_across_fuse_weights(tmp_path):
     e2 = InferenceEngine(cfg, params, cache_dtype=jnp.float32, fuse_weights=True)
     e2.load_session(path)  # must not raise
     assert e2.pos == e1.pos
+
+
+def test_nucleus_wider_than_candidates_falls_back_to_full_vocab():
+    """When the top-K candidate set covers < topp of the mass (nucleus wider
+    than K), sampling must fall back to untruncated temperature sampling —
+    not silently behave as top-k=K."""
+    import numpy as np
+
+    from dllama_tpu.engine import sampling
+
+    v = 64
+    flat = jnp.zeros((1, v), jnp.float32)  # uniform: top-4 holds 1/16 of mass
+    old = sampling.NUCLEUS_K
+    sampling.NUCLEUS_K = 4
+    try:
+        toks = [
+            int(sampling.sample_logits(flat, jax.random.PRNGKey(s), 1.0, 0.9)[0])
+            for s in range(64)
+        ]
+    finally:
+        sampling.NUCLEUS_K = old
+    # uniform sampling over 64 tokens: hitting only 4 specific ids 64 times
+    # has probability (1/16)^64 — any spread beyond 4 ids proves the fallback
+    assert len(set(toks)) > 4
+
+
+def test_nucleus_within_candidates_truncates():
+    """Peaked logits with small topp must stay inside the tiny nucleus even
+    when the candidate set is clamped."""
+    import numpy as np
+
+    from dllama_tpu.engine import sampling
+
+    logits = np.full((1, 64), -10.0, np.float32)
+    logits[0, 7] = 10.0
+    logits[0, 9] = 9.0
+    toks = {
+        int(sampling.sample_logits(jnp.asarray(logits), jax.random.PRNGKey(s), 1.0, 0.5)[0])
+        for s in range(32)
+    }
+    assert toks <= {7}  # topp=0.5 keeps only the crossing token
