@@ -216,3 +216,24 @@ def test_batch_engine_fused_weights_parity():
         toks = be.decode(6)
         outs[fused] = (first, [int(t) for t in toks[:, 0]])
     assert outs[False] == outs[True]
+
+
+def test_slot_prefill_start_pos_matches_full_width():
+    """Prefix-cache admissions (start_pos > 0) must agree across the
+    slot-sliced and masked full-width prefill paths (same cache, same first
+    token): this is the path the scheduler's NaiveCache reuse drives."""
+    be_slot = BatchEngine(CFG, PARAMS, n_slots=2, seed=9, cache_dtype=jnp.float32)
+    be_full = BatchEngine(CFG, PARAMS, n_slots=2, seed=9, cache_dtype=jnp.float32)
+    be_full._use_slot_prefill = False
+
+    turn1 = [3, 4, 5, 6]
+    for be in (be_slot, be_full):
+        be.add(0, turn1, temperature=0.0, seed=2)
+        be.release(0, keep_rows=len(turn1))  # keep KV rows (prefix cache)
+    delta = [7, 8]
+    t1 = be_slot.add(0, delta, temperature=0.0, seed=3, start_pos=len(turn1))
+    t2 = be_full.add(0, delta, temperature=0.0, seed=3, start_pos=len(turn1))
+    assert t1 == t2
+    np.testing.assert_allclose(
+        np.asarray(be_slot.cache.k, np.float32),
+        np.asarray(be_full.cache.k, np.float32), atol=1e-5, rtol=1e-5)
