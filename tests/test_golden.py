@@ -130,3 +130,20 @@ def test_golden_model_temp0_continuation():
     tok = int(np.argmax(np.asarray(logits)[0]))
     ids = [tok] + [int(t) for t in eng.decode_greedy_n(np.array([[tok]]), 15)[:, 0]]
     assert ids == GOLDEN_CONTINUATION
+
+
+def test_golden_model_fused_weights_continuation():
+    """The fused wqkv/w13 engine must reproduce the same pinned continuation
+    from the committed .m — fusion composes with the file-load path exactly."""
+    import jax.numpy as jnp
+
+    from dllama_tpu.engine.engine import InferenceEngine
+    from dllama_tpu.models import formats
+
+    cfg, hs = formats.read_header(FIXTURE_M)
+    params = formats.load_params(FIXTURE_M, cfg, hs)
+    eng = InferenceEngine(cfg, params, cache_dtype=jnp.float32, fuse_weights=True)
+    logits = eng.prefill(np.asarray([GOLDEN_PROMPT], np.int32))
+    tok = int(np.argmax(np.asarray(logits)[0]))
+    ids = [tok] + [int(t) for t in eng.decode_greedy_n(np.array([[tok]]), 15)[:, 0]]
+    assert ids == GOLDEN_CONTINUATION
