@@ -1,0 +1,52 @@
+"""CPU smoke of the TPU-window scripts (VERDICT r3 next-round #2).
+
+The watcher (experiments/tpu_watch.sh) fires experiments/tpu_session.sh
+unattended on the first live tunnel window; a trivial crash in any stage
+would burn scarce TPU time. These tests execute each stage's ACTUAL main
+path end-to-end on CPU — tiny shapes, interpret-mode Pallas — so an import
+error, bad flag, or shape typo is caught in CI, never in a window. The
+numbers produced here are meaningless; only completion + parity markers are
+asserted. (Reference analog: the window scripts are this repo's equivalent
+of the reference's dllama-run measurement drivers, dllama.cpp:54-104.)
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(argv, extra_env=None, timeout=900):
+    env = dict(os.environ)
+    # repo-only PYTHONPATH skips the axon sitecustomize (which would serialize
+    # behind a tunnel probe); CPU platform so no test touches the real chip
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # scripts run single-device, like the window
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable] + argv,
+        cwd=REPO, capture_output=True, text=True, timeout=timeout, env=env,
+    )
+
+
+def test_tpu_validate_smoke():
+    p = _run(["experiments/tpu_validate.py"])
+    assert p.returncode == 0, f"stdout:\n{p.stdout}\nstderr:\n{p.stderr[-2000:]}"
+    assert "TOTAL ALL PASS" in p.stdout
+
+
+def test_kbench_suite_smoke():
+    p = _run(["experiments/kbench.py", "suite", "--smoke"])
+    assert p.returncode == 0, f"stdout:\n{p.stdout}\nstderr:\n{p.stderr[-2000:]}"
+    assert "KBENCH DONE" in p.stdout
+    assert "FAILED" not in p.stdout, p.stdout
+    # the tile sweep measured at least one (tk, tn) combo
+    assert "tile tk=" in p.stdout, p.stdout
+
+
+def test_ebench_smoke():
+    p = _run(["experiments/ebench.py", "4"], {"EBENCH_TINY": "1"})
+    assert p.returncode == 0, f"stdout:\n{p.stdout}\nstderr:\n{p.stderr[-2000:]}"
+    assert "EBENCH DONE fails=0" in p.stdout, p.stdout
