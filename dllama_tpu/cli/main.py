@@ -37,6 +37,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--steps", type=int, default=64, help="max tokens to generate")
     p.add_argument("--temperature", type=float, default=0.8)
     p.add_argument("--topp", type=float, default=0.9)
+    p.add_argument("--exact-topp", action="store_true",
+                   help="reference-exact nucleus: full-vocab sort per step instead "
+                        "of the approx-top-256 candidate set (slower on big vocabs)")
     p.add_argument("--seed", type=int, default=None, help="sampler seed (default: time)")
     p.add_argument("--max-seq-len", type=int, default=None, help="clamp context length (RAM cap)")
     p.add_argument(
@@ -87,6 +90,12 @@ def _load(args):
 
         initialize(args.coordinator, args.num_processes, args.process_id)
     matmul.BACKEND = args.kernels
+    if args.exact_topp:
+        # must land before the first sampler trace — NUCLEUS_K is a
+        # trace-time constant of the fused decode step
+        from dllama_tpu.engine import sampling
+
+        sampling.NUCLEUS_K = None
     return load_model(
         args.model,
         args.tokenizer,

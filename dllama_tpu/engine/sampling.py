@@ -16,7 +16,10 @@ import jax.numpy as jnp
 # top-p candidate-set width: nucleus sampling restricts to the approx-top-K
 # logits instead of full-vocab sort (see sample_logits). At real-vocab sizes
 # and topp <= 0.99 the nucleus essentially never exceeds a few dozen tokens.
-NUCLEUS_K = 256
+# None = exact mode (ADVICE r3): full-vocab sort like the reference's nucleus
+# (tokenizer.cpp:389-395) — no approx recall loss, no wide-nucleus fallback,
+# at the cost of a 128k-row sort per decode step. CLI: --exact-topp.
+NUCLEUS_K: int | None = 256
 
 
 def sample_logits(logits: jax.Array, key: jax.Array, temperature, topp) -> jax.Array:
@@ -35,7 +38,9 @@ def sample_logits(logits: jax.Array, key: jax.Array, temperature, topp) -> jax.A
     If the candidates cover less than topp of the full-vocab mass (a nucleus
     wider than K — very high temperature on a large vocab), the row falls back
     to full-vocab temperature sampling rather than silently behaving as
-    top-k=K. Pure temperature sampling (topp <= 0 or >= 1) stays full-vocab
+    top-k=K. Callers that need the reference's exact semantics (no recall
+    loss, no fallback) set ``NUCLEUS_K = None`` for a true full-vocab sort.
+    Pure temperature sampling (topp <= 0 or >= 1) stays full-vocab
     (categorical = gumbel-argmax, no sort)."""
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -49,9 +54,12 @@ def sample_logits(logits: jax.Array, key: jax.Array, temperature, topp) -> jax.A
     key_p, key_t = jax.random.split(key)
 
     # --- top-p among the top-K candidates, full-vocab-normalized
-    k = min(NUCLEUS_K, logits.shape[-1])
-    vals, idx = jax.lax.approx_max_k(scaled, k, recall_target=0.99,
-                                     aggregate_to_topk=True)  # sorted desc
+    if NUCLEUS_K is None:  # exact escape hatch: full-vocab descending sort
+        vals, idx = jax.lax.top_k(scaled, scaled.shape[-1])
+    else:
+        k = min(NUCLEUS_K, logits.shape[-1])
+        vals, idx = jax.lax.approx_max_k(scaled, k, recall_target=0.99,
+                                         aggregate_to_topk=True)  # sorted desc
     lse = jax.scipy.special.logsumexp(scaled, axis=-1, keepdims=True)
     pk = jnp.exp(vals - lse)  # true softmax probs of the candidates
     cum = jnp.cumsum(pk, axis=-1)

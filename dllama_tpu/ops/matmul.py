@@ -84,7 +84,17 @@ def matmul(x: jax.Array, w, layer=None, backend: str | None = None) -> jax.Array
             m = 1
             for d in x.shape[:-1]:
                 m *= d
-            route_xla = XLA_PREFILL_MIN_M is not None and m >= XLA_PREFILL_MIN_M
+            # prefill-shaped only (ADVICE r3): model activations are [b, t, d],
+            # so t > 1 distinguishes prefill from batched decode — a 64-slot
+            # decode step must NOT lose the packed-weights bandwidth win just
+            # because its flattened m crosses the threshold. 2-D calls (no seq
+            # axis) are decode-shaped by construction.
+            prefill_shaped = x.ndim >= 3 and x.shape[-2] > 1
+            route_xla = (
+                XLA_PREFILL_MIN_M is not None
+                and prefill_shaped
+                and m >= XLA_PREFILL_MIN_M
+            )
             if supported(x.shape, w) and not route_xla:
                 return q40_matmul(x, w, layer, interpret=_platform() != "tpu")
         if layer is not None and w.packed.ndim == 3:

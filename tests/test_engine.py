@@ -285,3 +285,31 @@ def test_nucleus_within_candidates_truncates():
         for s in range(32)
     }
     assert toks <= {7}  # topp=0.5 keeps only the crossing token
+
+
+def test_exact_topp_escape_hatch_no_fallback():
+    """NUCLEUS_K=None (--exact-topp, ADVICE r3) sorts the full vocab: a flat
+    distribution that would trip the approx path's wide-nucleus fallback must
+    instead be truncated to exactly the topp mass, reference-style."""
+    import numpy as np
+
+    from dllama_tpu.engine import sampling
+
+    v = 64
+    # strictly decreasing (no sort-tie ambiguity), near-flat: the topp=0.5
+    # nucleus spans ~27 tokens — far wider than the approx path's K=4 clamp
+    logits = jnp.asarray(-0.01 * np.arange(v, dtype=np.float32))[None]
+    old = sampling.NUCLEUS_K
+    sampling.NUCLEUS_K = None
+    try:
+        toks = {
+            int(sampling.sample_logits(logits, jax.random.PRNGKey(s), 1.0, 0.5)[0])
+            for s in range(256)
+        }
+    finally:
+        sampling.NUCLEUS_K = old
+    # wider than any small-K clamp, but never past the exact nucleus boundary
+    assert len(toks) > 4
+    assert max(toks) <= 33
+
+

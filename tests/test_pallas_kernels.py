@@ -167,25 +167,32 @@ class TestDispatchKnobs:
     def test_xla_prefill_routing_threshold(self, monkeypatch):
         """Pins that the threshold actually ROUTES (not merely that both
         paths agree numerically): the fused kernel is stubbed to raise, so a
-        m>=threshold call must bypass it and a m<threshold call must hit it."""
+        prefill-shaped (t>1) call with m>=threshold must bypass it while
+        decode-shaped calls — t==1 at ANY slot count, and 2-D calls — must
+        hit it (ADVICE r3: flattened-m routing would starve batched decode)."""
         from dllama_tpu.ops import matmul as mm
         from dllama_tpu.ops.pallas import q40_matmul as qm
 
         w = QTensor.quantize((np.random.default_rng(0).standard_normal((256, 256)) * 0.05).astype(np.float32))
-        x = jnp.asarray(np.random.default_rng(1).standard_normal((64, 256)), jnp.bfloat16)
+        x = jnp.asarray(np.random.default_rng(1).standard_normal((1, 64, 256)), jnp.bfloat16)
         ref = np.asarray(mm.matmul(x, w, backend="xla"), np.float32)
         monkeypatch.setattr(mm, "XLA_PREFILL_MIN_M", 32)
 
         def boom(*a, **k):
-            raise AssertionError("fused kernel must not run at m >= threshold")
+            raise AssertionError("fused kernel must not run at prefill m >= threshold")
 
         monkeypatch.setattr(qm, "q40_matmul", boom)
         got = np.asarray(mm.matmul(x, w, backend="pallas"), np.float32)  # routed
         np.testing.assert_allclose(got, ref, atol=3e-2, rtol=3e-2)
-        # below the threshold the fused kernel must be invoked
-        x8 = jnp.asarray(np.random.default_rng(2).standard_normal((8, 256)), jnp.bfloat16)
-        with pytest.raises(AssertionError, match="fused kernel"):
-            mm.matmul(x8, w, backend="pallas")
+        # decode-shaped calls must invoke the fused kernel even when the
+        # flattened row count crosses the threshold (64 slots x t=1), and
+        # for plain 2-D calls (no seq axis)
+        for shape in ((64, 1, 256), (8, 256)):
+            xd = jnp.asarray(
+                np.random.default_rng(2).standard_normal(shape), jnp.bfloat16
+            )
+            with pytest.raises(AssertionError, match="fused kernel"):
+                mm.matmul(xd, w, backend="pallas")
 
     def test_blockdot_tile_override_matches_default(self, monkeypatch):
         from dllama_tpu.ops.pallas import q40_matmul as qm

@@ -50,3 +50,16 @@ def test_ebench_smoke():
     p = _run(["experiments/ebench.py", "4"], {"EBENCH_TINY": "1"})
     assert p.returncode == 0, f"stdout:\n{p.stdout}\nstderr:\n{p.stderr[-2000:]}"
     assert "EBENCH DONE fails=0" in p.stdout, p.stdout
+
+
+def test_abench_smoke():
+    p = _run(["experiments/abench.py", "--smoke"])
+    assert p.returncode == 0, f"stdout:\n{p.stdout}\nstderr:\n{p.stderr[-2000:]}"
+    assert "ABENCH DONE fails=0" in p.stdout, p.stdout
+
+
+def test_collectives_table_smoke():
+    p = _run(["experiments/collectives_table.py", "--smoke"])
+    assert p.returncode == 0, f"stdout:\n{p.stdout}\nstderr:\n{p.stderr[-2000:]}"
+    assert "COLLECTIVES DONE" in p.stdout, p.stdout
+    assert "FAILED" not in p.stdout, p.stdout
