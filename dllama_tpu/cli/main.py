@@ -61,9 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fuse-weights", action="store_true",
                    help="fused wqkv/w13 kernel launches (single-device engines; "
                         "ignored on a mesh)")
-    p.add_argument("--moe", choices=["auto", "dispatch", "dense"], default="auto",
+    p.add_argument("--moe", choices=["auto", "dispatch", "sort", "dense"], default="auto",
                    help="MoE compute: capacity-bucketed dispatch (O(k) FLOPs, rare "
-                        "capacity drops) or exact dense all-experts")
+                        "capacity drops), sort (grouped-GEMM ragged segments — "
+                        "O(k) FLOPs AND exact), or exact dense all-experts")
     p.add_argument("--sync", choices=["bf16", "q80"], default="bf16",
                    help="tp activation exchange: native bf16 collectives or the "
                         "reference's Q80 quantized payload (half the ICI bytes)")

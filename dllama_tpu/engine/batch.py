@@ -60,7 +60,7 @@ class BatchEngine:
         attn_impl: str = "auto",  # 'auto' | 'jnp' | 'flash' (same as InferenceEngine)
         sync: str = "bf16",  # 'bf16' | 'q80' quantized tp exchange (as InferenceEngine)
         kernels: str = "auto",  # 'auto' | 'pallas' | 'xla' matmul backend
-        moe_impl: str = "auto",  # 'auto' | 'dispatch' | 'dense' (ops.layers.moe_ffn)
+        moe_impl: str = "auto",  # 'auto' | 'dispatch' | 'sort' | 'dense' (ops.layers.moe_ffn)
         fuse_weights: bool = False,  # wqkv/w13 fused launches (unsharded only,
         # same contract as InferenceEngine)
     ):

@@ -76,7 +76,7 @@ class InferenceEngine:
         layer_unroll: int | bool = 1,  # lax.scan unroll over layers
         sync: str = "bf16",  # 'bf16' (native collectives) | 'q80' (quantized exchange)
         kernels: str = "auto",  # 'auto' | 'pallas' | 'xla' matmul backend
-        moe_impl: str = "auto",  # 'auto' | 'dispatch' | 'dense' (ops.layers.moe_ffn)
+        moe_impl: str = "auto",  # 'auto' | 'dispatch' | 'sort' | 'dense' (ops.layers.moe_ffn)
         pp_micro: int = 1,  # GPipe microbatches on pp meshes (batch % pp_micro == 0)
         fuse_weights: bool = False,  # wqkv/w13 fused launches (unsharded only;
         # concatenates copies on device — caller keeps the originals alive)

@@ -102,7 +102,7 @@ def moe_ffn(
     h: jax.Array,  # [B, T, D] (already rms-normed)
     gate: jax.Array,  # router [D, E] f32
     w1, w2, w3,  # expert stacks: [E, D, F], [E, F, D], [E, D, F] (QTensor or dense)
-    impl: str = "auto",  # 'auto' | 'dispatch' | 'dense'
+    impl: str = "auto",  # 'auto' | 'dispatch' | 'sort' | 'dense'
     capacity_factor: float = 2.0,
 ) -> jax.Array:
     """Mixtral-style sparse MoE FFN: top-k router (softmax over the top-k
@@ -112,12 +112,17 @@ def moe_ffn(
     expert tensors, but the runtime has no MoE graph (SURVEY.md §2.4 — EP row);
     this is the capability it never shipped.
 
-    Two compute schemes:
+    Three compute schemes:
     * ``dispatch`` (default for T*B >= E): GShard-style capacity-bucketed
       dispatch — each expert processes a fixed buffer of C = ~cf*k*N/E token
       rows (static shapes; the TPU way to be sparse), so FLOPs are O(k/E) of
       dense. Tokens over an expert's capacity lose that expert's contribution
       (standard switch-transformer semantics; cf=2 makes drops rare).
+    * ``sort``: MegaBlocks-style grouped GEMM — sort the N*k (token, choice)
+      rows by expert id (argsort + gathers, no scatters) and run ragged
+      segment matmuls (``lax.ragged_dot``). Exact like dense (no capacity
+      drops), O(k/E) FLOPs like dispatch. The fallback if dispatch's
+      ``.at[].add`` scatters serialize on TPU (VERDICT r3 weak #6).
     * ``dense``: every expert runs on every token, combine weights zero the
       unrouted ones. Exact (no capacity drops) and gather-free — the
       correctness reference, and the cheaper choice for tiny batches where
@@ -133,6 +138,27 @@ def moe_ffn(
     )
     topv, topi = jax.lax.top_k(logits, k)
     probs = jax.nn.softmax(topv, axis=-1)  # [B, T, k]
+
+    if impl == "sort":
+        hf = h.reshape(n, d)
+        assign = topi.reshape(-1)  # [N*k] expert ids, token-major
+        order = jnp.argsort(assign)  # stable: segments stay token-ordered
+        inv = jnp.argsort(order)
+        tok = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
+        xs = hf[tok[order]]  # [N*k, D] rows grouped by expert
+        group_sizes = jnp.bincount(assign, length=e).astype(jnp.int32)
+        g = jax.lax.ragged_dot(xs, _dense_w(w1, h.dtype), group_sizes,
+                               preferred_element_type=jnp.float32)
+        up = jax.lax.ragged_dot(xs, _dense_w(w3, h.dtype), group_sizes,
+                                preferred_element_type=jnp.float32)
+        act = activation(g, cfg.hidden_act).astype(h.dtype)
+        y = jax.lax.ragged_dot(act * up.astype(h.dtype), _dense_w(w2, h.dtype),
+                               group_sizes, preferred_element_type=jnp.float32)
+        # un-sort (gather by the inverse permutation — still no scatter),
+        # then the k choices of each token sit contiguous: weighted-sum them
+        y = y[inv].reshape(n, k, d)
+        out = jnp.sum(y * probs.reshape(n, k)[..., None], axis=1)
+        return out.reshape(b, t, d).astype(h.dtype)
 
     if impl == "dispatch":
         import math

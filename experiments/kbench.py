@@ -260,12 +260,76 @@ def sweep_blockdot_tiles(m=8, label="w1"):
     sys.stdout.flush()
 
 
+def bench_flash_decode():
+    """Flash decode-shape A/Bs (VERDICT r3 weak #3/#4):
+
+    1. pad-row cost: t=1 decode at group=4 (4 live rows padded to the tq=8
+       sublane tile) vs group=8 with the SAME hkv (8 live rows, zero pad) —
+       identical KV bytes streamed, identical grid, only live-row count
+       differs. time(group=4) ~= time(group=8) proves the kernel is
+       KV-DMA-bound: pad rows are free, doubling live rows is free, and a
+       fold-2-kv-heads layout rework would buy nothing (it cannot reduce KV
+       bytes). time(group=4) << time(group=8) means rows cost compute and a
+       fold layout halving program count is worth building.
+    2. pruning vs static grid: decode ms at S=8192 for pos 64 -> 7936. Time
+       must scale ~linearly with the LIVE cache (pruned DMA+compute); a flat
+       curve means the ~S/ts no-op grid steps dominate and the grid needs a
+       dynamic bound.
+    """
+    from dllama_tpu.ops.pallas.flash_attention import flash_gqa_attention
+
+    rng = np.random.default_rng(0)
+    hd = 64 if INTERPRET else 128
+    s_ab = 512 if INTERPRET else 1024
+    for hq, hkv, label in ((32, 8, "group=4 (4 live rows, 4 pad)"),
+                           (64, 8, "group=8 (8 live rows, 0 pad)")):
+        q = jnp.asarray(rng.standard_normal((1, 1, hq, hd)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((1, hkv, s_ab, hd)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((1, hkv, s_ab, hd)), jnp.bfloat16)
+        fn = lambda q, k, v: flash_gqa_attention(q, k, v, jnp.int32(s_ab - 2),
+                                                 interpret=INTERPRET)
+        try:
+            t = bench(fn, (q, k, v))
+            kv_bytes = 2 * hkv * s_ab * hd * 2
+            print(f"flash decode {label}: {t*1e6:.0f}us ({kv_bytes/t/1e9:.0f}GB/s cache)")
+        except Exception as e:
+            print(f"flash decode {label}: FAILED {e!r}"[:250])
+        sys.stdout.flush()
+
+    s_long = 1024 if INTERPRET else 8192
+    k = jnp.asarray(rng.standard_normal((1, 8, s_long, hd)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((1, 8, s_long, hd)), jnp.bfloat16)
+    q = jnp.asarray(rng.standard_normal((1, 1, 32, hd)), jnp.bfloat16)
+    fn = lambda q, k, v, p: flash_gqa_attention(q, k, v, p, interpret=INTERPRET)
+    rows = []
+    for frac in (1 / 128, 1 / 8, 1 / 2, 63 / 64):
+        pos = max(1, int(s_long * frac))
+        try:
+            t = bench(fn, (q, k, v, jnp.int32(pos)))
+            rows.append((pos, t))
+            print(f"flash decode S={s_long} pos={pos}: {t*1e6:.0f}us")
+        except Exception as e:
+            print(f"flash decode S={s_long} pos={pos}: FAILED {e!r}"[:250])
+        sys.stdout.flush()
+    if len(rows) >= 2:
+        # live-cache scaling ratio vs grid-overhead floor
+        (p0, t0), (p1, t1) = rows[0], rows[-1]
+        print(f"pruning scaling: pos x{p1/p0:.0f} -> time x{t1/t0:.1f} "
+              f"(~linear = pruning works; ~flat = static-grid overhead dominates)")
+    sys.stdout.flush()
+
+
 def main():
-    # argv: 'suite [--smoke]' | M SHAPE [variant ...] — suite runs the whole
-    # decode + prefill matrix in ONE process (one ~2 min device init, not six)
+    # argv: 'suite [--smoke]' | 'flash [--smoke]' | M SHAPE [variant ...] —
+    # suite runs the whole decode + prefill matrix in ONE process (one ~2 min
+    # device init, not six)
     if "--smoke" in sys.argv:
         sys.argv.remove("--smoke")
         enable_smoke()
+    if sys.argv[1:2] == ["flash"]:
+        bench_flash_decode()
+        print("KBENCH DONE")
+        return
     if sys.argv[1:2] == ["suite"]:
         for m, label, variants in SUITE:
             try:
@@ -277,6 +341,11 @@ def main():
             sweep_blockdot_tiles()
         except Exception as e:
             print(f"tile sweep: FAILED {e!r}"[:300])
+            sys.stdout.flush()
+        try:
+            bench_flash_decode()
+        except Exception as e:
+            print(f"flash bench: FAILED {e!r}"[:300])
             sys.stdout.flush()
         print("KBENCH DONE")
         sys.stdout.flush()
