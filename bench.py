@@ -21,7 +21,7 @@ Env knobs:
   BENCH_SLOTS          comma list for the batched sweep (default '8,32')
   BENCH_DECODE_TOKENS  timed fused-decode length (default 128)
   BENCH_KERNELS        auto (default) | pallas | xla — engine matmul backend
-  BENCH_Q40_STYLE      auto (default) | deq | blockdot | maskdot — Pallas
+  BENCH_Q40_STYLE      auto (default) | deq | blockdot | maskdot | loopdot —
                        decode-kernel style (prefill always uses deq)
   BENCH_XLA_PREFILL_M  int: route Pallas matmuls with flattened m >= this
                        through the XLA dequant-dot GEMM (prefill tier A/B;
@@ -362,9 +362,9 @@ def worker():
             )
 
     q40_style = os.environ.get("BENCH_Q40_STYLE", "auto")
-    if q40_style not in ("auto", "deq", "blockdot", "maskdot"):
+    if q40_style not in ("auto", "deq", "blockdot", "maskdot", "loopdot"):
         raise SystemExit(
-            f"BENCH_Q40_STYLE must be auto|deq|blockdot|maskdot, got {q40_style!r}"
+            f"BENCH_Q40_STYLE must be auto|deq|blockdot|maskdot|loopdot, got {q40_style!r}"
         )
     if q40_style != "auto":
         from dllama_tpu.ops.pallas import q40_matmul as _qmod
@@ -500,7 +500,10 @@ def worker():
         del wide_params  # params persists: the next preset may share its shapes
 
     # bytes/token is part of the benchmark contract (SURVEY.md §5.1/§6): on
-    # one chip it's 0; multi-chip runs report the analytic ICI payload.
+    # one chip it's 0; multi-chip runs report the MEASURED per-token HLO
+    # collective bytes when experiments/collectives.json covers the mesh
+    # (COLLECTIVES.md, the reference's Fig. 6 analog), else the analytic
+    # ICI payload model.
     from dllama_tpu.utils.profiling import collective_bytes_per_token
 
     if not best[1]:
@@ -516,7 +519,21 @@ def worker():
             moe = {"error": repr(e)[:200]}
 
     cfg8 = LlamaConfig(**PRESETS[run_presets[-1]])
-    kb = collective_bytes_per_token(cfg8, tp=jax.device_count())["kb_per_token_per_chip"]
+    n_dev = jax.device_count()
+    kb = collective_bytes_per_token(cfg8, tp=n_dev)["kb_per_token_per_chip"]
+    kb_measured = None
+    if n_dev > 1:
+        try:
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "experiments", "collectives.json")) as f:
+                tbl = json.load(f)
+            rec = tbl.get(f"{run_presets[-1]}/tp{n_dev}/bf16")
+            if isinstance(rec, dict) and isinstance(
+                rec.get("measured_kb_per_token_per_chip"), (int, float)
+            ):
+                kb_measured = round(rec["measured_kb_per_token_per_chip"], 1)
+        except (OSError, ValueError):
+            pass  # malformed table must never cost a finished bench run
     result = {
         "metric": f"tokens/sec/chip, {best[1]}, Q40 synthetic, 1 chip ({dev.platform})",
         "value": best[2],
@@ -531,7 +548,8 @@ def worker():
         "q40_style": q40_style,
         "xla_prefill_m": int(xla_prefill_m) if xla_prefill_m else None,
         "moe": moe,
-        "kb_per_token_per_chip": round(kb, 1),
+        "kb_per_token_per_chip": kb_measured if kb_measured is not None else round(kb, 1),
+        "kb_per_token_source": "measured_hlo" if kb_measured is not None else "analytic",
     }
     print(json.dumps(result))
 

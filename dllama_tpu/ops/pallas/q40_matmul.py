@@ -65,11 +65,15 @@ from dllama_tpu.ops.quant import Q_BLOCK, QTensor
 _EXP_BITS = 0x4B000000
 _V_OFFSET = 8388608.0 + 8.0
 
-# kernel-style override for benchmarks: 'auto' | 'deq' | 'blockdot' | 'maskdot'
+# kernel-style override for benchmarks:
+# 'auto' | 'deq' | 'blockdot' | 'maskdot' | 'loopdot'
 # ('maskdot' = blockdot's math with the per-block partial dots expressed as
 # ONE plain dot on a block-masked activation matrix — a fallback in case
 # Mosaic rejects the batched dot_general; MXU does nb x redundant zero MACs,
-# irrelevant while decode is HBM/VPU-bound)
+# irrelevant while decode is HBM/VPU-bound. 'loopdot' = the same math as a
+# STATICALLY UNROLLED sequence of plain [m,32]x[32,tn] dots — no batched
+# dot_general, no masking, no redundant MACs; the most lowering-conservative
+# fallback, at the cost of nb tiny MXU launches per grid step.)
 STYLE = "auto"
 
 # decode-kernel tile overrides for on-hardware autotuning (experiments/
@@ -227,6 +231,71 @@ def _maskdot_kernel(
         out_ref[:] = acc_ref[:]
 
 
+def _loopdot_kernel(
+    layer_ref, xb_ref, packed_ref, scales_ref, out_ref, acc_ref, *, tk, tn
+):
+    del layer_ref
+    kb = pl.program_id(1)
+
+    @pl.when(kb == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # blockdot's exact math (codes q-8 lossless in the activation dtype, f32
+    # scales applied to the per-block partials) with the nb-batched dot
+    # unrolled into nb PLAIN dots at static indices — nothing here that a
+    # Mosaic build supporting jnp.dot can reject
+    c = _unpack_codes(packed_ref[:], tk, tn).astype(xb_ref.dtype)  # [nb, 32, tn]
+    s = _scales_f32(scales_ref[:])  # [nb, tn]
+    acc = acc_ref[:]
+    for b in range(tk // Q_BLOCK):  # static unroll
+        y = jnp.dot(xb_ref[b], c[b], preferred_element_type=jnp.float32)
+        acc = acc + y * s[b][None, :]
+    acc_ref[:] = acc
+
+    @pl.when(kb == pl.num_programs(1) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _loopdot_call(layer, x, packed, scales, *, interpret: bool = False):
+    """blockdot fallback #2: same math, statically-unrolled plain dots. Small
+    tk keeps the unroll count (tk/32 dots per grid step) bounded."""
+    m, k = x.shape
+    n = packed.shape[-1]
+    nb = k // Q_BLOCK
+    tn = _pick_tile(n, (512, 256, 128))
+    tk = _pick_tile(k, (256, 128, 64, 32))
+    grid = (n // tn, k // tk)
+    xb = x.reshape(m, nb, Q_BLOCK).transpose(1, 0, 2)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tk // Q_BLOCK, m, Q_BLOCK), lambda j, kb, L: (kb, 0, 0)),
+            pl.BlockSpec((None, tk // 2, tn), lambda j, kb, L: (L[0], kb, j)),
+            pl.BlockSpec((None, tk // Q_BLOCK, tn), lambda j, kb, L: (L[0], kb, j)),
+        ],
+        out_specs=pl.BlockSpec((m, tn), lambda j, kb, L: (0, j)),
+        scratch_shapes=[pltpu.VMEM((m, tn), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_loopdot_kernel, tk=tk, tn=tn),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * n * k,
+            bytes_accessed=m * k * 4 + k * n // 2 + (k // Q_BLOCK) * n * scales.dtype.itemsize + m * n * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(layer, xb, packed, scales)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _maskdot_call(layer, x, packed, scales, *, interpret: bool = False):
     """blockdot fallback: same math, plain-dot-only lowering (m <= 16)."""
@@ -345,7 +414,7 @@ def q40_matmul(
     style = STYLE
     if style == "auto":
         style = "blockdot" if mp <= 16 else "deq"
-    elif style in ("blockdot", "maskdot") and mp > 16:
+    elif style in ("blockdot", "maskdot", "loopdot") and mp > 16:
         # forced decode-shaped styles apply only to decode-shaped calls; a
         # forced style is a DECODE-kernel selector, prefill always uses deq
         # (callers labeling results must report per-m paths, see bench.py)
@@ -359,6 +428,8 @@ def q40_matmul(
                              tk=tk_o, tn=tn_o)
     elif style == "maskdot":
         out = _maskdot_call(layer_arr, x2, packed, scales, interpret=interpret)
+    elif style == "loopdot":
+        out = _loopdot_call(layer_arr, x2, packed, scales, interpret=interpret)
     else:
         out = _deq_call(layer_arr, x2, packed, scales, interpret=interpret)
     if pad:

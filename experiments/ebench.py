@@ -21,6 +21,7 @@ print("devices:", jax.devices(), f"({time.time()-t0:.0f}s)", flush=True)
 from dllama_tpu.engine.engine import InferenceEngine
 from dllama_tpu.models.config import LlamaConfig
 from dllama_tpu.models.llama import random_params_fast
+from dllama_tpu.ops import layers as layers_mod
 from dllama_tpu.ops.pallas import q40_matmul as qmod
 
 N_DECODE = int(sys.argv[1]) if len(sys.argv) > 1 else 64
@@ -43,7 +44,10 @@ COMBOS = [
     ("ufull", True, "auto", "auto", False),
     ("jnp-attn", 1, "jnp", "auto", False),
     ("maskdot", 1, "auto", "maskdot", False),
+    ("loopdot", 1, "auto", "loopdot", False),
     ("deq-decode", 1, "auto", "deq", False),
+    # reserve Pallas rms_norm (VERDICT r3 weak #8): flip only on a win here
+    ("pallas-norm", 1, "auto", "auto", False),
 ]
 
 PROMPT_LEN = min(512, cfg.seq_len // 2)
@@ -53,6 +57,7 @@ first = np.array([[1]], np.int32)
 fails = []
 for label, unroll, attn, style, fuse in COMBOS:
     qmod.STYLE = style
+    layers_mod.RMS_NORM_IMPL = "pallas" if label == "pallas-norm" else "jnp"
     try:
         eng = InferenceEngine(cfg, params, cache_dtype=jnp.bfloat16,
                               max_prefill_chunk=512, layer_unroll=unroll,
@@ -78,6 +83,7 @@ for label, unroll, attn, style, fuse in COMBOS:
         print(f"{label}: FAILED {e!r}"[:300], flush=True)
     finally:
         qmod.STYLE = "auto"
+        layers_mod.RMS_NORM_IMPL = "jnp"
 
 # machine-checkable completion marker: the CI smoke asserts fails=0; in a live
 # window partial failure still exits 0 so later session stages run (tee'd log

@@ -10,6 +10,7 @@ shapes, 2 iters) so CI proves the harness cannot crash in a live TPU window
 (VERDICT r3 #2); smoke numbers are meaningless, only completion matters.
   variants: A  production dispatch (q40_matmul auto: blockdot for m<=16, deq above)
             DQ forced deq-style kernel      BD forced blockdot kernel
+            MD forced maskdot fallback      LD forced loopdot fallback
             B  legacy fma-f32 kernel        D  bf16-weights roofline reference
             E  XLA dequantize-then-dot
 Measures achieved HBM GB/s (packed+scales bytes) on 1B-preset shapes.
@@ -164,10 +165,11 @@ def run_one(m, label, variants):
         # batched dot_general might not lower) must not eat the row's other
         # timings in a one-shot TPU window
         try:
-            if v in ("A", "DQ", "BD", "MD"):
-                # NOTE: forced decode styles (BD/MD) apply only when m <= 16;
+            if v in ("A", "DQ", "BD", "MD", "LD"):
+                # NOTE: forced decode styles (BD/MD/LD) apply only when m <= 16;
                 # larger m silently uses deq (the dispatcher's prefill rule)
-                style = {"A": "auto", "DQ": "deq", "BD": "blockdot", "MD": "maskdot"}[v]
+                style = {"A": "auto", "DQ": "deq", "BD": "blockdot",
+                         "MD": "maskdot", "LD": "loopdot"}[v]
                 t = bench(dispatch_closure(w, style), (x,))
                 rows.append((f"{v} {style}", t, qbytes))
             elif v == "B":
@@ -202,7 +204,7 @@ def run_one(m, label, variants):
 
 SUITE = [
     # decode shapes: the production dispatch + each forced style + rooflines
-    (8, "w1", ["A", "BD", "MD", "DQ", "D", "E"]),
+    (8, "w1", ["A", "BD", "MD", "LD", "DQ", "D", "E"]),
     (8, "wcls", ["A", "D", "E"]),  # the lm head is ~18% of 1B weight bytes
     # prefill shapes: in-kernel deq vs the XLA dequant-dot the MXU loves
     (256, "w1", ["DQ", "D", "E"]),
@@ -226,7 +228,7 @@ def enable_smoke():
         "wcls": (128, 512),
     }
     SUITE = [
-        (8, "w1", ["A", "BD", "MD", "DQ", "B", "D", "E"]),
+        (8, "w1", ["A", "BD", "MD", "LD", "DQ", "B", "D", "E"]),
         (8, "wcls", ["A", "D", "E"]),
         (32, "w1", ["DQ", "D", "E"]),
     ]

@@ -46,7 +46,7 @@ stacked = QTensor(jnp.stack([w.packed for w in ws]), jnp.stack([w.scales for w i
 wd1 = ws[1].dequantize(jnp.float32)
 
 _interp = jax.devices()[0].platform != "tpu"
-for style, m in (("blockdot", 8), ("maskdot", 8), ("deq", 128)):
+for style, m in (("blockdot", 8), ("maskdot", 8), ("loopdot", 8), ("deq", 128)):
     x = jnp.asarray(rng.standard_normal((m, K)), jnp.bfloat16)
     qmod.STYLE = style
     try:

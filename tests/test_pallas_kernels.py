@@ -142,10 +142,10 @@ def test_rms_norm_pallas_matches_jnp(rng, shape, dtype):
     )
 
 
-@pytest.mark.parametrize("style", ["blockdot", "maskdot", "deq"])
+@pytest.mark.parametrize("style", ["blockdot", "maskdot", "loopdot", "deq"])
 def test_q40_styles_agree(rng, style):
-    """Every decode-kernel style computes the same product (maskdot is the
-    plain-dot fallback for blockdot's batched dot_general)."""
+    """Every decode-kernel style computes the same product (maskdot and
+    loopdot are the plain-dot fallbacks for blockdot's batched dot_general)."""
     from dllama_tpu.ops.pallas import q40_matmul as qmod
 
     x = jnp.asarray(rng.standard_normal((3, 512)), jnp.float32)
