@@ -39,6 +39,12 @@ BACKEND = "auto"
 # bench.py overrides via BENCH_XLA_PREFILL_M to A/B it on hardware.
 XLA_PREFILL_MIN_M: int | None = None
 
+# Pallas interpret-mode override: None = auto (interpret off-TPU, the normal
+# rule). experiments/aot_check.py sets False while AOT-compiling for a TPU
+# topology from a CPU host — the platform check would otherwise bake
+# interpret=True into the trace and Mosaic would never see the kernel.
+INTERPRET: bool | None = None
+
 
 def _platform() -> str:
     try:
@@ -96,7 +102,8 @@ def matmul(x: jax.Array, w, layer=None, backend: str | None = None) -> jax.Array
                 and m >= XLA_PREFILL_MIN_M
             )
             if supported(x.shape, w) and not route_xla:
-                return q40_matmul(x, w, layer, interpret=_platform() != "tpu")
+                interp = INTERPRET if INTERPRET is not None else _platform() != "tpu"
+                return q40_matmul(x, w, layer, interpret=interp)
         if layer is not None and w.packed.ndim == 3:
             w = slice_leaf(w, layer)
         wd = w.dequantize(x.dtype)

@@ -1,0 +1,229 @@
+"""AOT Mosaic-acceptance check — NO TPU hardware needed.
+
+libtpu is installed locally, and PJRT topology descriptions let XLA:TPU
+compile a lowered module for a real chip target offline
+(`jax.experimental.topologies.get_topology_desc`). That turns VERDICT r3's
+biggest unknown — "does Mosaic accept the blockdot kernel's batched
+dot_general?" (missing #2 / next-round #8) — into a question answerable
+without the axon tunnel: compile every Pallas kernel, every decode style,
+and the blockdot tile-sweep candidates for v5e/v6e (+ v4/v5p with --full)
+and record ACCEPT or REJECT per (target, kernel).
+
+Acceptance here means the Mosaic compiler inside XLA:TPU compiled the
+kernel to machine code for that chip; runtime speed still needs the window
+(kbench). Rejection surfaces the exact Mosaic error now, while there is
+still time to fix the kernel before a window fires.
+
+Usage: python experiments/aot_check.py [--full] [--md MOSAIC_AOT.md]
+Exit 0 when every production-default kernel accepts on every target
+(fallback styles may reject — they are insurance, flagged but not fatal).
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dllama_tpu.ops.pallas import q40_matmul as qmod
+from dllama_tpu.ops.quant import Q_BLOCK
+
+S = jax.ShapeDtypeStruct
+
+
+def targets(full: bool):
+    """Resolve every requested chip target; an unresolvable target is FATAL —
+    a gate that silently compiled for fewer targets than requested would pass
+    green while validating nothing (the whole point is that a Mosaic
+    rejection must not survive to a live window)."""
+    names = ["v5e:2x2", "v6e:2x2"] + (["v4:2x2x1", "v5p:2x2x1"] if full else [])
+    from jax.experimental import topologies
+
+    out = []
+    for n in names:
+        try:
+            out.append((n, topologies.get_topology_desc(n, platform="tpu")))
+        except Exception as e:
+            raise SystemExit(
+                f"FATAL: topology {n} unavailable ({repr(e)[:160]}) — the "
+                "acceptance gate cannot run; do not treat this as a pass"
+            )
+    return out
+
+
+def cases(full: bool):
+    """(name, fn, abstract args, production) tuples. Shapes are the 1b preset's
+    hot ops (kbench's SHAPES); `production` marks kernels whose rejection
+    fails the check (the shipped defaults), vs fallback insurance."""
+    L = 2
+    sh_w = lambda k, n: (
+        S((L, k // 2, n), jnp.uint8),
+        S((L, k // Q_BLOCK, n), jnp.uint16),
+    )
+    layer = S((1,), jnp.int32)
+    out = []
+
+    def style_case(name, style, m, k, n, production, tk=None, tn=None):
+        packed, scales = sh_w(k, n)
+
+        def fn(l, x, p, s, style=style, tk=tk, tn=tn):
+            qmod.STYLE, qmod.BLOCKDOT_TK, qmod.BLOCKDOT_TN = style, tk, tn
+            try:
+                return qmod.q40_matmul(x, qmod.QTensor(p, s), l)
+            finally:
+                qmod.STYLE = "auto"
+                qmod.BLOCKDOT_TK = qmod.BLOCKDOT_TN = None
+
+        out.append((name, fn, (layer, S((m, k), jnp.bfloat16), packed, scales), production))
+
+    style_case("blockdot m=8 w1(2048x8192)", "blockdot", 8, 2048, 8192, True)
+    style_case("blockdot m=8 wcls(2048x128256)", "blockdot", 8, 2048, 128256, True)
+    style_case("deq m=256 w1(2048x8192)", "deq", 256, 2048, 8192, True)
+    style_case("maskdot m=8 w1", "maskdot", 8, 2048, 8192, False)
+    style_case("loopdot m=8 w1", "loopdot", 8, 2048, 8192, False)
+    if full:
+        for tk in (512, 1024, 2048):
+            for tn in (128, 256, 512):
+                style_case(f"blockdot tiles tk={tk} tn={tn}", "blockdot",
+                           8, 2048, 8192, False, tk=tk, tn=tn)
+
+    # flash attention: decode (t=1, group=4 folded+pad) and prefill shapes
+    from dllama_tpu.ops.pallas.flash_attention import flash_gqa_attention
+
+    def flash(q_shape, s_len):
+        q = S(q_shape, jnp.bfloat16)
+        kv = S((1, 8, s_len, 128), jnp.bfloat16)
+        return (lambda q, k, v: flash_gqa_attention(q, k, v, jnp.int32(7)),
+                (q, kv, kv))
+
+    fn, args = flash((1, 1, 32, 128), 1024)
+    out.append(("flash decode t=1 S=1024", fn, args, True))
+    fn, args = flash((1, 256, 32, 128), 1024)
+    out.append(("flash prefill t=256 S=1024", fn, args, True))
+    fn, args = flash((1, 1, 32, 128), 8192)
+    out.append(("flash decode t=1 S=8192", fn, args, True))
+
+    from dllama_tpu.ops.pallas.rms_norm import rms_norm as prms
+
+    out.append(("rms_norm (reserve)", lambda x, w: prms(x, w, 1e-5),
+                (S((8, 2048), jnp.bfloat16), S((2048,), jnp.bfloat16)), False))
+    return out
+
+
+def sharded_cases(topo):
+    """The PRODUCTION shard_map'd Pallas paths (parallel/sharding.py), AOT-
+    compiled on a 4-chip tp mesh of the target topology: out-dim-sharded mm,
+    in-dim-sharded mm (+psum over 'tp'), head-sharded flash. This is the real
+    multi-chip TP path compiling for a real chip — one step past the CPU
+    dryrun (which can only prove partitioning, in interpret mode)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dllama_tpu.models.config import LlamaConfig
+    from dllama_tpu.ops import matmul as mmod
+    from dllama_tpu.ops.quant import QTensor
+    from dllama_tpu.parallel.mesh import MeshConfig, make_mesh
+    from dllama_tpu.parallel.sharding import LlamaShardings
+
+    cfg = LlamaConfig(dim=256, hidden_dim=512, n_layers=2, n_heads=8,
+                      n_kv_heads=4, vocab_size=512, seq_len=256)
+    mesh = make_mesh(MeshConfig(tp=4), devices=topo.devices[:4])
+    sh = LlamaShardings(mesh, cfg)
+    mm, mm_in = sh.pallas_mms(1)
+    attn = sh.pallas_attn(1, interpret=False)
+    ns = lambda spec: NamedSharding(mesh, spec)
+    L = cfg.n_layers
+
+    def qw(k, n, spec):
+        return (S((L, k // 2, n), jnp.uint8, sharding=ns(spec)),
+                S((L, k // Q_BLOCK, n), jnp.uint16, sharding=ns(spec)))
+
+    def forced(fn):
+        def wrapped(*args):
+            mmod.INTERPRET = False
+            try:
+                return fn(*args)
+            finally:
+                mmod.INTERPRET = None
+        return wrapped
+
+    x = S((1, 1, cfg.dim), jnp.bfloat16, sharding=ns(P()))
+    li = S((), jnp.int32, sharding=ns(P()))
+    out = []
+    p1, s1 = qw(cfg.dim, cfg.hidden_dim, P(None, None, "tp"))
+    out.append(("shard_map mm out-shard (w1)",
+                forced(lambda x, p, s, l: mm(x, QTensor(p, s), l)),
+                (x, p1, s1, li), True))
+    p2, s2 = qw(cfg.hidden_dim, cfg.dim, P(None, "tp", None))
+    xh = S((1, 1, cfg.hidden_dim), jnp.bfloat16, sharding=ns(P(None, None, "tp")))
+    out.append(("shard_map mm in-shard+psum (w2)",
+                forced(lambda x, p, s, l: mm_in(x, QTensor(p, s), l)),
+                (xh, p2, s2, li), True))
+    q = S((1, 1, cfg.n_heads, 64), jnp.bfloat16, sharding=ns(P(None, None, "tp", None)))
+    kc = S((1, cfg.n_kv_heads, cfg.seq_len, 64), jnp.bfloat16,
+           sharding=ns(P(None, "tp", None, None)))
+    pos = S((), jnp.int32, sharding=ns(P()))
+    out.append(("shard_map head-sharded flash",
+                lambda q, k, v, p: attn(q, k, v, p), (q, kc, kc, pos), True))
+    return out
+
+
+def main():
+    full = "--full" in sys.argv
+    md_path = "MOSAIC_AOT.md"
+    if "--md" in sys.argv:
+        i = sys.argv.index("--md") + 1
+        if i >= len(sys.argv):
+            raise SystemExit("usage: aot_check.py [--full] [--md OUTPUT.md]")
+        md_path = sys.argv[i]
+    rows, prod_reject = [], []
+    for tname, topo in targets(full):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(topo.devices[:1], ("x",))
+        repl = NamedSharding(mesh, P())
+        single = [
+            # pin abstract args to one device of the target topology so
+            # XLA:TPU (not Host) compiles the module — Mosaic runs inside
+            (cname, fn, tuple(S(a.shape, a.dtype, sharding=repl) for a in args), prod)
+            for cname, fn, args, prod in cases(full)
+        ]
+        for cname, fn, args_sh, production in single + sharded_cases(topo):
+            t0 = time.time()
+            try:
+                jax.jit(fn).trace(*args_sh).lower().compile()
+                verdict = "ACCEPT"
+            except Exception as e:
+                verdict = f"REJECT {repr(e)[:220]}"
+                if production:
+                    prod_reject.append((tname, cname))
+            rows.append((tname, cname, production, verdict, time.time() - t0))
+            print(f"{tname} | {cname}: {verdict} ({rows[-1][4]:.0f}s)", flush=True)
+
+    with open(md_path, "w") as f:
+        f.write(
+            "# Mosaic AOT acceptance (offline XLA:TPU compile, no hardware)\n\n"
+            "Per-target compile verdicts for every Pallas kernel, produced by\n"
+            "`experiments/aot_check.py` via libtpu topology AOT compilation —\n"
+            "the committed yes/no VERDICT r3 asked for on blockdot lowering\n"
+            "(missing #2 / next-round #8). ACCEPT = Mosaic compiled the kernel\n"
+            "to machine code for that chip; runtime perf still comes from\n"
+            "kbench in a live window. 'prod' kernels are shipped defaults;\n"
+            "others are fallback insurance.\n\n"
+            "| target | kernel | prod | verdict |\n|---|---|---|---|\n"
+        )
+        for tname, cname, production, verdict, dt in rows:
+            f.write(f"| {tname} | {cname} | {'yes' if production else ''} | "
+                    f"{verdict.split(chr(10))[0][:120]} |\n")
+    print(f"wrote {md_path}")
+    print("AOT CHECK " + ("FAIL: production kernels rejected: " + str(prod_reject)
+                          if prod_reject else "ALL PRODUCTION KERNELS ACCEPT"))
+    return 1 if prod_reject else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

