@@ -115,6 +115,73 @@ def cases(full: bool):
     return out
 
 
+def full_step_case(topo):
+    """The ENTIRE 1b decode step — embedding gather, 16-layer scan with
+    blockdot matmuls + flash attention + KV cache update, final norm, wcls —
+    AOT-compiled for one chip of the target. Kernel-level acceptance can miss
+    interactions (Mosaic custom calls inside lax.scan, donated buffers);
+    this is the whole production graph."""
+    from functools import partial
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dllama_tpu.models.config import LlamaConfig
+    from dllama_tpu.models.llama import forward
+    from dllama_tpu.models.llama import KVCache
+    from dllama_tpu.ops import matmul as mmod
+    from dllama_tpu.ops.matmul import matmul
+    from dllama_tpu.ops.pallas.flash_attention import flash_gqa_attention
+    from dllama_tpu.ops.quant import QTensor
+
+    cfg = LlamaConfig(dim=2048, hidden_dim=8192, n_layers=16, n_heads=32,
+                      n_kv_heads=8, vocab_size=128256, seq_len=1024)
+    mesh = Mesh(topo.devices[:1], ("x",))
+    repl = NamedSharding(mesh, P())
+    A = lambda shape, dt: S(shape, dt, sharding=repl)
+
+    def qw(lead, k, n):
+        return QTensor(A((*lead, k // 2, n), jnp.uint8),
+                       A((*lead, k // Q_BLOCK, n), jnp.uint16))
+
+    L = cfg.n_layers
+    params = {
+        "embedding": A((cfg.vocab_size, cfg.dim), jnp.bfloat16),
+        "final_norm": A((cfg.dim,), jnp.float32),
+        "wcls": qw((), cfg.dim, cfg.vocab_size),
+        "layers": {
+            "wq": qw((L,), cfg.dim, cfg.dim),
+            "wk": qw((L,), cfg.dim, cfg.kv_dim),
+            "wv": qw((L,), cfg.dim, cfg.kv_dim),
+            "wo": qw((L,), cfg.dim, cfg.dim),
+            "w1": qw((L,), cfg.dim, cfg.hidden_dim),
+            "w2": qw((L,), cfg.hidden_dim, cfg.dim),
+            "w3": qw((L,), cfg.dim, cfg.hidden_dim),
+            "rms_att": A((L, cfg.dim), jnp.float32),
+            "rms_ffn": A((L, cfg.dim), jnp.float32),
+        },
+    }
+    cshape = (L, 1, cfg.n_kv_heads, cfg.seq_len, cfg.head_size)
+    cache = KVCache(A(cshape, jnp.bfloat16), A(cshape, jnp.bfloat16))
+    rope = A((cfg.seq_len, cfg.head_size // 2, 2), jnp.float32)
+    tokens = A((1, 1), jnp.int32)
+    pos = A((), jnp.int32)
+
+    def step(params, cache, tokens, pos, rope):
+        mmod.INTERPRET = False
+        try:
+            logits, cache = forward(
+                cfg, params, tokens, pos, cache, rope,
+                partial(flash_gqa_attention, interpret=False),
+                mm=partial(matmul, backend="pallas"), last_only=True,
+            )
+            return logits[:, -1], cache
+        finally:
+            mmod.INTERPRET = None
+
+    return [("FULL 1b decode step (scan+flash+blockdot)", step,
+             (params, cache, tokens, pos, rope), True)]
+
+
 def sharded_cases(topo):
     """The PRODUCTION shard_map'd Pallas paths (parallel/sharding.py), AOT-
     compiled on a 4-chip tp mesh of the target topology: out-dim-sharded mm,
@@ -192,7 +259,9 @@ def main():
             (cname, fn, tuple(S(a.shape, a.dtype, sharding=repl) for a in args), prod)
             for cname, fn, args, prod in cases(full)
         ]
-        for cname, fn, args_sh, production in single + sharded_cases(topo):
+        for cname, fn, args_sh, production in (
+            single + sharded_cases(topo) + full_step_case(topo)
+        ):
             t0 = time.time()
             try:
                 jax.jit(fn).trace(*args_sh).lower().compile()
