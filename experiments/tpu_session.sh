@@ -8,6 +8,11 @@ cd "$(dirname "$0")/.."
 mkdir -p experiments/logs
 TS=$(date +%H%M%S)
 L=experiments/logs
+# persistent compile cache: the window's stages (validate/kbench/ebench/bench)
+# re-compile many shared shapes; first-compile-over-tunnel is 20-40s each,
+# cache hits across processes AND across windows are ~free
+export JAX_COMPILATION_CACHE_DIR="$PWD/experiments/jax_cache"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 
 echo "== 1. probe"
 timeout 60 python -c "import jax; print('PROBE', jax.devices())" || { echo "tunnel down"; exit 1; }
