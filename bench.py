@@ -330,7 +330,79 @@ def bench_moe(n_tokens=256, iters=20):
     return out
 
 
+def admission_streams(cfg, pf_chunk: int, prompt_len: int):
+    """Token streams for the admission-stall scenario, shared with
+    experiments/abench.py. DISTINCT leading tokens per stream: the
+    scheduler's prefix cache would otherwise match a measured admission
+    against a warmup slot's history and prefill 1 token instead of
+    prompt_len (silently gutting the measurement). The warmup prompt's
+    (2*pf_chunk - 1) length decomposes into every pow-2 prefill width."""
+    import numpy as np
+
+    mk = lambda base, n: list(((np.arange(n) * 7 + base) % (cfg.vocab_size - 2) + 1).astype(int))
+    warm = mk(501, 2 * pf_chunk - 1)
+    bg_maker = lambda s: mk(1001 + 97 * s, 3)
+    return warm, bg_maker, mk(3001, prompt_len)
+
+
+def bench_admission(cfg, params, n_slots=8, prompt_len=512, chunk=4, pf_chunk=64):
+    """Admission-stall record for the serving tier (VERDICT r3 #4): the max
+    decode-to-decode gap batch-mates see while a long prompt joins, legacy
+    synchronous admission vs chunk-interleaved (scheduler default). Small
+    slot count keeps the compile bill bounded; the ratio is the story."""
+    import jax.numpy as jnp
+
+    from dllama_tpu.engine.batch import BatchEngine
+    from dllama_tpu.serve.scheduler import Scheduler
+
+    out = {"slots": n_slots, "prompt": prompt_len}
+    warm, bg_maker, prompt = admission_streams(cfg, pf_chunk, prompt_len)
+    for interleave in (False, True):
+        key = "interleave" if interleave else "sync"
+        sched = None
+        try:
+            eng = BatchEngine(cfg, params, n_slots=n_slots, cache_dtype=jnp.bfloat16,
+                              max_prefill_chunk=pf_chunk)
+            sched = Scheduler(eng, chunk=chunk, admit_interleave=interleave)
+            w = sched.submit(warm, 0.0, 0.9, chunk, frozenset(), seed=7)
+            list(w.tokens())
+            sched.reset_latency_stats()  # compile gaps are not stalls
+            bg = [sched.submit(bg_maker(s), 0.8, 0.9, 16 * chunk, frozenset(), seed=s)
+                  for s in range(max(1, n_slots // 2))]
+            it = bg[0].tokens()
+            for _ in range(2 * chunk):
+                next(it)
+            r_long = sched.submit(prompt, 0.0, 0.9, chunk, frozenset(), seed=99)
+            for _ in it:
+                pass
+            list(r_long.tokens())
+            for r in bg[1:]:
+                list(r.tokens())
+            s = sched.latency_summary()
+            if s["admission_stall_ms_max"] is not None:
+                out[key + "_stall_ms_max"] = round(s["admission_stall_ms_max"], 1)
+            out[key + "_long_ttft_ms"] = round(r_long.ttft_ms or 0.0, 1)
+        except Exception as e:
+            out[key + "_error"] = repr(e)[:160]
+        finally:
+            if sched is not None:
+                sched.shutdown()
+    sync_s, il_s = out.get("sync_stall_ms_max"), out.get("interleave_stall_ms_max")
+    if sync_s is not None and il_s is not None:
+        # floor the denominator at timer noise so a 0.0 best-case still yields
+        # a (large, finite) ratio instead of vanishing from the JSON
+        out["stall_reduction_x"] = round(sync_s / max(il_s, 0.05), 1)
+    return out
+
+
 def worker():
+    # persistent compile cache: repeated bench runs (and the tpu_session
+    # stages) reuse executables instead of paying tunnel compiles again
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "experiments", "jax_cache"),
+    )
     import jax
     import jax.numpy as jnp
 
@@ -518,6 +590,16 @@ def worker():
         except Exception as e:
             moe = {"error": repr(e)[:200]}
 
+    # serving-tier admission-stall record (uses the last preset's live params;
+    # param shapes are seq-independent, so the sweep preset's cfg applies)
+    admit = None
+    if (sweep_on and sweep_on != "tiny" and os.environ.get("BENCH_ADMIT") != "0"
+            and time.monotonic() < deadline - 240):
+        try:
+            admit = bench_admission(LlamaConfig(**PRESETS[sweep_on]), params)
+        except Exception as e:
+            admit = {"error": repr(e)[:200]}
+
     cfg8 = LlamaConfig(**PRESETS[run_presets[-1]])
     n_dev = jax.device_count()
     kb = collective_bytes_per_token(cfg8, tp=n_dev)["kb_per_token_per_chip"]
@@ -548,6 +630,7 @@ def worker():
         "q40_style": q40_style,
         "xla_prefill_m": int(xla_prefill_m) if xla_prefill_m else None,
         "moe": moe,
+        "admission": admit,
         "kb_per_token_per_chip": kb_measured if kb_measured is not None else round(kb, 1),
         "kb_per_token_source": "measured_hlo" if kb_measured is not None else "analytic",
     }

@@ -143,6 +143,16 @@ class Scheduler:
             "admission_stall_ms_mean": mean(gaps),
         }
 
+    def reset_latency_stats(self) -> None:
+        """Drop accumulated latency/stall samples (benches call this after
+        their compile-warmup phase so first-compile gaps don't pollute the
+        measured record). Also rewinds the loop's decode-gap anchor so the
+        first post-reset gap cannot span back to a pre-reset decode chunk."""
+        with self._metrics_lock:
+            self._completed.clear()
+            self._admit_gaps_ms.clear()
+        self._t_dec_end = None
+
     def cancel(self, req: Request) -> None:
         req.cancelled.set()
         self._wake.set()
@@ -280,7 +290,9 @@ class Scheduler:
         return worked
 
     def _run(self) -> None:
-        t_dec_end = None  # end of the previous decode chunk (stall metric)
+        # end of the previous decode chunk (stall metric); instance attribute
+        # so reset_latency_stats can rewind it from the caller's thread
+        self._t_dec_end = None
         while not self._stop.is_set():
             self._admit_starts()
             admitted = self._pump_admissions()
@@ -290,14 +302,14 @@ class Scheduler:
                 elif int(self.engine.pos[slot]) >= self.engine.seq_len:
                     self._finish(req, "length")
             if not self.slots:
-                t_dec_end = None
+                self._t_dec_end = None
                 if not self._inflight:
                     self._wake.wait(timeout=self.admit_timeout)
                     self._wake.clear()
                 continue
-            if admitted and t_dec_end is not None:
+            if admitted and self._t_dec_end is not None:
                 # decode-to-decode gap attributable to admission work
-                gap_ms = (time.monotonic() - t_dec_end) * 1000.0
+                gap_ms = (time.monotonic() - self._t_dec_end) * 1000.0
                 with self._metrics_lock:
                     self._admit_gaps_ms.append(gap_ms)
                     del self._admit_gaps_ms[:-256]
@@ -310,7 +322,7 @@ class Scheduler:
                     req.out.put(e)
                     self._finish(req, "error")
                 continue
-            t_dec_end = time.monotonic()
+            self._t_dec_end = time.monotonic()
             n = toks.shape[0]
             for slot, req in list(self.slots.items()):
                 for i in range(n):
