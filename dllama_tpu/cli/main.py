@@ -41,6 +41,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="reference-exact nucleus: full-vocab sort per step instead "
                         "of the approx-top-256 candidate set (slower on big vocabs)")
     p.add_argument("--seed", type=int, default=None, help="sampler seed (default: time)")
+    p.add_argument("--spec", type=int, default=0, metavar="K",
+                   help="prompt-lookup speculative decoding with K-token drafts "
+                        "(greedy runs only — bit-identical output, fewer forwards "
+                        "on repetitive text; 0 = off)")
     p.add_argument("--max-seq-len", type=int, default=None, help="clamp context length (RAM cap)")
     p.add_argument(
         "--mesh",
@@ -163,7 +167,8 @@ def cmd_inference(args) -> int:
     with profiling.trace(args.trace):
         timer.start()
         for t in m.engine.generate(
-            prompt_tokens, max_tokens, sampler, stop_fn=tok.is_eos, stats=stats
+            prompt_tokens, max_tokens, sampler, stop_fn=tok.is_eos, stats=stats,
+            spec=args.spec,
         ):
             timer.stop()
             piece = tok.decode(t)
@@ -248,7 +253,7 @@ def cmd_chat(args) -> int:
         if budget <= 0:
             print("(context window exhausted)")
             break
-        for t in m.engine.generate(prompt_tokens, budget, sampler):
+        for t in m.engine.generate(prompt_tokens, budget, sampler, spec=args.spec):
             piece = tok.decode(t)
             res = detector.append(t, piece)
             delta = detector.get_delta()

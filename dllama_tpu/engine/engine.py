@@ -156,6 +156,10 @@ class InferenceEngine:
                                moe_impl=moe_impl, last_only=last_only)
 
         donate = (1,) if donate_cache else ()
+        self._donate_cache = donate_cache
+        self._fwd = fwd  # speculative decoder builds on the same closure
+        self._spec_decoders: dict = {}
+        self._spec_h = None  # (device h, pos, cur): chunked-call history reuse
         self._step = jax.jit(partial(self._step_impl, fwd), donate_argnums=donate)
         self._decode_n = jax.jit(
             partial(self._decode_n_impl, fwd),
@@ -342,6 +346,61 @@ class InferenceEngine:
         self.pos += n
         return np.asarray(toks)
 
+    def decode_spec_greedy_n(self, history, token: int, n: int, k: int = 8,
+                             ngram: int = 2) -> np.ndarray:
+        """n exact-greedy tokens via prompt-lookup speculative decoding
+        (engine/speculative.py): up to k tokens drafted from the sequence's
+        own n-gram statistics are verified per forward, so repetitive text
+        decodes several tokens per weight sweep. Output is bit-identical to
+        decode_greedy_n; only the forward count changes.
+
+        ``history``: the tokens already FED, MOST RECENT last — the full
+        prompt+continuation, or any suffix of it (a chat turn's delta: tokens
+        at earlier positions are marked unknown and simply can't be drafted
+        from). ``token``: the last sampled, not-yet-fed token. B=1 engines
+        only. self._spec_stats records {emitted, cycles} of the last call
+        (emitted/cycles = realized speedup). Consecutive calls that continue
+        exactly where the last one stopped reuse the on-device history — no
+        per-chunk host rebuild (generate's chunked loop hits this path)."""
+        assert self.batch == 1, "speculative decode drives a single sequence"
+        if self.pos + n > self.seq_len:
+            raise ValueError(f"position {self.pos}+{n} exceeds seq_len {self.seq_len}")
+        key = (k, ngram)
+        if key not in self._spec_decoders:
+            from dllama_tpu.engine.speculative import make_spec_decode
+
+            self._spec_decoders[key] = make_spec_decode(
+                self._fwd, self.seq_len, k, ngram, donate=self._donate_cache
+            )
+        cached = self._spec_h
+        if cached is not None and cached[1] == self.pos and cached[2] == token:
+            h = cached[0]  # continue the device-resident history
+        else:
+            hist = np.asarray(history, np.int32).reshape(-1)
+            if hist.shape[0] > self.pos:
+                raise ValueError(f"history length {hist.shape[0]} > pos {self.pos}")
+            # unknown earlier positions hold -1: no real token id equals -1,
+            # so the n-gram matcher can never draft across the unknown region
+            h = np.full(self.seq_len + 1, -1, np.int32)
+            h[self.pos - hist.shape[0] : self.pos] = hist
+            h[self.pos] = token
+            h = jnp.asarray(h)
+        out, cnt, cyc, self.cache, h_out, pos = self._spec_decoders[key](
+            self.params, self.cache, h, jnp.int32(token),
+            jnp.int32(self.pos), self.rope_cache, n,
+        )
+        cnt = int(cnt)
+        m = min(n, cnt)
+        toks = np.asarray(out)[:m]
+        # overshoot rewind: emitted tokens beyond n were fed rows we do not
+        # keep (same stale-row invariant as generate's mid-chunk rewind).
+        # h_out stays valid for the rewound position: index pos+m holds
+        # out[m-1], the new unfed token.
+        self.pos = int(pos) - (cnt - m)
+        self._spec_stats = {"emitted": cnt, "cycles": int(cyc)}
+        self._spec_h = (h_out, self.pos, int(toks[-1])) if m else None
+        return toks
+
     def decode_sample_n(self, token: np.ndarray, n: int, sampler: Sampler) -> np.ndarray:
         """Fused n-step sampled decode on device; returns tokens [n, B].
         Advances the sampler's PRNG key once per call."""
@@ -372,6 +431,7 @@ class InferenceEngine:
         stop_fn: Callable[[int], bool] | None = None,
         stats: GenerationStats | None = None,
         chunk: int = 8,
+        spec: int = 0,
     ) -> Iterator[int]:
         """Host generation loop: prefill the prompt, then decode in fused
         device chunks of up to `chunk` tokens (sampling included on device —
@@ -380,8 +440,14 @@ class InferenceEngine:
         or when `stop_fn(token)` returns True. On an early stop mid-chunk the
         engine position is rewound so the KV cache stays prefix-consistent
         (cache rows past pos are masked, so over-decoded rows are harmless).
+
+        ``spec`` > 0 enables prompt-lookup speculative decoding with that
+        draft length for GREEDY runs (temperature 0) — bit-identical output,
+        fewer forwards on repetitive text (decode_spec_greedy_n); sampled
+        runs ignore it.
         """
         assert self.batch == 1, "generate() drives a single sequence; use step() for batches"
+        use_spec = spec > 0 and sampler.temperature == 0.0
         t0 = time.perf_counter()
         logits = self.prefill(np.asarray([prompt_tokens], dtype=np.int32))
         token = int(sampler(logits)[0])
@@ -391,6 +457,7 @@ class InferenceEngine:
             stats.prefill_tokens += len(prompt_tokens)
             stats.prefill_s += t1 - t0
 
+        fed = list(prompt_tokens) if use_spec else None
         produced = 0
         yield token
         produced += 1
@@ -400,7 +467,19 @@ class InferenceEngine:
             c = min(chunk, max_tokens - produced, self.seq_len - self.pos)
             start_pos = self.pos
             t2 = time.perf_counter()
-            toks = self.decode_sample_n(np.array([[token]]), c, sampler)
+            if use_spec:
+                if self.pos + c + spec + 1 > self.seq_len:
+                    use_spec = False  # no head-room for a draft window
+                    toks = self.decode_sample_n(np.array([[token]]), c, sampler)
+                else:
+                    flat = self.decode_spec_greedy_n(fed, token, c, k=spec)
+                    c = len(flat)
+                    if c == 0:
+                        break
+                    fed.extend([token] + [int(t) for t in flat[:-1]])
+                    toks = flat[:, None]
+            else:
+                toks = self.decode_sample_n(np.array([[token]]), c, sampler)
             if stats is not None:
                 stats.decode_tokens += c
                 stats.decode_s += time.perf_counter() - t2

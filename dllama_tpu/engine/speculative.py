@@ -1,0 +1,107 @@
+"""Prompt-lookup speculative decoding: n-gram drafting + exact greedy
+verification, fully on device.
+
+The reference decodes strictly one token per forward (dllama.cpp:69-88).
+On TPU a decode forward is HBM-bound — streaming the weights for ONE token
+costs nearly the same as for k+1 — so verifying k drafted tokens in a
+single (k+1)-wide forward is almost free, and every accepted draft
+multiplies tok/s. Drafts come from the sequence itself ("prompt lookup":
+continue the most recent occurrence of the trailing n-gram), so no draft
+model is needed, and the output is bit-identical to plain greedy decoding:
+every emitted token is the model's argmax — speculation only changes how
+many forwards it takes to produce them.
+
+TPU-native end to end:
+* propose — vectorized n-gram match over the on-device token history (no
+  gather loops, one masked-iota max + dynamic_slice);
+* verify — one (k+1)-wide forward through the SAME ``fwd`` closure the
+  engine compiled (Pallas kernels, KV writes, causal masks unchanged; the
+  prefill-shaped path handles T=k+1 natively);
+* accept — cumprod over the draft/argmax agreement prefix;
+* the cycle loop is a ``lax.while_loop`` carried on device — zero host
+  round-trips until n tokens are ready.
+
+Rejected drafts leave stale KV rows past the live position; attention masks
+rows ``> pos`` so they are never read and are overwritten when those
+positions are really decoded — the same invariant behind the engine's
+mid-chunk rewind (engine.generate).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def propose_ngram(h: jax.Array, length: jax.Array, k: int, ngram: int):
+    """Draft k tokens by continuing the most recent earlier occurrence of the
+    trailing `ngram` tokens of ``h[:length]``.
+
+    h: i32[S+1] token-at-position buffer (position i holds the sequence's
+    i-th token for i < length). Returns (draft i32[k], found bool). With no
+    match the draft is an arbitrary in-range window — harmless, because
+    verification only ever emits argmax tokens; a bad draft just means
+    a = 0 accepted.
+    """
+    s = h.shape[0]
+    idx = jnp.arange(s, dtype=jnp.int32)
+    # candidate j = index of the ngram's LAST token in an earlier occurrence:
+    # h[j - d] == h[length - 1 - d] for d in 0..ngram-1, and j <= length - 2
+    # (strictly earlier). j >= ngram - 1 keeps the roll from wrapping.
+    cond = (idx >= ngram - 1) & (idx <= length - 2)
+    for d in range(ngram):
+        tail = h[jnp.maximum(length - 1 - d, 0)]
+        cond &= jnp.roll(h, d) == tail
+    j = jnp.max(jnp.where(cond, idx, -1))
+    found = j >= 0
+    j = jnp.clip(j, 0, s - k - 1)
+    return jax.lax.dynamic_slice(h, (j + 1,), (k,)), found
+
+
+def make_spec_decode(fwd, seq_len: int, k: int, ngram: int = 2,
+                     donate: bool = True):
+    """Build the jittable greedy speculative decoder for one engine.
+
+    Returned fn signature (n static):
+        (params, cache, h, cur, pos, rope, n) ->
+            (out i32[n+k+1], count, cycles, cache, h, pos)
+    ``h``: i32[seq_len+1] positions filled up to and including ``pos`` (the
+    unfed ``cur`` token sits at index pos; unknown earlier positions hold -1,
+    which can never n-gram-match a real token id). Emits ``count`` tokens
+    (>= n unless the context filled first) in out[:count]; each is the exact
+    greedy continuation. ``cycles`` counts verify forwards — emitted/cycles
+    is the speculation speedup. The updated ``h`` comes back so a chunked
+    caller can thread it without host-side rebuilds.
+    """
+
+    def decode(params, cache, h, cur, pos, rope, n: int):
+        out0 = jnp.zeros((n + k + 1,), jnp.int32)
+
+        def cond_fn(carry):
+            _, _, _, pos, _, cnt, _ = carry
+            return (cnt < n) & (pos + k + 1 <= seq_len)
+
+        def body_fn(carry):
+            cache, h, cur, pos, out, cnt, cyc = carry
+            draft, _ = propose_ngram(h, pos + 1, k, ngram)
+            toks = jnp.concatenate([cur[None], draft])[None]  # [1, k+1]
+            logits, cache = fwd(params, cache, toks, pos, rope, last_only=False)
+            g = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)  # [k+1]
+            # longest draft prefix the model agrees with; g[a] is the bonus
+            # token sampled after the last accepted draft
+            a = jnp.sum(jnp.cumprod((draft == g[:k]).astype(jnp.int32)))
+            # g[:a+1] are the emitted tokens AND the tokens at positions
+            # pos+1 .. pos+a+1 (history entries past the new live position
+            # are garbage that is never read and later overwritten)
+            out = jax.lax.dynamic_update_slice(out, g, (cnt,))
+            h = jax.lax.dynamic_update_slice(h, g, (pos + 1,))
+            return (cache, h, g[a], pos + a + 1, out, cnt + a + 1, cyc + 1)
+
+        cache, h, cur, pos, out, cnt, cyc = jax.lax.while_loop(
+            cond_fn, body_fn,
+            (cache, h, cur, pos, out0, jnp.int32(0), jnp.int32(0)),
+        )
+        return out, cnt, cyc, cache, h, pos
+
+    return jax.jit(decode, static_argnums=(6,),
+                   donate_argnums=(1,) if donate else ())

@@ -1,0 +1,163 @@
+"""Prompt-lookup speculative decoding (engine/speculative.py): the invariant
+is EXACTNESS — spec decode must emit the bit-identical greedy continuation
+of plain decode_greedy_n for any input, while taking fewer forwards when the
+text is repetitive. The reference has no speculation at all (one forward per
+token, dllama.cpp:69-88); this is a capability beyond parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dllama_tpu.engine.engine import InferenceEngine
+from dllama_tpu.engine.sampling import Sampler
+from dllama_tpu.models.config import LlamaConfig
+from dllama_tpu.models.llama import random_params
+
+
+CFG = LlamaConfig(dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                  vocab_size=96, seq_len=160)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return random_params(CFG, seed=5, dtype=jnp.float32, quantize=False)
+
+
+def _greedy_ref(params, prompt, n):
+    eng = InferenceEngine(CFG, params, cache_dtype=jnp.float32)
+    logits = eng.prefill(np.asarray([prompt], np.int32))
+    first = int(np.argmax(np.asarray(logits)[0]))
+    toks = eng.decode_greedy_n(np.array([[first]]), n)
+    return first, [int(t) for t in toks[:, 0]]
+
+
+def _spec(params, prompt, n, k=6, ngram=2):
+    eng = InferenceEngine(CFG, params, cache_dtype=jnp.float32)
+    logits = eng.prefill(np.asarray([prompt], np.int32))
+    first = int(np.argmax(np.asarray(logits)[0]))
+    toks = eng.decode_spec_greedy_n(list(prompt), first, n, k=k, ngram=ngram)
+    return first, [int(t) for t in toks], eng._spec_stats, eng
+
+
+@pytest.mark.parametrize("prompt_kind", ["repetitive", "random"])
+def test_spec_matches_plain_greedy(params, prompt_kind):
+    if prompt_kind == "repetitive":
+        prompt = ([3, 7, 11, 19] * 8)[:30]
+    else:
+        prompt = list(np.random.default_rng(0).integers(1, CFG.vocab_size, 30))
+    f_ref, ref = _greedy_ref(params, prompt, 24)
+    f_spec, got, stats, _ = _spec(params, prompt, 24)
+    assert f_ref == f_spec
+    assert got == ref, f"spec diverged from greedy: {got} vs {ref}"
+    assert stats["cycles"] >= 1
+    # counting invariant: every cycle emits 1..k+1 tokens
+    assert stats["cycles"] <= stats["emitted"] <= stats["cycles"] * 7
+
+
+def test_spec_accepts_drafts_on_repetitive_text(params):
+    """A strongly periodic greedy continuation must be accepted in bulk:
+    fewer verify forwards than emitted tokens."""
+    # drive the model into its own fixed loop first, then continue it:
+    # whatever cycle greedy decode settles into IS the draftable pattern
+    prompt = [5, 9, 5, 9, 5, 9, 5, 9]
+    _, ref = _greedy_ref(params, prompt, 48)
+    _, got, stats, _ = _spec(params, prompt, 48, k=6)
+    assert got == ref
+    # greedy tiny-model continuations settle into short cycles; the lookup
+    # must exploit that (strictly fewer forwards than tokens)
+    assert stats["cycles"] < stats["emitted"], stats
+
+
+def test_spec_position_accounting_allows_continuation(params):
+    """After a spec call the engine position must equal plain-greedy's, and
+    further NORMAL decoding must continue the exact same stream."""
+    prompt = ([2, 4, 8] * 6)[:16]
+    f, ref = _greedy_ref(params, prompt, 30)
+    f2, got, _, eng = _spec(params, prompt, 18, k=4)
+    assert ref[:18] == got
+    assert eng.pos == len(prompt) + 18
+    more = eng.decode_greedy_n(np.array([[got[-1]]]), 12)
+    assert [int(t) for t in more[:, 0]] == ref[18:30]
+
+
+def test_spec_respects_seq_len_boundary(params):
+    """Close to the context end the decoder stops early (no draft head-room
+    crash) and returns what it could emit."""
+    prompt = [1, 2, 3] * 10
+    eng = InferenceEngine(CFG, params, cache_dtype=jnp.float32)
+    logits = eng.prefill(np.asarray([prompt], np.int32))
+    first = int(np.argmax(np.asarray(logits)[0]))
+    room = CFG.seq_len - eng.pos
+    toks = eng.decode_spec_greedy_n(list(prompt), first, room - 2, k=8)
+    # while_loop exit: pos + k + 1 <= seq_len — emission may fall short of
+    # the request near the wall but never overruns it
+    assert eng.pos <= CFG.seq_len
+    assert len(toks) <= room - 2
+
+
+def test_generate_spec_stream_identical(params):
+    """The public generate() loop with spec=K yields the identical token
+    stream to spec=0 at temperature 0 (including chunking/rewind edges)."""
+    prompt = ([3, 7, 11] * 8)[:20]
+
+    def run(spec):
+        eng = InferenceEngine(CFG, params, cache_dtype=jnp.float32)
+        return list(eng.generate(prompt, 33, Sampler(0.0, 0.9, 1), chunk=8,
+                                 spec=spec))
+
+    assert run(6) == run(0)
+
+
+def test_spec_delta_history_multi_turn(params):
+    """Chat-style reuse: turn 2 prefills only the delta and hands spec only
+    the delta as history (earlier positions unknown). Must match the plain
+    greedy engine fed the identical stream — and not crash on the length
+    check (ADVICE-style regression for the cli chat path)."""
+    t1 = [3, 7, 11] * 4
+    delta = [5, 9, 5, 9]
+
+    def turn(eng, toks, n, spec):
+        logits = eng.prefill(np.asarray([toks], np.int32))
+        first = int(np.argmax(np.asarray(logits)[0]))
+        if spec:
+            return [first] + [int(t) for t in eng.decode_spec_greedy_n(toks, first, n, k=4)]
+        return [first] + [int(t) for t in eng.decode_greedy_n(np.array([[first]]), n)[:, 0]]
+
+    eng_s = InferenceEngine(CFG, params, cache_dtype=jnp.float32)
+    eng_r = InferenceEngine(CFG, params, cache_dtype=jnp.float32)
+    assert turn(eng_s, t1, 8, True) == turn(eng_r, t1, 8, False)
+    assert turn(eng_s, delta, 8, True) == turn(eng_r, delta, 8, False)
+    assert eng_s.pos == eng_r.pos
+
+
+def test_spec_honors_donate_cache_false(params):
+    """donate_cache=False engines keep the caller's cache buffer alive
+    through spec calls (same contract as every other jitted step)."""
+    eng = InferenceEngine(CFG, params, cache_dtype=jnp.float32, donate_cache=False)
+    logits = eng.prefill(np.asarray([[1, 2, 3, 4]], np.int32))
+    snapshot = eng.cache
+    first = int(np.argmax(np.asarray(logits)[0]))
+    eng.decode_spec_greedy_n([1, 2, 3, 4], first, 6, k=4)
+    _ = np.asarray(snapshot.k)  # must not raise 'Array has been deleted'
+
+
+def test_propose_ngram_finds_latest_match():
+    from dllama_tpu.engine.speculative import propose_ngram
+
+    h = jnp.asarray(np.array([9, 4, 7, 1, 2, 4, 7, 3, 5, 4, 7, 0, 0, 0, 0, 0],
+                             np.int32))
+    # sequence known up to index 10 (L=11), trailing bigram (4, 7): matches
+    # end at j=2 and j=6; the LATEST (j=6) wins -> draft continues with h[7:]
+    draft, found = propose_ngram(h, jnp.int32(11), k=3, ngram=2)
+    assert bool(found)
+    assert [int(x) for x in draft] == [3, 5, 4]
+
+
+def test_propose_ngram_no_match_is_safe():
+    from dllama_tpu.engine.speculative import propose_ngram
+
+    h = jnp.asarray(np.arange(16, dtype=np.int32))
+    draft, found = propose_ngram(h, jnp.int32(12), k=4, ngram=2)
+    assert not bool(found)
+    assert draft.shape == (4,)  # arbitrary but in-range window
