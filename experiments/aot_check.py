@@ -178,8 +178,33 @@ def full_step_case(topo):
         finally:
             mmod.INTERPRET = None
 
-    return [("FULL 1b decode step (scan+flash+blockdot)", step,
-             (params, cache, tokens, pos, rope), True)]
+    # the speculative decoder: while_loop(propose + (k+1)-wide verify) over
+    # the same kernels — m=9 blockdot, 9-row flash fold, scan-in-while_loop
+    from dllama_tpu.engine.speculative import make_spec_decode
+
+    def spec_fwd(params, cache, tokens, pos, rope, last_only=False):
+        mmod.INTERPRET = False
+        try:
+            return forward(cfg, params, tokens, pos, cache, rope,
+                           partial(flash_gqa_attention, interpret=False),
+                           mm=partial(matmul, backend="pallas"),
+                           last_only=last_only)
+        finally:
+            mmod.INTERPRET = None
+
+    spec = make_spec_decode(spec_fwd, cfg.seq_len, k=8, donate=False)
+    h = A((cfg.seq_len + 1,), jnp.int32)
+    cur = A((), jnp.int32)
+
+    def spec_step(params, cache, h, cur, pos, rope):
+        return spec(params, cache, h, cur, pos, rope, 32)
+
+    return [
+        ("FULL 1b decode step (scan+flash+blockdot)", step,
+         (params, cache, tokens, pos, rope), True),
+        ("FULL 1b speculative decode (k=8 while_loop)", spec_step,
+         (params, cache, h, cur, pos, rope), True),
+    ]
 
 
 def sharded_cases(topo):
