@@ -30,6 +30,8 @@ Env knobs:
   BENCH_FUSE           '1': fused wqkv/w13 launches (unsharded engines)
   BENCH_BUDGET_S       total wall-clock budget for the parent (default 840 —
                        fits under the driver's `timeout 900 python bench.py`)
+  BENCH_CACHE          bf16 (default) | f8 — KV cache element type; f8
+                       halves cache bytes (the batched-sweep bottleneck)
   BENCH_FORCE_CPU      '1': skip the TPU entirely (CI smoke)
 """
 
@@ -182,6 +184,15 @@ LABELS = {"tiny": "tiny", "1b": "Llama-3.2-1B", "8b": "Llama-3.1-8B",
           "8b_long": "Llama-8B-8k"}
 
 
+def _cache_dtype():
+    import jax.numpy as jnp
+
+    val = os.environ.get("BENCH_CACHE", "bf16")
+    if val not in ("bf16", "f8"):
+        raise SystemExit(f"BENCH_CACHE must be bf16|f8, got {val!r}")
+    return jnp.float8_e4m3fn if val == "f8" else jnp.bfloat16
+
+
 def bench_engine(cfg, params, n_decode, unroll, prompt_len=512, kernels=None,
                  attn_impl="auto"):
     """Batch=1 prefill + fused-decode timings for one preset. Returns dict."""
@@ -192,7 +203,7 @@ def bench_engine(cfg, params, n_decode, unroll, prompt_len=512, kernels=None,
 
     import jax.numpy as jnp
 
-    eng = InferenceEngine(cfg, params, cache_dtype=jnp.bfloat16,
+    eng = InferenceEngine(cfg, params, cache_dtype=_cache_dtype(),
                           max_prefill_chunk=512, layer_unroll=unroll,
                           attn_impl=attn_impl,
                           fuse_weights=os.environ.get("BENCH_FUSE") == "1",
@@ -277,7 +288,7 @@ def bench_batched(cfg, params, slots, n_decode=64, kernels=None):
 
     import jax.numpy as jnp
 
-    eng = BatchEngine(cfg, params, n_slots=slots, cache_dtype=jnp.bfloat16,
+    eng = BatchEngine(cfg, params, n_slots=slots, cache_dtype=_cache_dtype(),
                       max_prefill_chunk=64,
                       fuse_weights=os.environ.get("BENCH_FUSE") == "1",
                       kernels=kernels or os.environ.get("BENCH_KERNELS", "auto"))
@@ -658,6 +669,7 @@ def worker():
         "setup_s": round(setup_s, 1),
         "unroll": unroll_env,
         "kernels": os.environ.get("BENCH_KERNELS", "auto"),
+        "cache_dtype": os.environ.get("BENCH_CACHE", "bf16"),
         "q40_style": q40_style,
         "xla_prefill_m": int(xla_prefill_m) if xla_prefill_m else None,
         "moe": moe,

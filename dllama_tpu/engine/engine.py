@@ -285,12 +285,17 @@ class InferenceEngine:
         durable version of that capability."""
         import numpy as np
 
+        k = np.asarray(self.cache.k)
+        v = np.asarray(self.cache.v)
+        # npz cannot represent ml_dtypes elements (an f8 cache loads back as
+        # raw void): persist the BYTES plus the dtype name and re-view on load
         np.savez_compressed(
             path,
             fingerprint=self._session_fingerprint(),
+            cache_dtype=str(k.dtype),
             pos=self.pos,
-            k=np.asarray(self.cache.k),
-            v=np.asarray(self.cache.v),
+            k=k.view(np.uint8),
+            v=v.view(np.uint8),
         )
 
     def load_session(self, path: str) -> None:
@@ -306,7 +311,20 @@ class InferenceEngine:
                     f"session file does not match this engine: {fp!r} != "
                     f"{self._session_fingerprint()!r}"
                 )
-            cache = KVCache(jnp.asarray(data["k"]), jnp.asarray(data["v"]))
+            if "cache_dtype" in data:  # bytes + dtype-name format
+                dt = jnp.dtype(str(data["cache_dtype"]))
+                k = data["k"].view(dt)
+                v = data["v"].view(dt)
+            else:
+                # legacy format stored typed arrays directly; npz turns
+                # ml_dtypes elements (bf16) into raw void — re-view them as
+                # the engine dtype (the fingerprint already pinned it)
+                k, v = data["k"], data["v"]
+                if k.dtype.kind == "V":
+                    dt = self.cache.k.dtype
+                    k = k.view(np.uint8).view(dt).reshape(self.cache.k.shape)
+                    v = v.view(np.uint8).view(dt).reshape(self.cache.v.shape)
+            cache = KVCache(jnp.asarray(k), jnp.asarray(v))
             if self.shardings is not None:
                 cache = self.shardings.put_cache(cache)
             self.cache = cache

@@ -95,9 +95,9 @@ def cases(full: bool):
     # flash attention: decode (t=1, group=4 folded+pad) and prefill shapes
     from dllama_tpu.ops.pallas.flash_attention import flash_gqa_attention
 
-    def flash(q_shape, s_len):
+    def flash(q_shape, s_len, kv_dtype=jnp.bfloat16):
         q = S(q_shape, jnp.bfloat16)
-        kv = S((1, 8, s_len, 128), jnp.bfloat16)
+        kv = S((1, 8, s_len, 128), kv_dtype)
         return (lambda q, k, v: flash_gqa_attention(q, k, v, jnp.int32(7)),
                 (q, kv, kv))
 
@@ -107,6 +107,9 @@ def cases(full: bool):
     out.append(("flash prefill t=256 S=1024", fn, args, True))
     fn, args = flash((1, 1, 32, 128), 8192)
     out.append(("flash decode t=1 S=8192", fn, args, True))
+    # f8 (e4m3) KV cache variant (--cache-dtype f8): half the cache DMA
+    fn, args = flash((1, 1, 32, 128), 1024, jnp.float8_e4m3fn)
+    out.append(("flash decode f8 KV cache", fn, args, True))
 
     from dllama_tpu.ops.pallas.rms_norm import rms_norm as prms
 

@@ -287,6 +287,83 @@ def test_nucleus_within_candidates_truncates():
     assert toks <= {7}  # topp=0.5 keeps only the crossing token
 
 
+def test_f8_kv_cache_numerics_and_session():
+    """f8 (e4m3) KV cache: halves cache bytes at a small accuracy cost. The
+    engine path must run end-to-end, stay numerically close to the bf16
+    cache on prefill logits, and round-trip through save/load_session."""
+    import numpy as np
+
+    from dllama_tpu.models.llama import random_params
+
+    cfg = LlamaConfig(dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                      vocab_size=96, seq_len=64)
+    params = random_params(cfg, seed=4, dtype=jnp.float32, quantize=False)
+    prompt = np.array([[1, 5, 9, 13, 17, 21]], np.int32)
+    l16 = np.asarray(InferenceEngine(cfg, params, cache_dtype=jnp.bfloat16).prefill(prompt), np.float32)
+    eng8 = InferenceEngine(cfg, params, cache_dtype=jnp.float8_e4m3fn)
+    l8 = np.asarray(eng8.prefill(prompt), np.float32)
+    cos = float((l16 * l8).sum() / (np.linalg.norm(l16) * np.linalg.norm(l8) + 1e-9))
+    assert cos > 0.98, f"f8 cache logits diverged: cos={cos}"
+    toks = eng8.decode_greedy_n(np.array([[int(np.argmax(l8))]]), 6)
+    assert toks.shape == (6, 1)
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        path = d + "/s.npz"
+        eng8.save_session(path)
+        eng8b = InferenceEngine(cfg, params, cache_dtype=jnp.float8_e4m3fn)
+        eng8b.load_session(path)
+        assert eng8b.pos == eng8.pos
+        assert eng8b.cache.k.dtype == jnp.float8_e4m3fn
+
+
+def test_load_legacy_bf16_session_format():
+    """Sessions saved by the pre-f8 format stored typed arrays directly; npz
+    degrades ml_dtypes bf16 to raw void — the loader must re-view them."""
+    import tempfile
+
+    import numpy as np
+
+    from dllama_tpu.models.llama import random_params
+
+    cfg = LlamaConfig(dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                      vocab_size=96, seq_len=64)
+    params = random_params(cfg, seed=4, dtype=jnp.float32, quantize=False)
+    eng = InferenceEngine(cfg, params, cache_dtype=jnp.bfloat16)
+    eng.prefill(np.array([[1, 2, 3]], np.int32))
+    with tempfile.TemporaryDirectory() as d:
+        path = d + "/legacy.npz"
+        np.savez_compressed(  # the old writer: typed arrays, no cache_dtype
+            path, fingerprint=eng._session_fingerprint(), pos=eng.pos,
+            k=np.asarray(eng.cache.k), v=np.asarray(eng.cache.v),
+        )
+        eng2 = InferenceEngine(cfg, params, cache_dtype=jnp.bfloat16)
+        eng2.load_session(path)
+        assert eng2.pos == eng.pos
+        np.testing.assert_array_equal(
+            np.asarray(eng2.cache.k.astype(jnp.float32)),
+            np.asarray(eng.cache.k.astype(jnp.float32)),
+        )
+
+
+def test_f8_kv_cache_batch_engine():
+    """Continuous-batching tier with the f8 cache: admission + fused decode."""
+    import numpy as np
+
+    from dllama_tpu.engine.batch import BatchEngine
+    from dllama_tpu.models.llama import random_params
+
+    cfg = LlamaConfig(dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                      vocab_size=96, seq_len=64)
+    params = random_params(cfg, seed=4, dtype=jnp.float32, quantize=False)
+    be = BatchEngine(cfg, params, n_slots=2, cache_dtype=jnp.float8_e4m3fn)
+    be.add(0, [1, 2, 3], temperature=0.0, seed=1)
+    be.add(1, [4, 5], temperature=0.0, seed=2)
+    toks = be.decode(4)
+    assert toks.shape == (4, 2)
+
+
 def test_exact_topp_escape_hatch_no_fallback():
     """NUCLEUS_K=None (--exact-topp, ADVICE r3) sorts the full vocab: a flat
     distribution that would trip the approx path's wide-nucleus fallback must
