@@ -283,16 +283,22 @@ def bench_flash_decode():
     rng = np.random.default_rng(0)
     hd = 64 if INTERPRET else 128
     s_ab = 512 if INTERPRET else 1024
-    for hq, hkv, label in ((32, 8, "group=4 (4 live rows, 4 pad)"),
-                           (64, 8, "group=8 (8 live rows, 0 pad)")):
+    for hq, hkv, kvdt, label in (
+        (32, 8, jnp.bfloat16, "group=4 (4 live rows, 4 pad)"),
+        (64, 8, jnp.bfloat16, "group=8 (8 live rows, 0 pad)"),
+        # 3. f8 KV cache (--cache-dtype f8): same shapes as row 1 at HALF the
+        #    cache bytes — if decode is cache-DMA-bound this should approach
+        #    2x row 1's time-per-byte advantage
+        (32, 8, jnp.float8_e4m3fn, "group=4 f8 KV cache"),
+    ):
         q = jnp.asarray(rng.standard_normal((1, 1, hq, hd)), jnp.bfloat16)
-        k = jnp.asarray(rng.standard_normal((1, hkv, s_ab, hd)), jnp.bfloat16)
-        v = jnp.asarray(rng.standard_normal((1, hkv, s_ab, hd)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((1, hkv, s_ab, hd)), kvdt)
+        v = jnp.asarray(rng.standard_normal((1, hkv, s_ab, hd)), kvdt)
         fn = lambda q, k, v: flash_gqa_attention(q, k, v, jnp.int32(s_ab - 2),
                                                  interpret=INTERPRET)
         try:
             t = bench(fn, (q, k, v))
-            kv_bytes = 2 * hkv * s_ab * hd * 2
+            kv_bytes = 2 * hkv * s_ab * hd * jnp.dtype(kvdt).itemsize
             print(f"flash decode {label}: {t*1e6:.0f}us ({kv_bytes/t/1e9:.0f}GB/s cache)")
         except Exception as e:
             print(f"flash decode {label}: FAILED {e!r}"[:250])
