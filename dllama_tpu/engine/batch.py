@@ -131,6 +131,7 @@ class BatchEngine:
             partial(self._decode_impl, cfg, attn_fn, self._col_fn, mm, mm_in, moe_impl),
             static_argnums=(8,), donate_argnums=(1,),
         )
+        self._copy_rows = jax.jit(self._copy_rows_impl, donate_argnums=(0,))
 
     # ------------------------------------------------------------- jitted fns
 
@@ -186,6 +187,46 @@ class BatchEngine:
             body, (tokens, cache, pos_vec, keys), None, length=n
         )
         return toks, cache, keys
+
+    @staticmethod
+    def _copy_rows_impl(cache, src, dst, rows):
+        """Copy the first `rows` cache rows of slot src into slot dst (both
+        k and v, all layers/heads). Static shapes: the whole [S] row axis is
+        masked rather than sliced, so one compile serves every prefix
+        length; src/dst/rows are traced scalars."""
+
+        def one(buf):  # [L, B, H, S, hd]
+            s = buf.shape[3]
+            src_rows = jax.lax.dynamic_index_in_dim(buf, src, axis=1, keepdims=False)
+            dst_rows = jax.lax.dynamic_index_in_dim(buf, dst, axis=1, keepdims=False)
+            mask = (jnp.arange(s) < rows)[None, None, :, None]
+            return jax.lax.dynamic_update_index_in_dim(
+                buf, jnp.where(mask, src_rows, dst_rows), dst, axis=1
+            )
+
+        return KVCache(one(cache.k), one(cache.v))
+
+    @property
+    def supports_cross_slot_copy(self) -> bool:
+        """False on dp meshes: the batch axis is sharded, so a slot-to-slot
+        row copy would gather across shards."""
+        return self._use_slot_prefill
+
+    def copy_prefix_rows(self, src_slot: int, dst_slot: int, rows: int) -> None:
+        """Cross-slot prefix share (the serving tier's RadixAttention-lite):
+        make dst_slot's first `rows` KV rows identical to src_slot's, so an
+        admission into dst can start_pos=rows off ANOTHER slot's cached
+        prefix — e.g. every user of a serving deployment shares the system
+        prompt's KV without recomputing it per slot. One fused on-device
+        copy; no recompiles across prefix lengths."""
+        if not self.supports_cross_slot_copy:
+            raise ValueError("cross-slot copy crosses dp shards; not supported "
+                             "on batch-sharded meshes")
+        assert not self.active[dst_slot], f"dst slot {dst_slot} is busy"
+        self.cache = self._copy_rows(
+            self.cache, jnp.int32(src_slot), jnp.int32(dst_slot), jnp.int32(rows)
+        )
+        self.pos[dst_slot] = rows
 
     # ------------------------------------------------------------------- api
 

@@ -198,29 +198,53 @@ class Scheduler:
             return True
         return False
 
-    def _pick_slot(self, prompt: list[int]) -> tuple[int | None, int]:
-        """(slot, reusable_prefix_len): the idle slot whose cached token
-        history shares the longest full prefix with `prompt`; with no match,
-        the idle slot holding the least cached state (evict the cheapest).
-        Slots reserved by in-flight admissions are not idle."""
+    def _pick_slot(self, prompt: list[int]) -> tuple[int | None, int, int | None]:
+        """(slot, reusable_prefix_len, donor): the idle slot whose cached
+        token history shares the longest full prefix with `prompt`. When a
+        DIFFERENT slot (idle or actively decoding) holds a longer matching
+        prefix, the cheapest idle slot is chosen and `donor` names the slot
+        whose KV rows should be copied in first (cross-slot prefix share —
+        e.g. a common system prompt cached once serves every slot). Slots
+        reserved by in-flight admissions are neither destinations nor donors
+        (their rows are mid-overwrite)."""
         reserved = {adm.slot for _, adm, _ in self._inflight}
         idle = [
             s for s in range(self.engine.n_slots)
             if not self.engine.active[s] and s not in reserved
         ]
         if not idle:
-            return None, 0
-        best, best_len = None, 0
-        for s in idle:
+            return None, 0, None
+
+        import numpy as np
+
+        def shared(s: int) -> int:
             cached = self.slot_tokens.get(s, [])
-            # reusable rows = longest shared prefix, capped so at least one
-            # prompt token remains to prefill (stale rows past it are masked)
+            # reusable rows = LONGEST COMMON PREFIX (not all-or-nothing: a
+            # shared system prompt with a divergent tail still reuses the
+            # common part), capped so at least one prompt token remains to
+            # prefill (stale rows past it are masked); an ACTIVE donor's
+            # last emitted token has no KV row yet
             n = min(len(cached), len(prompt) - 1)
-            if n > best_len and prompt[:n] == cached[:n]:
-                best, best_len = s, n
-        if best is not None:
-            return best, best_len
-        return min(idle, key=lambda s: len(self.slot_tokens.get(s, []))), 0
+            if self.engine.active[s]:
+                n = min(n, len(cached) - 1)
+            if n <= 0:
+                return 0
+            neq = np.nonzero(np.asarray(prompt[:n]) != np.asarray(cached[:n]))[0]
+            return int(neq[0]) if neq.size else n
+
+        # cross-slot donors need the engine's slot-copy primitive (dp meshes
+        # shard the batch axis, where donor search stays within idle slots)
+        cross_ok = getattr(self.engine, "supports_cross_slot_copy", False)
+        donors = [s for s in range(self.engine.n_slots) if s not in reserved] if cross_ok else idle
+        lcp = {s: shared(s) for s in donors}
+        best_idle = max(idle, key=lcp.__getitem__)
+        best_any = max(donors, key=lcp.__getitem__)
+        if lcp[best_any] > lcp[best_idle]:
+            dst = min(idle, key=lambda s: len(self.slot_tokens.get(s, [])))
+            return dst, lcp[best_any], best_any
+        if lcp[best_idle] > 0:
+            return best_idle, lcp[best_idle], None
+        return min(idle, key=lambda s: len(self.slot_tokens.get(s, []))), 0, None
 
     def _admit_starts(self) -> None:
         """Pop pending requests into in-flight admissions while slots allow."""
@@ -236,8 +260,22 @@ class Scheduler:
                 req.finish_reason = "cancelled"
                 req.out.put(_END)
                 continue
-            slot, reuse = self._pick_slot(req.prompt)
+            slot, reuse, donor = self._pick_slot(req.prompt)
+            if len(req.prompt) >= self.engine.seq_len:
+                # reject BEFORE any donor copy: a hopeless admission must not
+                # evict the destination slot's cached prefix
+                req.out.put(ValueError(
+                    f"prompt ({len(req.prompt)}) exceeds seq_len {self.engine.seq_len}"
+                ))
+                continue
             try:
+                if donor is not None and donor != slot and reuse > 0:
+                    # cross-slot share: materialize the donor's prefix rows
+                    # in the destination before the delta prefill
+                    self.engine.copy_prefix_rows(donor, slot, reuse)
+                    self.slot_tokens[slot] = list(
+                        self.slot_tokens.get(donor, [])[:reuse]
+                    )
                 adm = self.engine.add_begin(slot, req.prompt[reuse:], start_pos=reuse)
             except Exception as e:  # bad request (too long, …) — fail just this one
                 log.exception("admission rejected")

@@ -139,6 +139,84 @@ def test_scheduler_latency_metrics():
         sched.shutdown()
 
 
+def test_copy_prefix_rows_engine_level():
+    """Deterministic coverage of the cross-slot KV copy itself (the
+    scheduler test below can satisfy its reuse assertion through same-slot
+    matching when request A finishes early): copy an ACTIVE slot's prefix
+    rows into another slot, delta-prefill there, and match a cold engine."""
+    import jax.numpy as jnp
+
+    from dllama_tpu.engine.batch import BatchEngine
+    from dllama_tpu.models.config import LlamaConfig
+    from dllama_tpu.models.llama import random_params
+
+    cfg = LlamaConfig(dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                      vocab_size=96, seq_len=96)
+    params = random_params(cfg, seed=2, dtype=jnp.float32, quantize=False)
+    system = list(range(1, 21))
+    delta = [40, 41, 42]
+
+    eng = BatchEngine(cfg, params, n_slots=2, cache_dtype=jnp.float32)
+    assert eng.supports_cross_slot_copy
+    eng.add(0, system + [30], temperature=0.0, seed=0)  # slot 0 active donor
+    eng.copy_prefix_rows(0, 1, len(system))
+    f_shared = eng.add(1, delta, temperature=0.0, start_pos=len(system), seed=1)
+    toks_shared = eng.decode(6)[:, 1]
+
+    cold = BatchEngine(cfg, params, n_slots=2, cache_dtype=jnp.float32)
+    cold.add(0, system + [30], temperature=0.0, seed=0)  # same batch-mate
+    f_cold = cold.add(1, system + delta, temperature=0.0, seed=1)
+    toks_cold = cold.decode(6)[:, 1]
+    assert f_shared == f_cold
+    assert [int(t) for t in toks_shared] == [int(t) for t in toks_cold]
+
+
+def test_cross_slot_prefix_share():
+    """Two requests with a common system prompt on DIFFERENT slots: the
+    second reuses the first slot's KV rows (cross-slot copy when A is still
+    decoding, same-slot LCP reuse if A finished first — both count) — and
+    its output is identical to a cold run."""
+    import jax.numpy as jnp
+
+    from dllama_tpu.engine.batch import BatchEngine
+    from dllama_tpu.models.config import LlamaConfig
+    from dllama_tpu.models.llama import random_params
+    from dllama_tpu.serve.scheduler import Scheduler
+
+    cfg = LlamaConfig(dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                      vocab_size=96, seq_len=128)
+    params = random_params(cfg, seed=2, dtype=jnp.float32, quantize=False)
+    system = list(range(1, 25))  # 24-token shared "system prompt"
+    p_a = system + [30, 31]
+    p_b = system + [40, 41]
+
+    eng = BatchEngine(cfg, params, n_slots=3, cache_dtype=jnp.float32)
+    sched = Scheduler(eng, chunk=2)
+    try:
+        r_a = sched.submit(p_a, 0.0, 0.9, 24, eos_ids=frozenset(), seed=0)
+        it = r_a.tokens()
+        first_a = [next(it), next(it)]  # A is decoding on its slot
+        r_b = sched.submit(p_b, 0.0, 0.9, 8, eos_ids=frozenset(), seed=0)
+        got_b = list(r_b.tokens())
+        got_a = first_a + list(it)
+        # B's admission must have reused the shared system prefix from A's
+        # slot (A was still active: reuse len(system) tokens via copy)
+        assert sched.reused_prefix_tokens >= len(system)
+    finally:
+        sched.shutdown()
+
+    # cold reference for B
+    eng2 = BatchEngine(cfg, params, n_slots=3, cache_dtype=jnp.float32)
+    sched2 = Scheduler(eng2, chunk=2)
+    try:
+        cold_b = list(sched2.submit(p_b, 0.0, 0.9, 8, eos_ids=frozenset(), seed=0).tokens())
+        cold_a = list(sched2.submit(p_a, 0.0, 0.9, 24, eos_ids=frozenset(), seed=0).tokens())
+    finally:
+        sched2.shutdown()
+    assert got_b == cold_b, "cross-slot shared prefix changed B's output"
+    assert got_a == cold_a
+
+
 def test_interleaved_admission_matches_synchronous_and_records_stalls():
     """A long prompt joining a running batch is admitted one prefill chunk
     per decode chunk (VERDICT r3 #4): tokens must be identical to the legacy
