@@ -287,6 +287,7 @@ def cmd_serve(args) -> int:
         n_slots=args.slots,
         default_temperature=args.temperature,
         default_topp=args.topp,
+        spec=args.spec,
         default_seed=args.seed,
     )
 

@@ -67,7 +67,7 @@ class PrefixCache:
 
 class ApiServer:
     def __init__(self, loaded, default_temperature=0.8, default_topp=0.9, default_seed=None,
-                 scheduler=None):
+                 scheduler=None, spec: int = 0):
         self.engine = loaded.engine
         self.tokenizer = loaded.tokenizer
         self.config = loaded.config
@@ -79,6 +79,9 @@ class ApiServer:
             temperature=default_temperature, topp=default_topp, seed=default_seed
         )
         self.cache = PrefixCache()
+        # prompt-lookup speculative decoding for greedy single-engine serving
+        # (generate() ignores it for sampled requests and the batched tier)
+        self.spec = int(spec)
         self.lock = threading.Lock()
         self.model_name = "dllama-tpu"
         # continuous-batching tier: a serve/scheduler.Scheduler over a
@@ -134,7 +137,8 @@ class ApiServer:
             parts: list[str] = []
             n_generated = 0
             finish = "length"
-            for t in self.engine.generate(prompt_tokens, budget, sampler):
+            for t in self.engine.generate(prompt_tokens, budget, sampler,
+                                          spec=self.spec):
                 n_generated += 1
                 piece = self.tokenizer.decode(t)
                 res = detector.append(t, piece)
@@ -380,6 +384,7 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
         default_topp=defaults.get("default_topp", 0.9),
         default_seed=defaults.get("default_seed"),
         scheduler=scheduler,
+        spec=defaults.get("spec", 0),
     )
     handler = type("Handler", (_Handler,), {"api": api})
     httpd = ThreadingHTTPServer((host, port), handler)

@@ -142,6 +142,36 @@ def test_spec_honors_donate_cache_false(params):
     _ = np.asarray(snapshot.k)  # must not raise 'Array has been deleted'
 
 
+def test_serve_spec_identical_completions(tmp_path):
+    """The single-engine HTTP tier with spec=K streams the identical greedy
+    completion as spec=0 (the serve wiring of --spec)."""
+    import json
+    import threading
+
+    from tests.test_serve import make_tiny_files, post
+
+    from dllama_tpu.engine.loader import load_model
+    from dllama_tpu.serve.api import make_server
+
+    mpath, tpath, _ = make_tiny_files(tmp_path)
+    body = {"messages": [{"role": "user", "content": "abc abc abc"}],
+            "max_tokens": 12, "temperature": 0.0}
+
+    def run(spec):
+        loaded = load_model(mpath, tpath, mesh=None)
+        httpd, api = make_server(loaded, host="127.0.0.1", port=0, spec=spec)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            status, data = post(httpd.server_address[1], "/v1/chat/completions", body)
+            assert status == 200
+            return json.loads(data)["choices"][0]["message"]["content"]
+        finally:
+            httpd.shutdown()
+
+    assert run(6) == run(0)
+
+
 def test_propose_ngram_finds_latest_match():
     from dllama_tpu.engine.speculative import propose_ngram
 
