@@ -281,7 +281,7 @@ def bench_engine(cfg, params, n_decode, unroll, prompt_len=512, kernels=None,
     return out
 
 
-def bench_batched(cfg, params, slots, n_decode=64, kernels=None):
+def bench_batched(cfg, params, slots, n_decode=64, kernels=None, cache_dtype=None):
     """Aggregate decode tok/s/chip from the continuous-batching tier with all
     `slots` sequences decoding together (BatchEngine, per-slot positions)."""
     import numpy as np
@@ -290,7 +290,8 @@ def bench_batched(cfg, params, slots, n_decode=64, kernels=None):
 
     import jax.numpy as jnp
 
-    eng = BatchEngine(cfg, params, n_slots=slots, cache_dtype=_cache_dtype(),
+    eng = BatchEngine(cfg, params, n_slots=slots,
+                      cache_dtype=cache_dtype or _cache_dtype(),
                       max_prefill_chunk=64,
                       fuse_weights=os.environ.get("BENCH_FUSE") == "1",
                       kernels=kernels or os.environ.get("BENCH_KERNELS", "auto"))
@@ -470,6 +471,8 @@ def worker():
         if run_presets[-1] != "tiny" and PRESETS[run_presets[-1]]["seq_len"] < 4096
         else None
     )
+    if os.environ.get("BENCH_SWEEP_TINY") == "1" and "tiny" in run_presets:
+        sweep_on = "tiny"  # CI-only: exercise the sweep path at toy size
 
     for name in run_presets:
         if name not in PRESETS:
@@ -613,6 +616,26 @@ def worker():
                 batch_results.append(br)
                 if br["agg_tok_s"] / north > best[0]:
                     best = (br["agg_tok_s"] / north, f"{LABELS[name]} {slots}-slot serving", br["agg_tok_s"])
+            # f8-cache variant at the largest measured slot count (half the
+            # cache bytes — the sweep's bottleneck): one extra row, budget
+            # permitting, so the driver's single run captures the f8 win
+            if (os.environ.get("BENCH_CACHE", "bf16") == "bf16"
+                    and time.monotonic() < deadline - 150):
+                try:
+                    import jax.numpy as _jnp
+
+                    slots_f8 = max(s for s in slot_list)
+                    br = bench_batched(cfg, params, slots_f8,
+                                       cache_dtype=_jnp.float8_e4m3fn)
+                    br["preset"] = name
+                    br["path"] = "cache=f8"
+                    batch_results.append(br)
+                    if br["agg_tok_s"] / north > best[0]:
+                        best = (br["agg_tok_s"] / north,
+                                f"{LABELS[name]} {slots_f8}-slot serving (f8 KV)",
+                                br["agg_tok_s"])
+                except Exception as e:
+                    batch_results.append({"slots": "f8", "error": repr(e)[:200]})
         del wide_params  # params persists: the next preset may share its shapes
 
     # bytes/token is part of the benchmark contract (SURVEY.md §5.1/§6): on
