@@ -588,6 +588,7 @@ def worker():
         # batched sweep while the north-star config's params are live; skip
         # slots we no longer have budget for
         if name == sweep_on:
+            ok = []  # (slots, kern, widen) of successful bf16 rows
             for slots in slot_list:
                 if time.monotonic() > deadline - 120:
                     batch_results.append({"slots": slots, "skipped": "budget"})
@@ -604,6 +605,7 @@ def worker():
                                            slots, kernels=kern)
                         br["path"] = f"kernels={kern or 'auto'}" + (
                             " scales=f32" if widen else "")
+                        ok.append((slots, kern, widen))
                         break
                     except Exception as e:
                         print(f"batched slots={slots} ({kern},{widen}) failed: {e!r}"[:500],
@@ -616,19 +618,22 @@ def worker():
                 batch_results.append(br)
                 if br["agg_tok_s"] / north > best[0]:
                     best = (br["agg_tok_s"] / north, f"{LABELS[name]} {slots}-slot serving", br["agg_tok_s"])
-            # f8-cache variant at the largest measured slot count (half the
-            # cache bytes — the sweep's bottleneck): one extra row, budget
-            # permitting, so the driver's single run captures the f8 win
-            if (os.environ.get("BENCH_CACHE", "bf16") == "bf16"
+            # f8-cache variant at the largest slot count that produced a bf16
+            # row (half the cache bytes — the sweep's bottleneck), with that
+            # row's proven kernel path: one extra row, budget permitting, so
+            # the driver's single run captures the f8 win AND its baseline
+            if (ok and os.environ.get("BENCH_CACHE", "bf16") == "bf16"
                     and time.monotonic() < deadline - 150):
                 try:
                     import jax.numpy as _jnp
 
-                    slots_f8 = max(s for s in slot_list)
-                    br = bench_batched(cfg, params, slots_f8,
+                    slots_f8, kern, widen = max(ok)
+                    br = bench_batched(cfg, wide_params if widen else params,
+                                       slots_f8, kernels=kern,
                                        cache_dtype=_jnp.float8_e4m3fn)
                     br["preset"] = name
-                    br["path"] = "cache=f8"
+                    br["path"] = f"cache=f8 kernels={kern or 'auto'}" + (
+                        " scales=f32" if widen else "")
                     batch_results.append(br)
                     if br["agg_tok_s"] / north > best[0]:
                         best = (br["agg_tok_s"] / north,

@@ -364,6 +364,10 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
     device). n_slots == 0 keeps the single-engine tier with the NaiveCache
     prefix reuse (the reference server's semantics)."""
     scheduler = None
+    if n_slots > 0 and int(defaults.get("spec", 0)) > 0:
+        log.warning("--spec applies to the single-engine tier only; the "
+                    "continuous-batching tier (--slots %d) decodes without "
+                    "speculation", n_slots)
     if n_slots > 0:
         from dllama_tpu.engine.batch import BatchEngine
         from dllama_tpu.serve.scheduler import Scheduler
