@@ -3,11 +3,26 @@
 # then the full benchmark. Run from the repo root when the axon tunnel is
 # alive (probe first!). Each stage tolerates failure and moves on; everything
 # is logged to experiments/logs/.
+#
+# TPU_SESSION_SMOKE=1 runs the SAME script end-to-end on CPU with each
+# stage's tiny/smoke variant — proves the shell plumbing (stage sequence,
+# tee paths, timeouts) without a chip; exercised by CI
+# (tests/test_window_scripts.py).
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p experiments/logs
 TS=$(date +%H%M%S)
 L=experiments/logs
+SMOKE="${TPU_SESSION_SMOKE:-0}"
+if [ "$SMOKE" = "1" ]; then
+  export JAX_PLATFORMS=cpu
+  export PYTHONPATH="$PWD"
+  KB_ARGS="--smoke"; AB_ARGS="--smoke"
+  export EBENCH_TINY=1 BENCH_FORCE_CPU=1
+  EB_N=4
+else
+  KB_ARGS=""; AB_ARGS=""; EB_N=64
+fi
 # persistent compile cache: the window's stages (validate/kbench/ebench/bench)
 # re-compile many shared shapes; first-compile-over-tunnel is 20-40s each,
 # cache hits across processes AND across windows are ~free
@@ -15,21 +30,25 @@ export JAX_COMPILATION_CACHE_DIR="$PWD/experiments/jax_cache"
 mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 
 echo "== 1. probe"
-timeout 60 python -c "import jax; print('PROBE', jax.devices())" || { echo "tunnel down"; exit 1; }
+if [ "$SMOKE" = "1" ]; then
+  echo "PROBE skipped (smoke)"
+else
+  timeout 60 python -c "import jax; print('PROBE', jax.devices())" || { echo "tunnel down"; exit 1; }
+fi
 
 echo "== 2. kernel validation (compile + parity, ~3-5 min)"
 timeout 600 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/tpu_validate.py 2>&1 | tee "$L/validate_$TS.log"
 
 echo "== 3. kernel micro-bench suite (decode m=8 + prefill m=256/512, one process)"
-timeout 900 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/kbench.py suite 2>&1 | tee "$L/kbench_$TS.log"
+timeout 900 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/kbench.py suite $KB_ARGS 2>&1 | tee "$L/kbench_$TS.log"
 
 echo "== 4. engine-knob A/B (1B, one process)"
-timeout 900 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/ebench.py 2>&1 | tee "$L/ebench_$TS.log"
+timeout 900 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/ebench.py $EB_N 2>&1 | tee "$L/ebench_$TS.log"
 
 echo "== 5. full benchmark (1b + 8b + long + batched sweep)"
 timeout 900 python bench.py 2>&1 | tee "$L/bench_$TS.log" | tail -1
 
 echo "== 6. admission-stall A/B (8b serving tier, sync vs interleaved)"
-timeout 900 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/abench.py 2>&1 | tee "$L/abench_$TS.log"
+timeout 900 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/abench.py $AB_ARGS 2>&1 | tee "$L/abench_$TS.log"
 
 echo "== done; logs in $L/*_$TS.log"

@@ -65,6 +65,23 @@ def test_collectives_table_smoke():
     assert "FAILED" not in p.stdout, p.stdout
 
 
+def test_tpu_session_shell_end_to_end():
+    """The WHOLE tpu_session.sh (shell plumbing: stage sequence, env, tee
+    paths, timeouts) in smoke mode — a stage-wiring typo must fail CI, not a
+    live window."""
+    env = dict(os.environ)
+    env["TPU_SESSION_SMOKE"] = "1"
+    env.pop("XLA_FLAGS", None)
+    p = subprocess.run(
+        ["sh", "experiments/tpu_session.sh"], cwd=REPO, capture_output=True,
+        text=True, timeout=2400, env=env,
+    )
+    assert p.returncode == 0, f"stdout:\n{p.stdout[-3000:]}\nstderr:\n{p.stderr[-2000:]}"
+    for marker in ("TOTAL ALL PASS", "KBENCH DONE", "EBENCH DONE fails=0",
+                   "ABENCH DONE fails=0", "== done"):
+        assert marker in p.stdout, f"missing {marker!r}:\n{p.stdout[-3000:]}"
+
+
 def test_aot_mosaic_acceptance():
     """Every production Pallas kernel (incl. the shard_map'd TP paths) must
     AOT-compile for the v5e/v6e targets via the local libtpu — the committed
