@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dllama_tpu.engine.engine import pow2_chunk
 from dllama_tpu.engine.sampling import sample_logits
 from dllama_tpu.models.config import LlamaConfig
 from dllama_tpu.models.llama import KVCache, forward
@@ -254,11 +255,7 @@ class BatchEngine:
         """Prefill ONE power-of-two chunk of the admission's prompt; returns
         True when every prompt token's KV row is written."""
         n, off, slot = len(adm.toks), adm.off, adm.slot
-        # power-of-two widths: at most log2(max_chunk)+1 compiled variants
-        # (same policy as InferenceEngine.prefill)
-        c = min(self.max_prefill_chunk, 1 << (n - off - 1).bit_length())
-        while c > n - off:
-            c //= 2
+        c = pow2_chunk(n - off, self.max_prefill_chunk)
         if self._use_slot_prefill:
             row, self.cache = self._prefill_slot(
                 self.params, self.cache,

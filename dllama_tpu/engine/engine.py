@@ -28,6 +28,16 @@ from dllama_tpu.models.llama import KVCache, forward
 from dllama_tpu.ops.layers import build_rope_cache
 
 
+def pow2_chunk(remaining: int, max_chunk: int) -> int:
+    """Largest power-of-two width <= min(max_chunk, remaining): prompts of
+    any length compile at most log2(max_chunk)+1 prefill step variants
+    (shared by InferenceEngine.prefill and BatchEngine.add_step)."""
+    c = min(max_chunk, 1 << (remaining - 1).bit_length())
+    while c > remaining:
+        c //= 2
+    return c
+
+
 @dataclass
 class GenerationStats:
     """Per-token timing in the reference's report shape (dllama.cpp:93-104)."""
@@ -339,9 +349,7 @@ class InferenceEngine:
         logits = None
         off = 0
         while off < n:
-            chunk = min(self.max_prefill_chunk, 1 << (n - off - 1).bit_length())
-            while chunk > n - off:
-                chunk //= 2
+            chunk = pow2_chunk(n - off, self.max_prefill_chunk)
             logits = self.step(tokens[:, off : off + chunk])
             off += chunk
         return logits

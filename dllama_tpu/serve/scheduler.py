@@ -29,6 +29,8 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+import numpy as np
+
 from dllama_tpu.engine.batch import BatchEngine
 
 log = logging.getLogger("dllama_tpu.serve")
@@ -215,8 +217,6 @@ class Scheduler:
         if not idle:
             return None, 0, None
 
-        import numpy as np
-
         def shared(s: int) -> int:
             cached = self.slot_tokens.get(s, [])
             # reusable rows = LONGEST COMMON PREFIX (not all-or-nothing: a
@@ -260,14 +260,15 @@ class Scheduler:
                 req.finish_reason = "cancelled"
                 req.out.put(_END)
                 continue
-            slot, reuse, donor = self._pick_slot(req.prompt)
             if len(req.prompt) >= self.engine.seq_len:
-                # reject BEFORE any donor copy: a hopeless admission must not
-                # evict the destination slot's cached prefix
+                # reject BEFORE slot search or any donor copy: a hopeless
+                # admission must not evict a slot's cached prefix (nor pay
+                # the per-slot LCP scan)
                 req.out.put(ValueError(
                     f"prompt ({len(req.prompt)}) exceeds seq_len {self.engine.seq_len}"
                 ))
                 continue
+            slot, reuse, donor = self._pick_slot(req.prompt)
             try:
                 if donor is not None and donor != slot and reuse > 0:
                     # cross-slot share: materialize the donor's prefix rows
