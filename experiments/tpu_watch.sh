@@ -18,7 +18,14 @@ while [ "$i" -lt 400 ]; do
     echo "TUNNEL UP probe=$i $(date -u +%H:%M:%S)" >>"$W"
     sh experiments/tpu_session.sh >>experiments/logs/session.log 2>&1
     echo "SESSION DONE rc=$? $(date -u +%H:%M:%S)" >>"$W"
-    exit 0
+    # a window that died mid-session leaves no real TPU bench record —
+    # keep watching for another window instead of giving up for the round
+    if grep -l '"vs_baseline"' experiments/logs/bench_*.log 2>/dev/null \
+        | xargs grep -L '"tpu_unavailable": true' 2>/dev/null | grep -q .; then
+      echo "TPU BENCH RECORDED; watcher exiting $(date -u +%H:%M:%S)" >>"$W"
+      exit 0
+    fi
+    echo "session yielded no TPU bench record; re-arming" >>"$W"
   fi
   echo "probe $i down $(date -u +%H:%M:%S)" >>"$W"
   sleep 60
