@@ -33,7 +33,9 @@ from dllama_tpu.ops.quant import (
     Q_BLOCK,
     QTensor,
     dequantize_q40_np,
+    dequantize_q80_np,
     quantize_q40_np,
+    quantize_q80_np,
 )
 
 
@@ -127,6 +129,13 @@ def write_tensor(f, x: np.ndarray, float_type: FloatType) -> int:
         rec[:, :2] = scales.reshape(-1, 1).view(np.uint8)
         rec[:, 2:] = packed
         buf = rec.tobytes()
+    elif float_type == FloatType.Q80:
+        # reference record: f16 delta + 32 int8 codes (writer.py:55-74)
+        codes, scales = quantize_q80_np(flat)
+        rec = np.zeros((codes.shape[0], 2 + Q_BLOCK), dtype=np.uint8)
+        rec[:, :2] = scales.reshape(-1, 1).view(np.uint8)
+        rec[:, 2:] = codes.view(np.uint8)
+        buf = rec.tobytes()
     else:
         raise ValueError(f"unsupported weight type: {float_type}")
     f.write(buf)
@@ -172,6 +181,12 @@ def decode_dense(raw: np.ndarray, shape: tuple, ft: FloatType) -> np.ndarray:
         scales = rec[:, :2].copy().view(np.float16).reshape(-1)
         packed = rec[:, 2:]
         return dequantize_q40_np(packed, scales).reshape(shape)
+    if ft == FloatType.Q80:
+        n = int(np.prod(shape))
+        rec = raw.reshape(n // Q_BLOCK, 2 + Q_BLOCK)
+        scales = rec[:, :2].copy().view(np.float16).reshape(-1)
+        codes = rec[:, 2:].view(np.int8)  # same-itemsize view: no copy
+        return dequantize_q80_np(codes, scales).reshape(shape)
     raise ValueError(f"unsupported weight type: {ft}")
 
 

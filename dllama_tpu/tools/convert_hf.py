@@ -95,7 +95,7 @@ def convert_hf(model_dir: str, weight_type_name: str, output: str | None = None,
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("model_dir", help="HF checkpoint dir (config.json + *.safetensors)")
-    p.add_argument("weight_type", choices=["q40", "f16", "f32"], help="on-disk matmul weight type")
+    p.add_argument("weight_type", choices=["q40", "q80", "f16", "f32"], help="on-disk matmul weight type")
     p.add_argument("--output", default=None, help="output .m path")
     p.add_argument("--max-seq-len", type=int, default=None, help="clamp seq_len in the header")
     args = p.parse_args(argv)

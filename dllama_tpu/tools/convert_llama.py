@@ -133,7 +133,7 @@ def convert_llama(model_dir: str, weight_type_name: str, output: str | None = No
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("model_dir", help="Meta checkpoint dir (params.json + consolidated.*.pth)")
-    p.add_argument("weight_type", choices=["q40", "f16", "f32"])
+    p.add_argument("weight_type", choices=["q40", "q80", "f16", "f32"])
     p.add_argument("--output", default=None)
     args = p.parse_args(argv)
     convert_llama(args.model_dir, args.weight_type, args.output)

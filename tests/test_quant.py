@@ -106,3 +106,40 @@ def test_q80_jnp_matches_np(rng):
 @pytest.mark.parametrize("ft,nbytes", [("q40", 18 * 4), ("q80", 34 * 4), ("f32", 512), ("f16", 256)])
 def test_float_type_sizes(ft, nbytes):
     assert quant.parse_float_type(ft).nbytes(128) == nbytes
+
+
+def test_q80_weight_model_file_end_to_end(tmp_path, rng):
+    """The reference converter can emit Q80-WEIGHT `.m` files
+    (writer.py:55-74, 102-103); ours must write, re-read, and RUN them.
+    Q80 matmul weights load as dense bf16 operands (the packed-HBM fast path
+    stays Q40-only); numerics must sit inside Q80's roundtrip noise."""
+    import jax.numpy as jnp
+
+    from dllama_tpu.engine.engine import InferenceEngine
+    from dllama_tpu.models import formats
+    from dllama_tpu.models.config import LlamaConfig
+
+    cfg = LlamaConfig(dim=64, hidden_dim=96, n_layers=2, n_heads=4, n_kv_heads=2,
+                      vocab_size=128, seq_len=64, weight_type=quant.FloatType.Q80)
+    tensors = {
+        name: (rng.standard_normal(shape) * 0.05).astype(np.float32)
+        for name, shape, _ in formats.tensor_plan(cfg)
+    }
+    path = str(tmp_path / "q80.m")
+    formats.save_model(path, cfg, tensors)
+
+    cfg2, hs = formats.read_header(path)
+    assert cfg2.weight_type == quant.FloatType.Q80
+    # per-tensor decode parity: within the reference's Q80 eps of the source
+    for name, shape, ft, raw in formats.iter_tensors(path, cfg2, hs):
+        got = formats.decode_dense(raw, shape, ft)
+        eps = 0.01 if ft == quant.FloatType.Q80 else 1e-6
+        np.testing.assert_allclose(got, tensors[name], atol=eps)
+
+    params = formats.load_params(path, cfg2, hs, dtype=jnp.float32)
+    eng = InferenceEngine(cfg2, params, cache_dtype=jnp.float32)
+    logits = eng.prefill(np.array([[1, 2, 3]], np.int32))
+    toks = eng.decode_greedy_n(
+        np.array([[int(np.argmax(np.asarray(logits)))]]), 6
+    )
+    assert toks.shape == (6, 1)
