@@ -119,17 +119,20 @@ def main():
     if not force_cpu:
         # bounded probe/retry: a wedged relay clears only server-side, so a
         # couple of spaced attempts, then give up and record the CPU fallback.
-        for attempt in range(3):
+        # Probe timeout must cover a LIVE-but-slow tunnel's backend init
+        # (~120 s observed; the watcher uses 240 s for the same reason) — a
+        # 90 s probe would write off a usable window as down.
+        for attempt in range(2):
             budget = deadline - time.monotonic()
-            if budget < 240:  # not enough left for probe + worker + fallback
+            if budget < 300:  # not enough left for probe + worker + fallback
                 break
-            tpu_ok = probe_tpu(min(90, budget - 180))
+            tpu_ok = probe_tpu(min(180, budget - 180))
             if tpu_ok:
                 break
             print(f"TPU probe {attempt + 1} failed (tunnel wedged/unavailable)",
                   file=sys.stderr)
-            if deadline - time.monotonic() > 420:
-                time.sleep(60)
+            if deadline - time.monotonic() > 480:
+                time.sleep(45)
     if tpu_ok:
         budget = deadline - time.monotonic() - 120  # keep room for CPU fallback
         env = dict(os.environ)
