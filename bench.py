@@ -540,56 +540,9 @@ def worker():
             if a != (q40_style, None, False, "auto")
         ]
         wide_params = None
-        for style, kern, widen, attn in attempts:
-            _qm.STYLE = style
-            try:
-                if widen and wide_params is None:
-                    wide_params = _widen_scales(params)
-                r = bench_engine(cfg, wide_params if widen else params, n_decode,
-                                 unroll, prompt_len=PROMPT_LENS.get(name, 512),
-                                 kernels=kern, attn_impl=attn)
-                r["path"] = f"style={style} kernels={kern or 'auto'}" + (
-                    " scales=f32" if widen else "") + (
-                    " attn=jnp" if attn == "jnp" else "")
-                results[name] = r
-                if r["decode_tok_s"] / north > best[0]:
-                    best = (r["decode_tok_s"] / north, f"{LABELS[name]} batch=1 decode",
-                            r["decode_tok_s"])
-                break
-            except Exception as e:  # keep other configs' numbers (a kernel
-                # compile failure on one tier must not zero the whole record)
-                print(f"preset {name} ({style}/{kern}) failed: {e!r}"[:500],
-                      file=sys.stderr)
-                results[name] = {"error": repr(e)[:200]}
-            finally:
-                _qm.STYLE = q40_style
-        # prefill-route self-tune (runs once, on the first preset that
-        # succeeded on a Pallas rung): re-measure with large-m matmuls routed
-        # through the XLA dequant-dot GEMM. If that beats the fused prefill
-        # by >20%, keep the routing for the remaining (bigger) presets. The
-        # driver's bench runs with default env, so the worker must learn this
-        # itself rather than rely on BENCH_XLA_PREFILL_M.
-        if (xla_prefill_m is None and not prefill_tuned
-                and name in results and "prefill_tok_s" in results[name]
-                and "kernels=auto" in results[name].get("path", "")
-                and time.monotonic() < deadline - 240):
-            prefill_tuned = True
-            try:
-                _mmod.XLA_PREFILL_MIN_M = 64
-                r2 = bench_engine(cfg, params, min(n_decode, 32), unroll,
-                                  prompt_len=PROMPT_LENS.get(name, 512))
-                r2["path"] = "style=auto kernels=auto xla_prefill_m=64"
-                results[name + "_xla_prefill"] = r2
-                if r2["prefill_tok_s"] > 1.2 * results[name]["prefill_tok_s"]:
-                    results["prefill_route"] = "xla (kept: fused deq slower)"
-                else:
-                    _mmod.XLA_PREFILL_MIN_M = None
-                    results["prefill_route"] = "fused deq"
-            except Exception as e:
-                _mmod.XLA_PREFILL_MIN_M = None
-                results[name + "_xla_prefill"] = {"error": repr(e)[:200]}
-        # batched sweep while the north-star config's params are live; skip
-        # slots we no longer have budget for
+        # batched sweep FIRST on the north-star preset (its agg_tok_s is what
+        # vs_baseline is judged on — in a tight window it must not be starved
+        # by the batch=1 extras); skip slots we no longer have budget for
         if name == sweep_on:
             ok = []  # (slots, kern, widen) of successful bf16 rows
             for slots in slot_list:
@@ -644,6 +597,54 @@ def worker():
                                 br["agg_tok_s"])
                 except Exception as e:
                     batch_results.append({"slots": "f8", "error": repr(e)[:200]})
+        for style, kern, widen, attn in attempts:
+            _qm.STYLE = style
+            try:
+                if widen and wide_params is None:
+                    wide_params = _widen_scales(params)
+                r = bench_engine(cfg, wide_params if widen else params, n_decode,
+                                 unroll, prompt_len=PROMPT_LENS.get(name, 512),
+                                 kernels=kern, attn_impl=attn)
+                r["path"] = f"style={style} kernels={kern or 'auto'}" + (
+                    " scales=f32" if widen else "") + (
+                    " attn=jnp" if attn == "jnp" else "")
+                results[name] = r
+                if r["decode_tok_s"] / north > best[0]:
+                    best = (r["decode_tok_s"] / north, f"{LABELS[name]} batch=1 decode",
+                            r["decode_tok_s"])
+                break
+            except Exception as e:  # keep other configs' numbers (a kernel
+                # compile failure on one tier must not zero the whole record)
+                print(f"preset {name} ({style}/{kern}) failed: {e!r}"[:500],
+                      file=sys.stderr)
+                results[name] = {"error": repr(e)[:200]}
+            finally:
+                _qm.STYLE = q40_style
+        # prefill-route self-tune (runs once, on the first preset that
+        # succeeded on a Pallas rung): re-measure with large-m matmuls routed
+        # through the XLA dequant-dot GEMM. If that beats the fused prefill
+        # by >20%, keep the routing for the remaining (bigger) presets. The
+        # driver's bench runs with default env, so the worker must learn this
+        # itself rather than rely on BENCH_XLA_PREFILL_M.
+        if (xla_prefill_m is None and not prefill_tuned
+                and name in results and "prefill_tok_s" in results[name]
+                and "kernels=auto" in results[name].get("path", "")
+                and time.monotonic() < deadline - 240):
+            prefill_tuned = True
+            try:
+                _mmod.XLA_PREFILL_MIN_M = 64
+                r2 = bench_engine(cfg, params, min(n_decode, 32), unroll,
+                                  prompt_len=PROMPT_LENS.get(name, 512))
+                r2["path"] = "style=auto kernels=auto xla_prefill_m=64"
+                results[name + "_xla_prefill"] = r2
+                if r2["prefill_tok_s"] > 1.2 * results[name]["prefill_tok_s"]:
+                    results["prefill_route"] = "xla (kept: fused deq slower)"
+                else:
+                    _mmod.XLA_PREFILL_MIN_M = None
+                    results["prefill_route"] = "fused deq"
+            except Exception as e:
+                _mmod.XLA_PREFILL_MIN_M = None
+                results[name + "_xla_prefill"] = {"error": repr(e)[:200]}
         del wide_params  # params persists: the next preset may share its shapes
 
     # bytes/token is part of the benchmark contract (SURVEY.md §5.1/§6): on
