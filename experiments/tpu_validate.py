@@ -73,6 +73,16 @@ except Exception as e:
     failures.append("flash")
     print(f"FAIL flash (compile/run): {str(e)[:400]}", flush=True)
 
+# f8 (e4m3) KV cache through the flash kernel (--cache-dtype f8)
+try:
+    k8 = k.astype(jnp.float8_e4m3fn)
+    v8 = v.astype(jnp.float8_e4m3fn)
+    got = flash_gqa_attention(q, k8, v8, jnp.int32(900), interpret=_interp)
+    check("flash f8 KV cache", got, gqa_attention(q, k8, v8, jnp.int32(900)))
+except Exception as e:
+    failures.append("flash-f8")
+    print(f"FAIL flash f8 (compile/run): {str(e)[:400]}", flush=True)
+
 # end-to-end tiny engine parity
 from dllama_tpu.engine.engine import InferenceEngine
 from dllama_tpu.models.config import LlamaConfig
@@ -127,6 +137,27 @@ try:
 except Exception as e:
     failures.append("batch")
     print(f"FAIL batch engine (compile/run): {str(e)[:400]}", flush=True)
+
+# speculative decode: exact-greedy parity vs the plain fused scan on-chip
+try:
+    eng_s = InferenceEngine(cfg, params, cache_dtype=jnp.bfloat16, kernels="pallas")
+    sp = np.asarray([[1, 2, 3, 4] * 4], np.int32)
+    lg = eng_s.prefill(sp)
+    first = int(np.argmax(np.asarray(lg)[0]))
+    spec_toks = [int(t) for t in eng_s.decode_spec_greedy_n(list(sp[0]), first, 12, k=4)]
+    eng_g = InferenceEngine(cfg, params, cache_dtype=jnp.bfloat16, kernels="pallas")
+    eng_g.prefill(sp)
+    ref_toks = [int(t) for t in eng_g.decode_greedy_n(np.array([[first]]), 12)[:, 0]]
+    st = eng_s._spec_stats
+    if spec_toks == ref_toks:
+        print(f"PASS speculative parity ({st['emitted']} tokens / {st['cycles']} "
+              f"forwards) ({time.time() - t_start:.0f}s)", flush=True)
+    else:
+        failures.append("spec")
+        print(f"FAIL speculative parity: {spec_toks} != {ref_toks}", flush=True)
+except Exception as e:
+    failures.append("spec")
+    print(f"FAIL speculative (compile/run): {str(e)[:400]}", flush=True)
 
 print("TOTAL", "FAIL " + ",".join(failures) if failures else "ALL PASS", flush=True)
 sys.exit(1 if failures else 0)
