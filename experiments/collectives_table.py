@@ -46,8 +46,8 @@ from dllama_tpu.parallel.sharding import LlamaShardings
 from dllama_tpu.utils.profiling import collective_bytes_per_token
 
 
-def measure(cfg: LlamaConfig, tp: int, sync: str) -> dict:
-    mesh = make_mesh(MeshConfig(tp=tp))
+def measure(cfg: LlamaConfig, mesh_kw: dict, sync: str) -> dict:
+    mesh = make_mesh(MeshConfig(**mesh_kw))
     sh = LlamaShardings(mesh, cfg)
     params = random_params_fast(cfg, seed=0, dtype=jnp.bfloat16)
     eng = InferenceEngine(
@@ -56,7 +56,9 @@ def measure(cfg: LlamaConfig, tp: int, sync: str) -> dict:
     )
     rep = eng.measured_collective_report()
     wire = 34.0 / 32.0 if sync == "q80" else 2.0
-    analytic = collective_bytes_per_token(cfg, tp=tp, exchange_bytes=wire)
+    analytic = collective_bytes_per_token(
+        cfg, tp=mesh_kw.get("tp", 1), sp=mesh_kw.get("sp", 1), exchange_bytes=wire
+    )
     del eng, params
     return {
         "measured_bytes": rep["total_bytes"],
@@ -71,33 +73,41 @@ def main():
     if "--out" in sys.argv:
         out_md = sys.argv[sys.argv.index("--out") + 1]
     if smoke:
-        combos = [("tiny", 2, "bf16"), ("tiny", 2, "q80")]
+        combos = [("tiny", {"tp": 2}, "bf16"), ("tiny", {"tp": 2}, "q80"),
+                  ("tiny", {"sp": 2}, "bf16")]
         out_md = os.path.join("experiments", "collectives_smoke.md")
     else:
         combos = [
-            (name, tp, sync)
+            (name, {"tp": tp}, sync)
             for name in ("1b", "8b")
             for tp in (2, 4, 8)
             for sync in ("bf16", "q80")
+        ] + [
+            # sequence/context parallelism (the axis the reference lacks):
+            # decode-path ring attention's per-step LSE-merge payload
+            ("1b", {"sp": 8}, "bf16"),
+            ("1b", {"sp": 2, "tp": 4}, "bf16"),
+            ("8b", {"sp": 8}, "bf16"),
         ]
 
     rows, table_json = [], {}
-    for name, tp, sync in combos:
+    for name, mesh_kw, sync in combos:
         t0 = time.time()
         cfg = LlamaConfig(**PRESETS[name])
+        mesh_label = ",".join(f"{k}{v}" for k, v in sorted(mesh_kw.items()))
         try:
-            r = measure(cfg, tp, sync)
+            r = measure(cfg, mesh_kw, sync)
         except Exception as e:
-            print(f"{name} tp={tp} {sync}: FAILED {e!r}"[:300], flush=True)
+            print(f"{name} {mesh_label} {sync}: FAILED {e!r}"[:300], flush=True)
             continue
         ops = " + ".join(
             f"{op} {b/1024:.1f}K" for op, b in sorted(r["per_op"].items())
         )
         rows.append(
-            f"| {name} | {tp} | {sync} | {r['measured_bytes']/1024:.1f} | "
+            f"| {name} | {mesh_label} | {sync} | {r['measured_bytes']/1024:.1f} | "
             f"{r['analytic_wire_bytes']/1024:.1f} | {ops} |"
         )
-        table_json[f"{name}/tp{tp}/{sync}"] = {
+        table_json[f"{name}/{mesh_label}/{sync}"] = {
             "measured_kb_per_token_per_chip": r["measured_bytes"] / 1024.0,
             "analytic_wire_kb_per_token_per_chip": r["analytic_wire_bytes"] / 1024.0,
             "per_op_bytes": r["per_op"],
@@ -118,7 +128,7 @@ def main():
         "  `utils.profiling.collective_bytes_per_token`.\n"
         "* q80 rides the quantized exchange (u8 payload + f16 scales ≈ 1.06\n"
         "  bytes/elem on the wire) for the wo/w2 partial-sum syncs.\n\n"
-        "| preset | tp | sync | measured KB/tok/chip | analytic wire KB/tok/chip | measured per-op |\n"
+        "| preset | mesh | sync | measured KB/tok/chip | analytic wire KB/tok/chip | measured per-op |\n"
         "|---|---|---|---|---|---|\n"
     )
     md = header + "\n".join(rows) + "\n"
