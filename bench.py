@@ -145,7 +145,15 @@ def main():
     env = _cpu_env()
     env["BENCH_DECODE_TOKENS"] = os.environ.get("BENCH_CPU_DECODE_TOKENS", "16")
     env["BENCH_PRESET"] = os.environ.get("BENCH_CPU_PRESET", "tiny")
-    result = run_worker(env, max(deadline - time.monotonic(), 120))
+    # the honest CPU record still demonstrates the serving tier: a small
+    # batched sweep (+f8 row) and the admission-stall A/B at toy size
+    env.setdefault("BENCH_SWEEP_TINY", "1")
+    env.setdefault("BENCH_SLOTS", "4")
+    remaining = max(deadline - time.monotonic(), 120)
+    # the worker must SELF-limit inside the parent's window — a worker killed
+    # mid-measurement prints no JSON and the whole record degrades to empty
+    env["BENCH_WORKER_BUDGET_S"] = str(max(remaining - 30, 60))
+    result = run_worker(env, remaining)
     if result is None:  # last resort: an honest empty record, still rc=0
         result = {
             "metric": "decode tok/s (UNMEASURED: TPU tunnel down, CPU fallback failed)",
@@ -403,6 +411,7 @@ def bench_admission(cfg, params, n_slots=8, prompt_len=512, chunk=4, pf_chunk=64
     from dllama_tpu.engine.batch import BatchEngine
     from dllama_tpu.serve.scheduler import Scheduler
 
+    prompt_len = min(prompt_len, cfg.seq_len // 2)
     out = {"slots": n_slots, "prompt": prompt_len}
     warm, bg_maker, prompt = admission_streams(cfg, pf_chunk, prompt_len)
     for interleave in (False, True):
@@ -669,7 +678,7 @@ def worker():
     # serving-tier admission-stall record (uses the last preset's live params;
     # param shapes are seq-independent, so the sweep preset's cfg applies)
     admit = None
-    if (sweep_on and sweep_on != "tiny" and os.environ.get("BENCH_ADMIT") != "0"
+    if (sweep_on and os.environ.get("BENCH_ADMIT") != "0"
             and time.monotonic() < deadline - 240):
         try:
             admit = bench_admission(LlamaConfig(**PRESETS[sweep_on]), params)
