@@ -18,7 +18,9 @@ if [ "$SMOKE" = "1" ]; then
   export JAX_PLATFORMS=cpu
   export PYTHONPATH="$PWD"
   KB_ARGS="--smoke"; AB_ARGS="--smoke"
-  export EBENCH_TINY=1 BENCH_FORCE_CPU=1
+  # smoke proves PLUMBING: keep the bench stage's record minimal/fast
+  export EBENCH_TINY=1 BENCH_FORCE_CPU=1 BENCH_ADMIT=0 BENCH_SPEC=0 \
+         BENCH_SLOTS=2 BENCH_CPU_DECODE_TOKENS=8
   EB_N=4
 else
   KB_ARGS=""; AB_ARGS=""; EB_N=64
