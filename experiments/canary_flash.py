@@ -1,0 +1,25 @@
+"""Flash-attention canary: compile + run ONE tiny flash kernel on the live
+backend and exit 0.
+
+The 2026-07-31 window wedged server-side exactly at the first flash compile
+(TPU_VALIDATE_r04.md); whether flash *caused* the wedge is unknown. The
+session script runs this under `timeout` before any flash-dependent stage:
+on timeout/failure it exports BENCH_ATTN=jnp / EBENCH_ATTN=jnp / kbench
+--no-flash so the window still produces engine numbers on the XLA attention
+path instead of hanging every later stage.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dllama_tpu.ops.pallas.flash_attention import flash_gqa_attention
+
+interp = jax.devices()[0].platform != "tpu"
+rng = np.random.default_rng(0)
+q = jnp.asarray(rng.standard_normal((1, 1, 8, 64)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((1, 4, 512, 64)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((1, 4, 512, 64)), jnp.bfloat16)
+out = flash_gqa_attention(q, k, v, jnp.int32(100), interpret=interp)
+jax.block_until_ready(out)
+assert np.isfinite(np.asarray(out, np.float32)).all()
+print("FLASH CANARY OK", flush=True)

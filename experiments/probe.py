@@ -1,0 +1,27 @@
+"""Tunnel health probe: backend init + one tiny computation round-trip.
+
+Exit 0 = the chip executes work. Device enumeration alone is NOT proof —
+the 2026-07-31 window wedged in a state where `jax.devices()` had already
+succeeded but every execution RPC blocked forever (TPU_VALIDATE_r04.md), so
+the watcher and every inter-stage gate in tpu_session.sh call this instead.
+Run under `timeout`: a wedged tunnel hangs this process rather than
+erroring.
+"""
+import time
+
+t0 = time.time()
+import jax
+import jax.numpy as jnp
+
+d = jax.devices()
+# no silent-CPU success: the watcher keys a whole measurement session off
+# this exit code (PROBE_ALLOW_CPU=1 for local/dev runs)
+import os
+if not os.environ.get("PROBE_ALLOW_CPU"):
+    assert d[0].platform == "tpu", f"not a TPU backend: {d}"
+t1 = time.time()
+x = jnp.ones((128, 128))
+s = float((x @ x).sum())
+assert s == 128.0 * 128 * 128, s
+print(f"PROBE OK {d[0].platform} init={t1-t0:.1f}s compute={time.time()-t1:.1f}s",
+      flush=True)

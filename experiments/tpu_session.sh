@@ -1,8 +1,18 @@
 #!/bin/sh
-# One TPU window, fully scripted: validate kernels, micro-bench decode styles,
-# then the full benchmark. Run from the repo root when the axon tunnel is
-# alive (probe first!). Each stage tolerates failure and moves on; everything
-# is logged to experiments/logs/.
+# One TPU window, fully scripted and wedge-hardened. The 2026-07-31 window
+# (TPU_VALIDATE_r04.md) proved the failure mode that matters is not a crash
+# but a server-side WEDGE: one compile RPC blocks forever and every later
+# device call from every process hangs with it. So:
+#   * a COMPUTE probe (experiments/probe.py) gates every stage — a wedged
+#     tunnel costs one probe timeout, then the session exits and the watcher
+#     re-arms for the next window;
+#   * a flash-attention CANARY runs before any flash-dependent stage (the
+#     wedge struck at the first flash compile); if it hangs, later stages run
+#     with BENCH_ATTN/EBENCH_ATTN=jnp and kbench --no-flash so the window
+#     still yields engine + q40 numbers on the XLA attention path;
+#   * the full benchmark (BENCH_r04's source of truth) runs FIRST among the
+#     long stages — the headline record must not be starved by micro-benches;
+#   * tpu_validate runs as per-group processes, each timeout-bounded.
 #
 # TPU_SESSION_SMOKE=1 runs the SAME script end-to-end on CPU with each
 # stage's tiny/smoke variant — proves the shell plumbing (stage sequence,
@@ -25,32 +35,79 @@ if [ "$SMOKE" = "1" ]; then
 else
   KB_ARGS=""; AB_ARGS=""; EB_N=64
 fi
-# persistent compile cache: the window's stages (validate/kbench/ebench/bench)
-# re-compile many shared shapes; first-compile-over-tunnel is 20-40s each,
-# cache hits across processes AND across windows are ~free
+# persistent compile cache: the window's stages re-compile many shared
+# shapes; first-compile-over-tunnel is 20-40s each, cache hits across
+# processes AND across windows are ~free
 export JAX_COMPILATION_CACHE_DIR="$PWD/experiments/jax_cache"
 mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+PP="$PWD:${PYTHONPATH:-}"  # quoted at every use: paths with spaces must not word-split
 
-echo "== 1. probe"
-if [ "$SMOKE" = "1" ]; then
-  echo "PROBE skipped (smoke)"
+# compute probe between stages: a wedged tunnel fails here in <=240s instead
+# of eating every later stage's full timeout. Smoke skips (no tunnel).
+probe() {
+  if [ "$SMOKE" = "1" ]; then return 0; fi
+  timeout 240 env PYTHONPATH="$PP" python experiments/probe.py >>"$L/probe_$TS.log" 2>&1
+}
+
+echo "== 1. probe (compute round-trip)"
+probe || { echo "tunnel down/wedged"; exit 1; }
+
+echo "== 2. flash canary (the 2026-07-31 wedge struck at a flash compile)"
+FLASH_OK=1
+# no pipe: a pipeline's status is tee's, which would mask a hung canary and
+# leave flash armed on the exact wedge this stage exists to catch
+if timeout 360 env PYTHONPATH="$PP" python experiments/canary_flash.py >"$L/canary_$TS.log" 2>&1; then
+  cat "$L/canary_$TS.log"
+  echo "canary ok: flash stays on"
+  # bench.py re-canaries when BENCH_ATTN is unset; 'auto' (its default)
+  # records the same result without a second fresh-process compile
+  export BENCH_ATTN=auto
 else
-  timeout 60 python -c "import jax; print('PROBE', jax.devices())" || { echo "tunnel down"; exit 1; }
+  cat "$L/canary_$TS.log"
+  FLASH_OK=0
+  export BENCH_ATTN=jnp EBENCH_ATTN=jnp
+  KB_ARGS="$KB_ARGS --no-flash"
+  echo "CANARY FAILED/HUNG: flash disabled for this window (attn=jnp)"
+  probe || { echo "tunnel wedged by canary; logs kept, watcher will re-arm"; exit 1; }
 fi
 
-echo "== 2. kernel validation (compile + parity, ~3-5 min)"
-timeout 600 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/tpu_validate.py 2>&1 | tee "$L/validate_$TS.log"
+echo "== 3. full benchmark (1b + 8b + long + batched sweep) — the BENCH_r04 record"
+# bench self-limits via BENCH_BUDGET_S (default 840, tuned for the driver's
+# `timeout 900`); hand it the full stage budget or the extra time is dead
+if [ "$SMOKE" != "1" ]; then export BENCH_BUDGET_S=1140; fi
+timeout 1200 python bench.py 2>&1 | tee "$L/bench_$TS.log" | tail -1
+if [ "$SMOKE" != "1" ]; then unset BENCH_BUDGET_S; fi
+probe || { echo "tunnel wedged after bench"; exit 1; }
 
-echo "== 3. kernel micro-bench suite (decode m=8 + prefill m=256/512, one process)"
-timeout 900 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/kbench.py suite $KB_ARGS 2>&1 | tee "$L/kbench_$TS.log"
+echo "== 4. kernel micro-bench suite (decode m=8 + prefill m=256/512 + tiles)"
+timeout 900 env PYTHONPATH="$PP" python experiments/kbench.py suite $KB_ARGS 2>&1 | tee "$L/kbench_$TS.log"
+probe || { echo "tunnel wedged after kbench"; exit 1; }
 
-echo "== 4. engine-knob A/B (1B, one process)"
-timeout 900 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/ebench.py $EB_N 2>&1 | tee "$L/ebench_$TS.log"
-
-echo "== 5. full benchmark (1b + 8b + long + batched sweep)"
-timeout 900 python bench.py 2>&1 | tee "$L/bench_$TS.log" | tail -1
+echo "== 5. engine-knob A/B (1B, one process)"
+timeout 900 env PYTHONPATH="$PP" python experiments/ebench.py $EB_N 2>&1 | tee "$L/ebench_$TS.log"
+probe || { echo "tunnel wedged after ebench"; exit 1; }
 
 echo "== 6. admission-stall A/B (8b serving tier, sync vs interleaved)"
-timeout 900 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/abench.py $AB_ARGS 2>&1 | tee "$L/abench_$TS.log"
+timeout 900 env PYTHONPATH="$PP" python experiments/abench.py $AB_ARGS 2>&1 | tee "$L/abench_$TS.log"
+probe || { echo "tunnel wedged after abench"; exit 1; }
+
+echo "== 7. kernel validation (per-group, each timeout-bounded)"
+VGROUPS="q40"
+if [ "$FLASH_OK" = "1" ]; then VGROUPS="q40 flash engine spec"; fi
+: >"$L/validate_$TS.log"
+VFAIL=0
+for g in $VGROUPS; do
+  # capture python's own exit status (a `| tee` would report tee's): a
+  # timeout-killed or crashed group must set VFAIL even with no FAIL marker
+  timeout 420 env PYTHONPATH="$PP" python experiments/tpu_validate.py "$g" >"$L/.vgroup_$TS.log" 2>&1 || VFAIL=1
+  cat "$L/.vgroup_$TS.log" >>"$L/validate_$TS.log"
+  cat "$L/.vgroup_$TS.log"
+  probe || { echo "tunnel wedged during validate $g"; exit 1; }
+done
+rm -f "$L/.vgroup_$TS.log"
+# the CI smoke asserts the ALL PASS marker for the whole stage
+if [ "$VFAIL" = "0" ] && ! grep -q FAIL "$L/validate_$TS.log"; then
+  echo "VALIDATE STAGE CLEAN (groups: $VGROUPS)"
+fi
 
 echo "== done; logs in $L/*_$TS.log"

@@ -12,16 +12,19 @@ while [ "$i" -lt 400 ]; do
   i=$((i + 1))
   # probe timeout must cover a live-but-slow tunnel's backend init (~120 s
   # measured); the short sleep keeps the window-catch latency low — a probe
-  # against a down tunnel just hangs until its timeout anyway.
-  if timeout 240 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
+  # against a down tunnel just hangs until its timeout anyway. COMPUTE probe,
+  # not device enumeration: the 2026-07-31 wedge passed jax.devices() while
+  # every execution RPC hung (TPU_VALIDATE_r04.md).
+  if timeout 240 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/probe.py \
       >>"$W" 2>&1; then
     echo "TUNNEL UP probe=$i $(date -u +%H:%M:%S)" >>"$W"
     sh experiments/tpu_session.sh >>experiments/logs/session.log 2>&1
     echo "SESSION DONE rc=$? $(date -u +%H:%M:%S)" >>"$W"
     # a window that died mid-session leaves no real TPU bench record —
-    # keep watching for another window instead of giving up for the round
-    if grep -l '"vs_baseline"' experiments/logs/bench_*.log 2>/dev/null \
-        | xargs grep -L '"tpu_unavailable": true' 2>/dev/null | grep -q .; then
+    # keep watching for another window instead of giving up for the round.
+    # A PARTIAL record (wedge mid-bench snapshot) is kept but doesn't end
+    # the watch either: the next window should produce the full sweep.
+    if sh experiments/watch_done.sh experiments/logs; then
       echo "TPU BENCH RECORDED; watcher exiting $(date -u +%H:%M:%S)" >>"$W"
       exit 0
     fi

@@ -65,6 +65,114 @@ def test_collectives_table_smoke():
     assert "FAILED" not in p.stdout, p.stdout
 
 
+def test_probe_smoke():
+    """The compute probe (tunnel gate for the watcher + every session stage)."""
+    p = _run(["experiments/probe.py"], {"PROBE_ALLOW_CPU": "1"})
+    assert p.returncode == 0, f"stdout:\n{p.stdout}\nstderr:\n{p.stderr[-2000:]}"
+    assert "PROBE OK" in p.stdout
+    # and without the escape hatch a CPU backend must NOT count as up
+    p = _run(["experiments/probe.py"])
+    assert p.returncode != 0
+
+
+def test_canary_flash_smoke():
+    p = _run(["experiments/canary_flash.py"])
+    assert p.returncode == 0, f"stdout:\n{p.stdout}\nstderr:\n{p.stderr[-2000:]}"
+    assert "FLASH CANARY OK" in p.stdout
+
+
+def test_tpu_validate_single_group():
+    """Per-group invocation (the session bounds each group's timeout so a
+    wedge costs one group, not the stage): q40 alone must pass and must not
+    touch flash/engine paths."""
+    p = _run(["experiments/tpu_validate.py", "q40"])
+    assert p.returncode == 0, f"stdout:\n{p.stdout}\nstderr:\n{p.stderr[-2000:]}"
+    assert "TOTAL ALL PASS" in p.stdout
+    assert "flash" not in p.stdout and "engine" not in p.stdout
+    # a typo'd group must error, not pass-with-zero-checks
+    p = _run(["experiments/tpu_validate.py", "q4O"])
+    assert p.returncode != 0 and "TOTAL ALL PASS" not in p.stdout
+
+
+def test_kbench_no_flash():
+    """--no-flash (set when the flash canary hangs) skips the flash section
+    but still delivers the q40 rows and the tile sweep."""
+    p = _run(["experiments/kbench.py", "suite", "--smoke", "--no-flash"])
+    assert p.returncode == 0, f"stdout:\n{p.stdout}\nstderr:\n{p.stderr[-2000:]}"
+    assert "flash bench SKIPPED" in p.stdout
+    assert "flash decode" not in p.stdout
+    assert "tile tk=" in p.stdout and "KBENCH DONE" in p.stdout
+
+
+def test_bench_partial_snapshot_recovery(tmp_path, monkeypatch, capsys):
+    """A tunnel wedge mid-bench blocks the worker forever inside one RPC; the
+    parent must then emit the worker's last partial snapshot instead of
+    degrading to the CPU fallback (losing every TPU number — the round-3
+    failure mode)."""
+    import json as _json
+
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    partial = {"metric": "tokens/sec/chip, PARTIAL", "value": 123.0,
+               "unit": "tok/s", "vs_baseline": 0.5, "partial": True}
+
+    def fake_run_worker(env, timeout_s):
+        # the worker "wedged" after snapshotting one preset
+        with open(env["BENCH_PARTIAL_PATH"], "w") as f:
+            _json.dump(partial, f)
+        return None
+
+    monkeypatch.setattr(bench, "probe_tpu", lambda t: True)
+    monkeypatch.setattr(bench, "run_worker", fake_run_worker)
+    monkeypatch.setenv("BENCH_ATTN", "auto")  # skip the parent's flash canary
+    snap = tmp_path / "partial.json"
+    monkeypatch.setenv("BENCH_PARTIAL_PATH", str(snap))
+    rc = bench.main()
+    assert rc == 0
+    out = capsys.readouterr().out
+    rec = _json.loads(out.strip().splitlines()[-1])
+    assert rec["value"] == 123.0 and rec.get("partial") is True
+    assert not snap.exists()  # consumed on recovery, not left to go stale
+
+
+def test_bench_worker_writes_partial_snapshot(tmp_path):
+    """The worker itself must snapshot as it goes (tiny preset, CPU)."""
+    part = tmp_path / "partial.json"
+    p = _run(["bench.py", "--worker"],
+             {"BENCH_PRESET": "tiny", "BENCH_DECODE_TOKENS": "8",
+              "BENCH_SPEC": "0", "BENCH_ADMIT": "0",
+              "BENCH_PARTIAL_PATH": str(part)}, timeout=600)
+    assert p.returncode == 0, f"stdout:\n{p.stdout}\nstderr:\n{p.stderr[-2000:]}"
+    import json as _json
+
+    rec = _json.loads(part.read_text())
+    assert rec["partial"] is True and rec["value"] > 0
+
+
+def test_watch_done_condition(tmp_path):
+    """The watcher's stop-watching condition (experiments/watch_done.sh):
+    only a FULL real-TPU bench record ends the watch — not an empty dir, not
+    a CPU fallback, not a wedge partial snapshot."""
+    def done():
+        return subprocess.run(
+            ["sh", "experiments/watch_done.sh", str(tmp_path)], cwd=REPO
+        ).returncode == 0
+
+    assert not done()  # no logs at all
+    (tmp_path / "bench_1.log").write_text(
+        '{"vs_baseline": 0.0, "tpu_unavailable": true}\n')
+    assert not done()  # CPU fallback record
+    (tmp_path / "bench_2.log").write_text(
+        '{"vs_baseline": 0.4, "partial": true}\n')
+    assert not done()  # wedge partial snapshot
+    (tmp_path / "bench_3.log").write_text('{"vs_baseline": 0.6}\n')
+    assert done()  # full TPU record
+
+
 def test_tpu_session_shell_end_to_end():
     """The WHOLE tpu_session.sh (shell plumbing: stage sequence, env, tee
     paths, timeouts) in smoke mode — a stage-wiring typo must fail CI, not a
@@ -77,8 +185,12 @@ def test_tpu_session_shell_end_to_end():
         text=True, timeout=2400, env=env,
     )
     assert p.returncode == 0, f"stdout:\n{p.stdout[-3000:]}\nstderr:\n{p.stderr[-2000:]}"
-    for marker in ("TOTAL ALL PASS", "KBENCH DONE", "EBENCH DONE fails=0",
-                   "ABENCH DONE fails=0", "== done"):
+    for marker in ("canary ok", "TOTAL ALL PASS", "KBENCH DONE",
+                   "EBENCH DONE fails=0", "ABENCH DONE fails=0",
+                   # the full group list: a failing canary would degrade
+                   # VGROUPS to just q40, which must not pass CI silently
+                   "VALIDATE STAGE CLEAN (groups: q40 flash engine spec)",
+                   "== done"):
         assert marker in p.stdout, f"missing {marker!r}:\n{p.stdout[-3000:]}"
 
 
