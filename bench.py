@@ -143,6 +143,10 @@ def main():
         repo = os.path.dirname(os.path.abspath(__file__))
         cenv = dict(os.environ)
         cenv["PYTHONPATH"] = repo + os.pathsep + cenv.get("PYTHONPATH", "")
+        # share the worker's compile cache so the canary's flash compile
+        # (~35-60s over the tunnel) is a cache hit for the worker
+        cenv.setdefault("JAX_COMPILATION_CACHE_DIR",
+                        os.path.join(repo, "experiments", "jax_cache"))
         c_out, _, c_rc = _run_child(
             [sys.executable, os.path.join(repo, "experiments", "canary_flash.py")],
             cenv, min(300.0, max(deadline - time.monotonic() - 240, 60)))
@@ -158,9 +162,10 @@ def main():
         # a tunnel WEDGE mid-measurement (2026-07-31 window, blocked forever
         # inside one RPC — deadline checks never run) then degrades to the
         # last snapshot instead of losing every TPU number to the timeout
-        partial_path = os.environ.get("BENCH_PARTIAL_PATH") or os.path.join(
-            os.path.dirname(os.path.abspath(__file__)),
-            "experiments", "logs", f"bench_partial_{os.getpid()}.json")
+        partial_path = os.path.abspath(
+            os.environ.get("BENCH_PARTIAL_PATH") or os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "experiments", "logs", f"bench_partial_{os.getpid()}.json"))
         os.makedirs(os.path.dirname(partial_path), exist_ok=True)
         try:  # never read a STALE snapshot (pid reuse across windows)
             os.remove(partial_path)
@@ -185,6 +190,7 @@ def main():
             except (OSError, ValueError):
                 pass
         if result is not None:
+            _save_last_tpu_record(result)
             print(json.dumps(result))
             return 0
         print("TPU worker failed; falling back to CPU record", file=sys.stderr)
@@ -205,9 +211,55 @@ def main():
             "metric": "decode tok/s (UNMEASURED: TPU tunnel down, CPU fallback failed)",
             "value": 0.0, "unit": "tok/s", "vs_baseline": 0.0,
         }
-    result["tpu_unavailable"] = not tpu_ok
+    # reaching here means the emitted numbers are CPU ones — mark the record
+    # unconditionally (watch_done.sh keys "keep watching" off this marker; a
+    # probe-ok-but-worker-wedged run must NOT read as a TPU record), keep the
+    # probe result as separate detail
+    result["tpu_unavailable"] = True
+    result["tpu_probe_ok"] = tpu_ok
+    # the tunnel being down at THIS run must not hide hardware evidence a
+    # watcher window already captured: attach the most recent real-TPU record
+    # (clearly labeled, headline value stays the honest CPU number)
+    last = _load_last_tpu_record()
+    if last is not None:
+        result["last_tpu_record"] = last
+        print("attached last_tpu_record from an earlier live window "
+              f"({last.get('recorded_at_utc', '?')})", file=sys.stderr)
     print(json.dumps(result))
     return 0
+
+
+def _last_tpu_path():
+    return os.environ.get("BENCH_LAST_TPU_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "experiments", "last_tpu_bench.json")
+
+
+def _save_last_tpu_record(result):
+    """Persist any real-TPU record (full or partial) so a later run against a
+    dead tunnel can still surface hardware evidence in its JSON."""
+    try:
+        rec = dict(result)
+        rec["recorded_at_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        # full records supersede partial ones; a partial never overwrites full
+        if rec.get("partial"):
+            old = _load_last_tpu_record()
+            if old is not None and not old.get("partial"):
+                return
+        path = _last_tpu_path()
+        with open(path + ".tmp", "w") as f:
+            json.dump(rec, f, indent=1)
+        os.replace(path + ".tmp", path)
+    except OSError:
+        pass  # evidence persistence must never fail a finished run
+
+
+def _load_last_tpu_record():
+    try:
+        with open(_last_tpu_path()) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 # --------------------------------------------------------------------- worker

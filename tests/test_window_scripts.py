@@ -131,12 +131,57 @@ def test_bench_partial_snapshot_recovery(tmp_path, monkeypatch, capsys):
     monkeypatch.setenv("BENCH_ATTN", "auto")  # skip the parent's flash canary
     snap = tmp_path / "partial.json"
     monkeypatch.setenv("BENCH_PARTIAL_PATH", str(snap))
+    # keep the recovered record's persistence out of the real repo file
+    monkeypatch.setenv("BENCH_LAST_TPU_PATH", str(tmp_path / "last.json"))
     rc = bench.main()
     assert rc == 0
     out = capsys.readouterr().out
     rec = _json.loads(out.strip().splitlines()[-1])
     assert rec["value"] == 123.0 and rec.get("partial") is True
     assert not snap.exists()  # consumed on recovery, not left to go stale
+
+
+def test_bench_last_tpu_record_attach(tmp_path, monkeypatch, capsys):
+    """A TPU record captured in an earlier watcher window must surface
+    (clearly labeled) in a later run against a dead tunnel, and a TPU
+    success must persist one."""
+    import json as _json
+
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    last = tmp_path / "last_tpu_bench.json"
+    monkeypatch.setenv("BENCH_LAST_TPU_PATH", str(last))
+    monkeypatch.setenv("BENCH_PARTIAL_PATH", str(tmp_path / "p.json"))
+
+    # 1. TPU success persists the record
+    full = {"metric": "tok/s", "value": 200.0, "unit": "tok/s",
+            "vs_baseline": 0.4}
+    monkeypatch.setattr(bench, "probe_tpu", lambda t: True)
+    monkeypatch.setattr(bench, "run_worker", lambda env, t: dict(full))
+    monkeypatch.setenv("BENCH_ATTN", "auto")
+    assert bench.main() == 0
+    capsys.readouterr()
+    saved = _json.loads(last.read_text())
+    assert saved["value"] == 200.0 and "recorded_at_utc" in saved
+
+    # a partial must not overwrite the full record
+    bench._save_last_tpu_record({"value": 1.0, "partial": True})
+    assert _json.loads(last.read_text())["value"] == 200.0
+
+    # 2. dead tunnel: CPU fallback attaches the persisted record
+    monkeypatch.setattr(bench, "probe_tpu", lambda t: False)
+    cpu_rec = {"metric": "cpu", "value": 5.0, "unit": "tok/s", "vs_baseline": 0.0}
+    monkeypatch.setattr(bench, "run_worker", lambda env, t: dict(cpu_rec))
+    monkeypatch.setenv("BENCH_BUDGET_S", "301")  # skip probe retry sleep
+    assert bench.main() == 0
+    out = capsys.readouterr().out
+    rec = _json.loads(out.strip().splitlines()[-1])
+    assert rec["tpu_unavailable"] is True
+    assert rec["last_tpu_record"]["value"] == 200.0
 
 
 def test_bench_worker_writes_partial_snapshot(tmp_path):
