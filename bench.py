@@ -189,6 +189,13 @@ def main():
                     result = partial
             except (OSError, ValueError):
                 pass
+        if result is not None and "tpu" not in str(result.get("device", "")).lower():
+            # the probe saw a TPU but the worker initialized JAX's CPU
+            # fallback (tunnel died in between): these are CPU numbers and
+            # must not masquerade as the round's TPU record
+            print("TPU worker ran on a non-TPU backend "
+                  f"({result.get('device')}); treating as fallback", file=sys.stderr)
+            result = None
         if result is not None:
             _save_last_tpu_record(result)
             print(json.dumps(result))
@@ -240,6 +247,11 @@ def _save_last_tpu_record(result):
     dead tunnel can still surface hardware evidence in its JSON."""
     try:
         rec = dict(result)
+        # a worker that silently fell back to JAX's CPU backend (tunnel died
+        # between probe and worker start) must not overwrite real hardware
+        # evidence with CPU numbers labeled as a TPU record
+        if "tpu" not in str(rec.get("device", "")).lower():
+            return
         rec["recorded_at_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         # full records supersede partial ones; a partial never overwrites full
         if rec.get("partial"):
@@ -247,9 +259,10 @@ def _save_last_tpu_record(result):
             if old is not None and not old.get("partial"):
                 return
         path = _last_tpu_path()
-        with open(path + ".tmp", "w") as f:
+        tmp = f"{path}.{os.getpid()}.tmp"  # watcher + manual runs can overlap
+        with open(tmp, "w") as f:
             json.dump(rec, f, indent=1)
-        os.replace(path + ".tmp", path)
+        os.replace(tmp, path)
     except OSError:
         pass  # evidence persistence must never fail a finished run
 
@@ -257,7 +270,8 @@ def _save_last_tpu_record(result):
 def _load_last_tpu_record():
     try:
         with open(_last_tpu_path()) as f:
-            return json.load(f)
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) else None
     except (OSError, ValueError):
         return None
 

@@ -9,6 +9,7 @@ between the latency and serving tiers.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from functools import partial
 from typing import Callable
@@ -70,6 +71,10 @@ def resolve_kernels(
             if sharded_pallas:
                 attn_fn = shardings.pallas_attn(batch, interpret=not on_tpu)
             elif attn_impl == "flash" or (on_tpu and shardings is None):
-                attn_fn = partial(flash_gqa_attention, interpret=not on_tpu)
+                attn_fn = partial(
+                    flash_gqa_attention, interpret=not on_tpu,
+                    # decode grids bucketed by live-context length (off until
+                    # the kbench depth sweep proves the no-op grid steps cost)
+                    s_buckets=os.environ.get("DLLAMA_FLASH_BUCKETS") == "1")
 
     return KernelSelection(mm=mm, mm_in=mm_in, attn_fn=attn_fn, backend=backend)

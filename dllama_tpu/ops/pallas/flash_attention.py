@@ -156,6 +156,22 @@ def _flash_folded(q, k, v, pos, *, group: int, hkv: int, interpret: bool,
     )(pos, q, k, v)
 
 
+def _s_buckets(s: int, t: int) -> tuple[int, ...]:
+    """Ascending static cache-view lengths for the bucketed grid: powers of
+    two from 512 up to S (each tileable per `supported`), always ending at S.
+    None/empty when bucketing can't help (short cache or a prefill chunk that
+    could span a bucket boundary)."""
+    if s <= 512 or t > 1:
+        return ()
+    out = []
+    b = 512
+    while b < s:
+        out.append(b)
+        b *= 2
+    out.append(s)
+    return tuple(out)
+
+
 def flash_gqa_attention(
     q: jax.Array,  # [B, T, Hq, hd]
     k_cache: jax.Array,  # [B, Hkv, S, hd]
@@ -163,8 +179,18 @@ def flash_gqa_attention(
     pos_base: jax.Array,  # i32 scalar or [B] per-row positions
     *,
     interpret: bool = False,
+    s_buckets: bool = False,
 ) -> jax.Array:
-    """Drop-in for ops.layers.gqa_attention (same signature/semantics)."""
+    """Drop-in for ops.layers.gqa_attention (same signature/semantics).
+
+    s_buckets: bucket the kv grid by live-context length. The KV-tile pruning
+    already elides dead tiles' DMA and compute, but the grid itself is static
+    in S — at 8 Ki context and small pos the kernel still issues ~S/ts no-op
+    grid steps per head per layer. With bucketing, decode dispatches
+    (lax.switch) to a kernel instance whose cache view is the smallest
+    power-of-two bucket covering pos+1, so the walked grid tracks the live
+    context. Off by default until the depth sweep (kbench flash) shows the
+    no-op steps cost real time; flip via DLLAMA_FLASH_BUCKETS=1."""
     b, t, hq, hd = q.shape
     hkv, s = k_cache.shape[1], k_cache.shape[2]
     group = hq // hkv
@@ -180,16 +206,25 @@ def flash_gqa_attention(
     if pad:
         qf = jnp.pad(qf, ((0, 0), (0, pad), (0, 0)))
     pos = jnp.broadcast_to(jnp.atleast_1d(jnp.asarray(pos_base, jnp.int32)), (b,))
-    out = _flash_folded(
-        qf,
-        k_cache.reshape(b * hkv, s, hd),
-        v_cache.reshape(b * hkv, s, hd),
-        pos,
-        group=group,
-        hkv=hkv,
-        interpret=interpret,
-        rows_live=rows,
-    )
+    kf = k_cache.reshape(b * hkv, s, hd)
+    vf = v_cache.reshape(b * hkv, s, hd)
+    call = functools.partial(_flash_folded, group=group, hkv=hkv,
+                             interpret=interpret, rows_live=rows)
+
+    buckets = _s_buckets(s, t) if s_buckets else ()
+    if len(buckets) > 1:
+        # every query row sees cache slots <= max(pos) + t - 1; the branch's
+        # static view must cover that horizon
+        horizon = jnp.max(pos) + t
+        idx = sum((horizon > be).astype(jnp.int32) for be in buckets[:-1])
+        out = jax.lax.switch(
+            idx,
+            [functools.partial(lambda se, qq, kk, vv, pp: call(
+                qq, kk[:, :se], vv[:, :se], pp), se) for se in buckets],
+            qf, kf, vf, pos,
+        )
+    else:
+        out = call(qf, kf, vf, pos)
     if pad:
         out = out[:, :rows]
     return (

@@ -110,6 +110,15 @@ def cases(full: bool):
     # f8 (e4m3) KV cache variant (--cache-dtype f8): half the cache DMA
     fn, args = flash((1, 1, 32, 128), 1024, jnp.float8_e4m3fn)
     out.append(("flash decode f8 KV cache", fn, args, True))
+    # bucketed grid (DLLAMA_FLASH_BUCKETS): lax.switch over pow-2 cache
+    # views — every branch is its own pallas_call instance, so Mosaic must
+    # accept all of them plus the switch wrapping
+    q8k = S((1, 1, 32, 128), jnp.bfloat16)
+    kv8k = S((1, 8, 8192, 128), jnp.bfloat16)
+    out.append(("flash decode bucketed S=8192",
+                lambda q, k, v: flash_gqa_attention(q, k, v, jnp.int32(7),
+                                                    s_buckets=True),
+                (q8k, kv8k, kv8k), True))
 
     from dllama_tpu.ops.pallas.rms_norm import rms_norm as prms
 

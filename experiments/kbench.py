@@ -326,6 +326,21 @@ def bench_flash_decode():
               f"(~linear = pruning works; ~flat = static-grid overhead dominates)")
     sys.stdout.flush()
 
+    # same depth sweep on the bucketed grid (DLLAMA_FLASH_BUCKETS): the
+    # lax.switch dispatches to a pow-2 cache view, so shallow positions walk
+    # a short grid instead of S/ts no-op steps. bucketed << static at small
+    # pos (and ~equal at pos ~= S) => flip the engine default
+    fnb = lambda q, k, v, p: flash_gqa_attention(q, k, v, p, interpret=INTERPRET,
+                                                 s_buckets=True)
+    for frac in (1 / 128, 1 / 8, 1 / 2, 63 / 64):
+        pos = max(1, int(s_long * frac))
+        try:
+            t = bench(fnb, (q, k, v, jnp.int32(pos)))
+            print(f"flash decode BUCKETED S={s_long} pos={pos}: {t*1e6:.0f}us")
+        except Exception as e:
+            print(f"flash decode BUCKETED S={s_long} pos={pos}: FAILED {e!r}"[:250])
+        sys.stdout.flush()
+
 
 def main():
     # argv: 'suite [--smoke] [--no-flash]' | 'flash [--smoke]' |

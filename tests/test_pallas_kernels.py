@@ -81,6 +81,27 @@ def test_flash_attention_matches_jnp(rng, b, t, hq, hkv, hd, s, pos):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("pos", [0, 511, 512, 800, 1023, 1500, 2047])
+def test_flash_attention_bucketed_matches_unbucketed(rng, pos):
+    """s_buckets dispatches decode to a power-of-two cache view covering
+    pos+1; output must be identical to the full-S grid at every position,
+    especially ON the bucket boundaries (pos+1 == 512 rides the 512 view,
+    pos+1 == 513 the 1024 one)."""
+    from dllama_tpu.ops.pallas.flash_attention import _s_buckets, flash_gqa_attention
+
+    assert _s_buckets(2048, 1) == (512, 1024, 2048)
+    assert _s_buckets(512, 1) == ()  # nothing to bucket
+    assert _s_buckets(2048, 16) == ()  # prefill chunks keep the static grid
+
+    q = jnp.asarray(rng.standard_normal((1, 1, 8, 64)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 4, 2048, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 4, 2048, 64)), jnp.float32)
+    want = flash_gqa_attention(q, k, v, jnp.int32(pos), interpret=True)
+    got = flash_gqa_attention(q, k, v, jnp.int32(pos), interpret=True,
+                              s_buckets=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=0, rtol=0)
+
+
 def test_flash_attention_bf16_io(rng):
     from dllama_tpu.ops.layers import gqa_attention
     from dllama_tpu.ops.pallas.flash_attention import flash_gqa_attention

@@ -118,7 +118,8 @@ def test_bench_partial_snapshot_recovery(tmp_path, monkeypatch, capsys):
         sys.path.pop(0)
 
     partial = {"metric": "tokens/sec/chip, PARTIAL", "value": 123.0,
-               "unit": "tok/s", "vs_baseline": 0.5, "partial": True}
+               "unit": "tok/s", "vs_baseline": 0.5, "partial": True,
+               "device": "TPU v5 lite0"}  # real snapshots carry the device
 
     def fake_run_worker(env, timeout_s):
         # the worker "wedged" after snapshotting one preset
@@ -159,7 +160,7 @@ def test_bench_last_tpu_record_attach(tmp_path, monkeypatch, capsys):
 
     # 1. TPU success persists the record
     full = {"metric": "tok/s", "value": 200.0, "unit": "tok/s",
-            "vs_baseline": 0.4}
+            "vs_baseline": 0.4, "device": "TPU v5 lite0"}
     monkeypatch.setattr(bench, "probe_tpu", lambda t: True)
     monkeypatch.setattr(bench, "run_worker", lambda env, t: dict(full))
     monkeypatch.setenv("BENCH_ATTN", "auto")
@@ -169,8 +170,31 @@ def test_bench_last_tpu_record_attach(tmp_path, monkeypatch, capsys):
     assert saved["value"] == 200.0 and "recorded_at_utc" in saved
 
     # a partial must not overwrite the full record
-    bench._save_last_tpu_record({"value": 1.0, "partial": True})
+    bench._save_last_tpu_record({"value": 1.0, "partial": True,
+                                 "device": "TPU v5 lite0"})
     assert _json.loads(last.read_text())["value"] == 200.0
+
+    # a CPU-backend record (worker fell back after the probe passed) must
+    # neither be persisted as TPU evidence nor read back as one
+    bench._save_last_tpu_record({"value": 2.0, "device": "TFRT_CPU_0"})
+    assert _json.loads(last.read_text())["value"] == 200.0
+    last.write_text("null")  # truncation-repaired file: tolerated, not trusted
+    assert bench._load_last_tpu_record() is None
+    last.write_text(_json.dumps(saved))
+
+    # a probe-ok-but-CPU-worker run must come out marked tpu_unavailable,
+    # not masquerade as the round's TPU record (watch_done.sh keys off this)
+    monkeypatch.setattr(
+        bench, "run_worker",
+        lambda env, t: {"metric": "cpu", "value": 3.0, "unit": "tok/s",
+                        "vs_baseline": 0.0, "device": "TFRT_CPU_0"})
+    monkeypatch.setenv("BENCH_BUDGET_S", "301")
+    assert bench.main() == 0
+    out = capsys.readouterr().out
+    rec = _json.loads(out.strip().splitlines()[-1])
+    assert rec["tpu_unavailable"] is True
+    assert rec["last_tpu_record"]["value"] == 200.0
+    monkeypatch.delenv("BENCH_BUDGET_S")
 
     # 2. dead tunnel: CPU fallback attaches the persisted record
     monkeypatch.setattr(bench, "probe_tpu", lambda t: False)
