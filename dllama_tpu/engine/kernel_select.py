@@ -73,8 +73,9 @@ def resolve_kernels(
             elif attn_impl == "flash" or (on_tpu and shardings is None):
                 attn_fn = partial(
                     flash_gqa_attention, interpret=not on_tpu,
-                    # decode grids bucketed by live-context length (off until
-                    # the kbench depth sweep proves the no-op grid steps cost)
+                    # kv grids bucketed by live-context length — decode steps
+                    # and early prefill chunks alike (off until the kbench
+                    # depth sweep proves the no-op grid steps cost)
                     s_buckets=os.environ.get("DLLAMA_FLASH_BUCKETS") == "1")
 
     return KernelSelection(mm=mm, mm_in=mm_in, attn_fn=attn_fn, backend=backend)

@@ -156,12 +156,13 @@ def _flash_folded(q, k, v, pos, *, group: int, hkv: int, interpret: bool,
     )(pos, q, k, v)
 
 
-def _s_buckets(s: int, t: int) -> tuple[int, ...]:
+def _s_buckets(s: int) -> tuple[int, ...]:
     """Ascending static cache-view lengths for the bucketed grid: powers of
     two from 512 up to S (each tileable per `supported`), always ending at S.
-    None/empty when bucketing can't help (short cache or a prefill chunk that
-    could span a bucket boundary)."""
-    if s <= 512 or t > 1:
+    Empty when the cache is too short to bucket. Valid for decode AND prefill
+    chunks: the dispatch horizon is max(pos) + t, so a chunk ending inside
+    bucket k rides bucket k's view and the causal mask handles the rest."""
+    if s <= 512:
         return ()
     out = []
     b = 512
@@ -186,11 +187,13 @@ def flash_gqa_attention(
     s_buckets: bucket the kv grid by live-context length. The KV-tile pruning
     already elides dead tiles' DMA and compute, but the grid itself is static
     in S — at 8 Ki context and small pos the kernel still issues ~S/ts no-op
-    grid steps per head per layer. With bucketing, decode dispatches
+    grid steps per head per layer. With bucketing, the call dispatches
     (lax.switch) to a kernel instance whose cache view is the smallest
-    power-of-two bucket covering pos+1, so the walked grid tracks the live
-    context. Off by default until the depth sweep (kbench flash) shows the
-    no-op steps cost real time; flip via DLLAMA_FLASH_BUCKETS=1."""
+    power-of-two bucket covering max(pos)+t, so the walked grid tracks the
+    live context — for decode steps and for the early chunks of a long
+    chunked prefill alike. Off by default until the depth sweep (kbench
+    flash) shows the no-op steps cost real time; flip via
+    DLLAMA_FLASH_BUCKETS=1."""
     b, t, hq, hd = q.shape
     hkv, s = k_cache.shape[1], k_cache.shape[2]
     group = hq // hkv
@@ -211,7 +214,7 @@ def flash_gqa_attention(
     call = functools.partial(_flash_folded, group=group, hkv=hkv,
                              interpret=interpret, rows_live=rows)
 
-    buckets = _s_buckets(s, t) if s_buckets else ()
+    buckets = _s_buckets(s) if s_buckets else ()
     if len(buckets) > 1:
         # every query row sees cache slots <= max(pos) + t - 1; the branch's
         # static view must cover that horizon

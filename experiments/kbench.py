@@ -341,6 +341,19 @@ def bench_flash_decode():
             print(f"flash decode BUCKETED S={s_long} pos={pos}: FAILED {e!r}"[:250])
         sys.stdout.flush()
 
+    # prefill-chunk-at-shallow-depth A/B: an early chunk of a long chunked
+    # prefill (pos=256, t=256) sees <= 512 live slots but statically walks
+    # all of S — bucketing rides the 512 view instead
+    tq_pf = 64 if INTERPRET else 256
+    qp = jnp.asarray(rng.standard_normal((1, tq_pf, 32, hd)), jnp.bfloat16)
+    for name, f in (("static", fn), ("BUCKETED", fnb)):
+        try:
+            t = bench(f, (qp, k, v, jnp.int32(tq_pf)))
+            print(f"flash prefill t={tq_pf} {name} S={s_long} pos={tq_pf}: {t*1e6:.0f}us")
+        except Exception as e:
+            print(f"flash prefill {name}: FAILED {e!r}"[:250])
+        sys.stdout.flush()
+
 
 def main():
     # argv: 'suite [--smoke] [--no-flash]' | 'flash [--smoke]' |

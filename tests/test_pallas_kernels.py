@@ -81,19 +81,23 @@ def test_flash_attention_matches_jnp(rng, b, t, hq, hkv, hd, s, pos):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
 
 
-@pytest.mark.parametrize("pos", [0, 511, 512, 800, 1023, 1500, 2047])
-def test_flash_attention_bucketed_matches_unbucketed(rng, pos):
-    """s_buckets dispatches decode to a power-of-two cache view covering
-    pos+1; output must be identical to the full-S grid at every position,
-    especially ON the bucket boundaries (pos+1 == 512 rides the 512 view,
-    pos+1 == 513 the 1024 one)."""
+@pytest.mark.parametrize("t,pos", [
+    (1, 0), (1, 511), (1, 512), (1, 800), (1, 1023), (1, 1500), (1, 2047),
+    # prefill chunks: horizon = pos + t picks the covering view, incl. a
+    # chunk that ENDS exactly on / just past a bucket boundary
+    (16, 0), (16, 496), (16, 497), (64, 960), (64, 1980),
+])
+def test_flash_attention_bucketed_matches_unbucketed(rng, t, pos):
+    """s_buckets dispatches to a power-of-two cache view covering
+    max(pos)+t; output must be identical to the full-S grid at every
+    position, especially ON the bucket boundaries (horizon 512 rides the
+    512 view, horizon 513 the 1024 one)."""
     from dllama_tpu.ops.pallas.flash_attention import _s_buckets, flash_gqa_attention
 
-    assert _s_buckets(2048, 1) == (512, 1024, 2048)
-    assert _s_buckets(512, 1) == ()  # nothing to bucket
-    assert _s_buckets(2048, 16) == ()  # prefill chunks keep the static grid
+    assert _s_buckets(2048) == (512, 1024, 2048)
+    assert _s_buckets(512) == ()  # nothing to bucket
 
-    q = jnp.asarray(rng.standard_normal((1, 1, 8, 64)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((1, t, 8, 64)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((1, 4, 2048, 64)), jnp.float32)
     v = jnp.asarray(rng.standard_normal((1, 4, 2048, 64)), jnp.float32)
     want = flash_gqa_attention(q, k, v, jnp.int32(pos), interpret=True)
