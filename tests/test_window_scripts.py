@@ -65,6 +65,37 @@ def test_collectives_table_smoke():
     assert "FAILED" not in p.stdout, p.stdout
 
 
+def test_hbm_traffic_smoke():
+    p = _run(["experiments/hbm_traffic.py", "--smoke"])
+    assert p.returncode == 0, f"stdout:\n{p.stdout}\nstderr:\n{p.stderr[-2000:]}"
+    assert "HBM TRAFFIC DONE" in p.stdout, p.stdout
+    assert "FAILED" not in p.stdout, p.stdout
+
+
+def test_q40_weight_floor_math():
+    """The artifact's floor must equal the .m file's actual Q40 byte count
+    for the same tensors (packed nibbles + f16 scales, writer parity)."""
+    sys.path.insert(0, REPO)
+    try:
+        from experiments.hbm_traffic import PRESETS, q40_weight_bytes
+        from dllama_tpu.models import formats
+        from dllama_tpu.ops.quant import FloatType
+    finally:
+        sys.path.pop(0)
+
+    cfg = PRESETS["tiny"]
+    floor = q40_weight_bytes(cfg)
+    want = 0
+    for _name, shape, ft in formats.tensor_plan(cfg):
+        if ft != FloatType.Q40:
+            continue  # f32 tensors (embedding, norms) aren't the Q40 stream
+        n = 1
+        for d in shape:
+            n *= d
+        want += n * 18 // 32  # 16 packed + 2 scale bytes per 32 weights
+    assert floor == want and floor > 0, (floor, want)
+
+
 def test_probe_smoke():
     """The compute probe (tunnel gate for the watcher + every session stage)."""
     p = _run(["experiments/probe.py"], {"PROBE_ALLOW_CPU": "1"})
