@@ -35,10 +35,11 @@ Usage: python experiments/hbm_traffic.py [--smoke] [--md HBM_TRAFFIC.md]
 
 from __future__ import annotations
 
+import os
 import sys
 from functools import partial
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -115,6 +116,30 @@ def kernel_stream_bytes(cfg: LlamaConfig, live_frac: float = 1.0) -> int:
     return total
 
 
+def batched_step_bytes(cfg: LlamaConfig, slots: int, live_frac: float = 1.0,
+                       cache_bytes_per_el: int = 2) -> int:
+    """Per-STEP HBM bytes of a `slots`-wide batched decode (BatchEngine):
+    the weight stream is read once and serves every slot (the entire point
+    of the serving tier), while the KV stream scales with slots — each
+    slot's cache rows are its own. Activation rows scale with slots but
+    stay negligible. cache_bytes_per_el=1 models the f8 KV cache."""
+    L, d, h, kv, hd = (cfg.n_layers, cfg.dim, cfg.hidden_dim, cfg.kv_dim,
+                       cfg.head_size)
+    m = max(8, slots)  # one fused step: all slots are rows of one matmul
+    weights = q40_weight_bytes(cfg)
+    acts = 0
+
+    def mm_act(k, n):
+        return m * k * 2 + m * n * 4
+
+    acts += (mm_act(d, d) * 2 + mm_act(d, kv) * 2
+             + mm_act(d, h) * 2 + mm_act(h, d)) * L + mm_act(d, cfg.vocab_size)
+    kv_stream = int(2 * slots * cfg.n_kv_heads * cfg.seq_len * hd
+                    * cache_bytes_per_el * live_frac) * L
+    kv_write = 2 * slots * kv * cache_bytes_per_el * L
+    return weights + acts + kv_stream + kv_write + slots * d * 2
+
+
 def abstract_model(cfg: LlamaConfig, sharding):
     A = lambda shape, dt: jax.ShapeDtypeStruct(shape, dt, sharding=sharding)
 
@@ -184,7 +209,10 @@ def main():
     show_undercount = "--show-xla-undercount" in sys.argv
     md_path = None
     if "--md" in sys.argv:
-        md_path = sys.argv[sys.argv.index("--md") + 1]
+        i = sys.argv.index("--md") + 1
+        if i >= len(sys.argv):
+            raise SystemExit("usage: hbm_traffic.py [--smoke] [--md OUTPUT.md]")
+        md_path = sys.argv[i]
 
     presets = ["tiny"] if smoke else ["1b", "8b"]
     topo = None
@@ -222,6 +250,11 @@ def main():
             ca = compile_step(cfg, topo, backend="xla", style=None,
                               on_cpu=on_cpu)
             by = ca.get("bytes accessed", 0.0)
+            if not by:
+                # a cost-analysis schema change must not be committed as a
+                # "the dequant path moves zero bytes" measurement
+                raise RuntimeError(
+                    f"cost_analysis returned no 'bytes accessed' ({sorted(ca)[:8]})")
             rows.append((f"{preset} xla dequant-dot", by, floor,
                          by / V5E_HBM_GBS / 1e6, "compiler (post-fusion HLO)"))
         except Exception as e:
@@ -232,6 +265,25 @@ def main():
             if by is not None:
                 print(f"{label}: bytes/token={by/1e9:.3f}GB floor={floor_/1e9:.3f}GB "
                       f"({by/floor_:.2f}x) roofline={ms:.2f}ms [{how}]")
+        sys.stdout.flush()
+
+    # batched serving tier (the vs_baseline number): the weight stream is
+    # read once per STEP and serves every slot, so aggregate tok/s scales
+    # until the per-slot KV stream takes over — this is the committed
+    # roofline the 8b slot sweep (BENCH batch records) is judged against
+    batched = []
+    if not smoke:
+        cfg = PRESETS["8b"]
+        for slots, cache_el, tag in ((8, 2, "bf16 KV"), (32, 2, "bf16 KV"),
+                                     (48, 2, "bf16 KV"), (48, 1, "f8 KV"),
+                                     (96, 1, "f8 KV")):
+            by = batched_step_bytes(cfg, slots, live_frac=0.5,
+                                    cache_bytes_per_el=cache_el)
+            step_ms = by / V5E_HBM_GBS / 1e6
+            agg = slots / step_ms * 1000
+            batched.append((f"8b {slots} slots ({tag})", by, step_ms, agg))
+            print(f"8b batched {slots} slots {tag}: {by/1e9:.2f}GB/step "
+                  f"{step_ms:.2f}ms -> {agg:.0f} tok/s aggregate roofline")
         sys.stdout.flush()
 
     if md_path and not smoke:
@@ -259,6 +311,17 @@ def main():
                 else:
                     f.write(f"| {label} | {by/1e9:.3f} GB | {floor_/1e9:.3f} GB "
                             f"| {by/floor_:.2f}x | {ms:.2f} ms | {how} |\n")
+            f.write(
+                "\n## Batched serving roofline (8b, cache half full)\n\n"
+                "One fused step reads the weight stream once for ALL slots;\n"
+                "only the KV stream scales with slots. Aggregate tok/s =\n"
+                "slots / step-time. The north star (BASELINE.json,\n"
+                "1000 tok/s/chip serving) is judged on this tier.\n\n"
+                "| case | bytes/step | step roofline | aggregate tok/s roofline |\n"
+                "|---|---|---|---|\n")
+            for label, by, step_ms, agg in batched:
+                f.write(f"| {label} | {by/1e9:.2f} GB | {step_ms:.2f} ms "
+                        f"| {agg:.0f} |\n")
             f.write(
                 "\nReading the table: the fused decode tier sits within a\n"
                 "few percent of the physical Q40 floor plus the live KV\n"

@@ -72,9 +72,14 @@ def test_hbm_traffic_smoke():
     assert "FAILED" not in p.stdout, p.stdout
 
 
-def test_q40_weight_floor_math():
-    """The artifact's floor must equal the .m file's actual Q40 byte count
-    for the same tensors (packed nibbles + f16 scales, writer parity)."""
+def test_q40_weight_floor_matches_written_file(tmp_path):
+    """The artifact's floor must equal the Q40 bytes a real .m file carries:
+    write the tiny preset through the actual writer and compare the on-disk
+    payload — file size minus header minus the non-Q40 (f32) tensor bytes —
+    against q40_weight_bytes. Independent of the tensor_plan loop the floor
+    itself uses."""
+    import numpy as np
+
     sys.path.insert(0, REPO)
     try:
         from experiments.hbm_traffic import PRESETS, q40_weight_bytes
@@ -84,16 +89,18 @@ def test_q40_weight_floor_math():
         sys.path.pop(0)
 
     cfg = PRESETS["tiny"]
+    rng = np.random.default_rng(0)
+    tensors = {n: (rng.standard_normal(s) * 0.05).astype(np.float32)
+               for n, s, _ in formats.tensor_plan(cfg)}
+    path = tmp_path / "tiny.m"
+    formats.save_model(str(path), cfg, tensors)
+    _cfg2, header_size = formats.read_header(str(path))
+    f32_bytes = sum(
+        FloatType.F32.nbytes(int(np.prod(shape)))
+        for _n, shape, ft in formats.tensor_plan(cfg) if ft == FloatType.F32)
+    on_disk_q40 = path.stat().st_size - header_size - f32_bytes
     floor = q40_weight_bytes(cfg)
-    want = 0
-    for _name, shape, ft in formats.tensor_plan(cfg):
-        if ft != FloatType.Q40:
-            continue  # f32 tensors (embedding, norms) aren't the Q40 stream
-        n = 1
-        for d in shape:
-            n *= d
-        want += n * 18 // 32  # 16 packed + 2 scale bytes per 32 weights
-    assert floor == want and floor > 0, (floor, want)
+    assert floor == on_disk_q40 > 0, (floor, on_disk_q40)
 
 
 def test_probe_smoke():
