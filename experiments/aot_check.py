@@ -124,6 +124,29 @@ def cases(full: bool):
 
     out.append(("rms_norm (reserve)", lambda x, w: prms(x, w, 1e-5),
                 (S((8, 2048), jnp.bfloat16), S((2048,), jnp.bfloat16)), False))
+
+    # MoE compute schemes: no Pallas inside, but `sort` leans on
+    # lax.ragged_dot and `dispatch` on .at[].add scatters — both exotic
+    # enough on XLA:TPU that the gate must cover them before any default
+    # flip (VERDICT r3 weak #6)
+    from dllama_tpu.models.config import LlamaConfig
+    from dllama_tpu.ops.layers import moe_ffn
+
+    mcfg = LlamaConfig(dim=1024, hidden_dim=2048, n_layers=2, n_heads=8,
+                       n_kv_heads=4, vocab_size=512, seq_len=64,
+                       n_experts=8, n_active_experts=2)
+    moe_args = (S((1, 64, 1024), jnp.bfloat16), S((1024, 8), jnp.float32),
+                S((8, 1024, 2048), jnp.bfloat16),
+                S((8, 2048, 1024), jnp.bfloat16),
+                S((8, 1024, 2048), jnp.bfloat16))
+    # production flags follow the auto resolution: sort (n >= E) and dense
+    # (n < E, e.g. B=1 decode) are the shipped paths; dispatch is window-A/B
+    # insurance only
+    for impl in ("sort", "dispatch", "dense"):
+        out.append((f"moe {impl} (8 experts, 64 tokens)",
+                    lambda h, g, w1, w2, w3, impl=impl: moe_ffn(
+                        mcfg, h, g, w1, w2, w3, impl=impl),
+                    moe_args, impl != "dispatch"))
     return out
 
 
