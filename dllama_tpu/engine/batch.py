@@ -64,6 +64,10 @@ class BatchEngine:
         moe_impl: str = "auto",  # 'auto' | 'dispatch' | 'sort' | 'dense' (ops.layers.moe_ffn)
         fuse_weights: bool = False,  # wqkv/w13 fused launches (unsharded only,
         # same contract as InferenceEngine)
+        spec: int = 0,  # K-token prompt-lookup speculative decoding for the
+        # batch (spec_step); 0 = off. Greedy slots emit 1..K+1 exact-argmax
+        # tokens per verify forward; sampled slots advance exactly 1.
+        spec_ngram: int = 2,
     ):
         from dllama_tpu.ops.layers import build_rope_cache
 
@@ -134,6 +138,23 @@ class BatchEngine:
         )
         self._copy_rows = jax.jit(self._copy_rows_impl, donate_argnums=(0,))
 
+        # batched speculative decoding (see spec_step): per-slot on-device
+        # token history feeds the n-gram proposer; one verify forward per
+        # cycle serves every slot
+        self.spec_k = int(spec)
+        if self.spec_k:
+            if shardings is not None and shardings.mesh.shape["dp"] > 1:
+                # history rows are slot-indexed on the host admission path;
+                # a dp mesh shards the slot axis
+                raise ValueError("spec batching supports unsharded/tp engines")
+            self.history = jnp.full((n_slots, self.seq_len + 1), -1, jnp.int32)
+            self._spec_step = jax.jit(
+                partial(self._spec_step_impl, cfg, attn_fn, self._col_fn, mm,
+                        mm_in, moe_impl, self.spec_k, spec_ngram),
+                donate_argnums=(1, 2),
+            )
+            self._hist_write = jax.jit(self._hist_write_impl, donate_argnums=(0,))
+
     # ------------------------------------------------------------- jitted fns
 
     @staticmethod
@@ -190,6 +211,88 @@ class BatchEngine:
         return toks, cache, keys
 
     @staticmethod
+    def _spec_step_impl(cfg, attn_fn, col_fn, mm, mm_in, moe_impl, k, ngram,
+                        params, cache, history, cur, pos_vec, active, keys,
+                        temps, topps, rope):
+        """One batched propose/verify cycle (engine/speculative.py, lifted to
+        per-slot vectors). Greedy slots (temperature == 0) draft k tokens by
+        prompt lookup over their own history row and emit the longest
+        model-agreed prefix + bonus — bit-identical to fused greedy decode —
+        while sampled slots advance exactly 1 token from their offset-0
+        logits with their own PRNG key (exact sampling semantics; the
+        (k+1)-wide forward costs them nothing extra since decode is
+        HBM-bound). Rejected drafts leave stale KV rows past each slot's live
+        position; the per-row causal mask never reads them."""
+        from dllama_tpu.engine.speculative import propose_ngram
+
+        draft = jax.vmap(
+            lambda h, ln: propose_ngram(h, ln, k, ngram)[0]
+        )(history, pos_vec + 1)  # [B, k]
+        toks = jnp.concatenate([cur[:, None], draft], axis=1)  # [B, k+1]
+        logits, cache = forward(cfg, params, toks, pos_vec, cache, rope, attn_fn,
+                                active=active, col_fn=col_fn, mm=mm, mm_in=mm_in,
+                                moe_impl=moe_impl, last_only=False)
+        g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, k+1]
+        agree = jnp.cumprod((draft == g[:, :k]).astype(jnp.int32), axis=1)
+        a = jnp.sum(agree, axis=1)  # [B] accepted draft prefix length
+
+        greedy_slot = temps == 0.0
+        splits = jax.vmap(jax.random.split)(keys)
+        keys_next, subs = splits[:, 0], splits[:, 1]
+        samp = _sample_rows(logits[:, 0], subs, temps, topps)  # [B]
+        a = jnp.where(greedy_slot, a, 0)
+        # only slots that actually consumed a sample advance their key:
+        # greedy slots never touch theirs, and a frozen slot (inactive this
+        # cycle — e.g. near seq_len) must keep its seed-pinned stream intact
+        # for the decode() that finishes it
+        keys = jnp.where((greedy_slot | ~active)[:, None], keys, keys_next)
+        emit = jnp.where(greedy_slot[:, None], g,
+                         jnp.concatenate([samp[:, None], g[:, 1:]], axis=1))
+
+        # the emitted tokens are ALSO the history entries at pos+1..pos+k+1
+        # (entries past the new live position are garbage that is never read
+        # below the slot's length and overwritten when really decoded)
+        hist2 = jax.vmap(
+            lambda h, e, p: jax.lax.dynamic_update_slice(h, e, (p,))
+        )(history, emit, pos_vec + 1)
+        history = jnp.where(active[:, None], hist2, history)
+
+        adv = jnp.where(active, a + 1, 0)  # tokens each slot emitted
+        nxt = jnp.take_along_axis(emit, a[:, None], axis=1)[:, 0]
+        nxt = jnp.where(active, nxt, cur)
+        return emit, adv, nxt, cache, history, keys
+
+    @staticmethod
+    def _hist_write_impl(history, slot, pos, toks):
+        """Write toks into history[slot, pos:pos+len] (admission chunks and
+        the first sampled token; traced slot/pos, len static per chunk)."""
+        row = jax.lax.dynamic_index_in_dim(history, slot, axis=0, keepdims=False)
+        row = jax.lax.dynamic_update_slice(row, toks, (pos,))
+        return jax.lax.dynamic_update_index_in_dim(history, row, slot, axis=0)
+
+    @staticmethod
+    @jax.jit
+    def _hist_write_batch(history, toks, pos_vec, active):
+        """history[i, pos[i]+1 : pos[i]+1+n] = toks[i] for active slots —
+        decode() backfills its emitted tokens so later spec_step drafting
+        keeps full n-gram coverage."""
+        upd = jax.vmap(
+            lambda h, t, p: jax.lax.dynamic_update_slice(h, t, (p,))
+        )(history, toks, pos_vec + 1)
+        return jnp.where(active[:, None], upd, history)
+
+    @staticmethod
+    @jax.jit
+    def _hist_copy_prefix(history, src, dst, rows):
+        """history[dst, :rows] = history[src, :rows] without per-length
+        recompiles (masked full-row copy, mirrors _copy_rows_impl)."""
+        s = history.shape[1]
+        src_row = jax.lax.dynamic_index_in_dim(history, src, axis=0, keepdims=False)
+        dst_row = jax.lax.dynamic_index_in_dim(history, dst, axis=0, keepdims=False)
+        merged = jnp.where(jnp.arange(s) < rows, src_row, dst_row)
+        return jax.lax.dynamic_update_index_in_dim(history, merged, dst, axis=0)
+
+    @staticmethod
     def _copy_rows_impl(cache, src, dst, rows):
         """Copy the first `rows` cache rows of slot src into slot dst (both
         k and v, all layers/heads). Static shapes: the whole [S] row axis is
@@ -227,6 +330,13 @@ class BatchEngine:
         self.cache = self._copy_rows(
             self.cache, jnp.int32(src_slot), jnp.int32(dst_slot), jnp.int32(rows)
         )
+        if self.spec_k:
+            # the shared prefix's token ids come along so the n-gram
+            # proposer can draft from it in the new slot too (masked full-row
+            # copy: one compile serves every prefix length)
+            self.history = self._hist_copy_prefix(
+                self.history, jnp.int32(src_slot), jnp.int32(dst_slot),
+                jnp.int32(rows))
         self.pos[dst_slot] = rows
 
     # ------------------------------------------------------------------- api
@@ -256,6 +366,13 @@ class BatchEngine:
         True when every prompt token's KV row is written."""
         n, off, slot = len(adm.toks), adm.off, adm.slot
         c = pow2_chunk(n - off, self.max_prefill_chunk)
+        if self.spec_k:
+            # the n-gram proposer drafts from the prompt too — that's the
+            # whole point of prompt lookup
+            self.history = self._hist_write(
+                self.history, jnp.int32(slot), jnp.int32(self.pos[slot]),
+                jnp.asarray(adm.toks[off : off + c]),
+            )
         if self._use_slot_prefill:
             row, self.cache = self._prefill_slot(
                 self.params, self.cache,
@@ -311,6 +428,12 @@ class BatchEngine:
         self.last_token[slot] = first
         self.temperature[slot] = temperature
         self.topp[slot] = topp
+        if self.spec_k:
+            # invariant: history[slot, pos] holds the slot's unfed token
+            self.history = self._hist_write(
+                self.history, jnp.int32(slot), jnp.int32(self.pos[slot]),
+                jnp.full((1,), first, jnp.int32),
+            )
         return first
 
     def add(self, slot: int, prompt_tokens: list[int], temperature: float = 0.8,
@@ -349,9 +472,55 @@ class BatchEngine:
         )
         toks = np.asarray(toks)
         self.keys = np.array(keys)  # writable copy — add() mutates rows
+        if self.spec_k:
+            # keep the spec history current: decode's tokens land at
+            # pos+1..pos+n per slot (pos still pre-advance here)
+            self.history = self._hist_write_batch(
+                self.history, jnp.asarray(toks.T.copy()),
+                jnp.asarray(self.pos.copy(), jnp.int32),
+                jnp.asarray(self.active.copy()),
+            )
         self.pos[self.active] += n
         self.last_token[self.active] = toks[-1, self.active]
         return toks
+
+    def spec_step(self) -> tuple[np.ndarray, np.ndarray]:
+        """One speculative verify cycle across the batch: returns
+        (tokens [B, K+1], counts [B]) where each active slot emitted
+        tokens[i, :counts[i]] this cycle — 1..K+1 exact-greedy tokens for
+        temperature==0 slots, exactly 1 exactly-sampled token otherwise.
+        Costs ~one decode step (the forward is HBM-bound; K+1 rows ride the
+        same weight stream), so greedy acceptance multiplies batch tok/s.
+
+        Slots within K+1 rows of seq_len are frozen for the cycle (their KV
+        writes would overflow); finish those with decode()/release(). The
+        reference decodes strictly one token per forward per request
+        (dllama.cpp:69-88) and its server has no batching at all — this is
+        both lifted to the serving tier at once."""
+        if not self.spec_k:
+            raise ValueError("engine built with spec=0")
+        if not self.active.any():
+            raise ValueError("no active slots")
+        room_ok = self.pos + self.spec_k + 1 <= self.seq_len
+        eff = self.active & room_ok
+        if not eff.any():
+            raise ValueError("no active slot has room for a spec cycle; "
+                             "use decode() or release the full slots")
+        emit, adv, nxt, self.cache, self.history, keys = self._spec_step(
+            self.params, self.cache, self.history,
+            jnp.asarray(self.last_token.copy()),
+            jnp.asarray(self.pos.copy(), jnp.int32),
+            jnp.asarray(eff.copy()),
+            jnp.asarray(self.keys.copy()),
+            jnp.asarray(self.temperature.copy()),
+            jnp.asarray(self.topp.copy()),
+            self.rope_cache,
+        )
+        emit, adv = np.asarray(emit), np.asarray(adv)
+        self.keys = np.array(keys)
+        self.pos += adv
+        self.last_token = np.array(nxt)
+        return emit, adv
 
     def release(self, slot: int, keep_rows: int | None = None) -> None:
         """Free a slot. keep_rows rewinds pos to the valid prefix (mid-chunk

@@ -364,14 +364,20 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
     device). n_slots == 0 keeps the single-engine tier with the NaiveCache
     prefix reuse (the reference server's semantics)."""
     scheduler = None
-    if n_slots > 0 and int(defaults.get("spec", 0)) > 0:
-        log.warning("--spec applies to the single-engine tier only; the "
-                    "continuous-batching tier (--slots %d) decodes without "
-                    "speculation", n_slots)
     if n_slots > 0:
         from dllama_tpu.engine.batch import BatchEngine
         from dllama_tpu.serve.scheduler import Scheduler
 
+        # batched speculation: greedy requests emit 1..K+1 tokens per verify
+        # cycle, sampled requests decode exactly as before. dp meshes shard
+        # the slot axis, which the per-slot history path doesn't support —
+        # degrade to plain batched decode there instead of failing startup.
+        spec_n = int(defaults.get("spec", 0))
+        if (spec_n and loaded.shardings is not None
+                and loaded.shardings.mesh.shape["dp"] > 1):
+            log.warning("--spec is unavailable on dp>1 meshes; the "
+                        "continuous-batching tier decodes without speculation")
+            spec_n = 0
         be = BatchEngine(
             loaded.config,
             loaded.engine.params,
@@ -380,6 +386,7 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
             max_seq_len=loaded.engine.seq_len,
             shardings=loaded.shardings,  # multi-chip serving keeps the mesh placement
             sync=getattr(loaded, "sync", "bf16"),
+            spec=spec_n,
         )
         scheduler = Scheduler(be)
     api = ApiServer(

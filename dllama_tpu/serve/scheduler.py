@@ -353,8 +353,19 @@ class Scheduler:
                     self._admit_gaps_ms.append(gap_ms)
                     del self._admit_gaps_ms[:-256]
             start_rows = {s: int(self.engine.pos[s]) for s in self.slots}
+            # speculative cycle when every in-flight slot has a K+1 window of
+            # cache room; otherwise a plain chunk advances the near-full
+            # slots to their length finish (spec_step freezes them, which
+            # would livelock here)
+            use_spec = bool(getattr(self.engine, "spec_k", 0)) and all(
+                start_rows[s] + self.engine.spec_k + 1 <= self.engine.seq_len
+                for s in self.slots
+            )
             try:
-                toks = self.engine.decode(self.chunk)
+                if use_spec:
+                    emit_toks, adv = self.engine.spec_step()
+                else:
+                    toks = self.engine.decode(self.chunk)
             except Exception as e:
                 log.exception("decode failed; failing all in-flight requests")
                 for req in list(self.slots.values()):
@@ -362,11 +373,12 @@ class Scheduler:
                     self._finish(req, "error")
                 continue
             self._t_dec_end = time.monotonic()
-            n = toks.shape[0]
             for slot, req in list(self.slots.items()):
+                n = int(adv[slot]) if use_spec else toks.shape[0]
                 for i in range(n):
                     # row written when sampling token i: start + i (+1 = prefix len)
-                    if self._emit(req, toks[i, slot], start_rows[slot] + i + 1):
+                    tok = emit_toks[slot, i] if use_spec else toks[i, slot]
+                    if self._emit(req, tok, start_rows[slot] + i + 1):
                         break
         for req, adm, _ in self._inflight:
             self._abort_admission(req, adm, "shutdown")

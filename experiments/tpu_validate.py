@@ -182,5 +182,39 @@ if "spec" in GROUPS:
         failures.append("spec")
         print(f"FAIL speculative (compile/run): {str(e)[:400]}", flush=True)
 
+    # batched speculative decode: the serving tier's per-slot propose/verify
+    # cycle must match fused multi-slot greedy decode on-chip
+    try:
+        from dllama_tpu.engine.batch import BatchEngine
+
+        prompts = {0: [1, 2, 3, 1, 2, 3], 2: [7, 6, 5, 7, 6]}
+        streams = {}
+        for use_spec in (False, True):
+            be = BatchEngine(cfg, params, n_slots=3, cache_dtype=jnp.bfloat16,
+                             kernels="pallas", spec=4 if use_spec else 0)
+            got = {s_: [be.add(s_, p_, temperature=0.0)] for s_, p_ in prompts.items()}
+            if use_spec:
+                cyc = 0
+                while any(len(v) < 9 for v in got.values()) and cyc < 40:
+                    emit, adv = be.spec_step()
+                    cyc += 1
+                    for s_ in prompts:
+                        got[s_] += [int(t) for t in emit[s_, : adv[s_]]]
+            else:
+                toks = be.decode(8)
+                for s_ in prompts:
+                    got[s_] += [int(t) for t in toks[:, s_]]
+            streams[use_spec] = {s_: v[:9] for s_, v in got.items()}
+        if streams[True] == streams[False]:
+            print(f"PASS batched speculative parity ({time.time() - t_start:.0f}s)",
+                  flush=True)
+        else:
+            failures.append("spec-batch")
+            print(f"FAIL batched spec parity: {streams[True]} != {streams[False]}",
+                  flush=True)
+    except Exception as e:
+        failures.append("spec-batch")
+        print(f"FAIL batched speculative (compile/run): {str(e)[:400]}", flush=True)
+
 print("TOTAL", "FAIL " + ",".join(failures) if failures else "ALL PASS", flush=True)
 sys.exit(1 if failures else 0)

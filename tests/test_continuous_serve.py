@@ -302,3 +302,36 @@ def test_scheduler_prefix_cache_reuses_slot_rows():
         assert warm == cold
     finally:
         sched.shutdown()
+
+
+def test_scheduler_spec_matches_plain_greedy():
+    """The scheduler's speculative cycles must stream the same greedy tokens
+    as plain chunked decode, including the near-seq_len fallback to
+    decode() (spec_step freezes slots without a K+1 window)."""
+    import jax.numpy as jnp
+
+    from dllama_tpu.engine.batch import BatchEngine
+    from dllama_tpu.models.config import LlamaConfig
+    from dllama_tpu.models.llama import random_params
+    from dllama_tpu.serve.scheduler import Scheduler
+
+    cfg = LlamaConfig(dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                      vocab_size=96, seq_len=64)
+    params = random_params(cfg, seed=4, dtype=jnp.float32, quantize=False)
+    prompt = [1, 2, 3, 1, 2, 3, 1, 2]
+
+    def run(spec):
+        eng = BatchEngine(cfg, params, n_slots=2, cache_dtype=jnp.float32,
+                          spec=spec)
+        sched = Scheduler(eng, chunk=4)
+        try:
+            # budget large enough that the request runs into the seq_len
+            # region where spec_step would freeze the slot (pos > 64-K-1)
+            req = sched.submit(prompt, 0.0, 0.9, 54, eos_ids=frozenset())
+            return list(req.tokens()), req.finish_reason
+        finally:
+            sched.shutdown()
+
+    want, want_fin = run(0)
+    got, got_fin = run(6)
+    assert got == want and got_fin == want_fin == "length"
