@@ -72,7 +72,10 @@ def load_model(
     # model never materializes on one device (VERDICT r1 weak #2).
     put = shardings.param_put if shardings is not None else None
     params = load_params(
-        model_path, cfg, header_size, dtype=jnp.bfloat16, dequantize=dequantize, put=put
+        model_path, cfg, header_size, dtype=jnp.bfloat16, dequantize=dequantize, put=put,
+        # Q80 weights stay packed (int8 + f16 scales, fused Pallas matmuls)
+        # on unsharded engines; the mesh slicers keep the dense-bf16 path
+        q80_packed=shardings is None,
     )
     tokenizer = Tokenizer.load(tokenizer_path) if tokenizer_path else None
     if tokenizer is not None and tokenizer.regular_vocab_size > cfg.vocab_size:

@@ -31,6 +31,7 @@ from dllama_tpu.models.config import MODEL_MAGIC, LlamaConfig
 from dllama_tpu.ops.quant import (
     FloatType,
     Q_BLOCK,
+    Q8Tensor,
     QTensor,
     dequantize_q40_np,
     dequantize_q80_np,
@@ -279,8 +280,8 @@ class LazyQ40Stack:
 
 
 def _load_matmul(raw: np.ndarray, shape: tuple[int, int], ft: FloatType, dtype, dequantize: bool,
-                 lazy: bool = False):
-    """File [out, in] -> host-resident x@W operand: QTensor or dense [in, out]."""
+                 lazy: bool = False, q80_packed: bool = False):
+    """File [out, in] -> host-resident x@W operand: QTensor/Q8Tensor or dense [in, out]."""
     n_out, k_in = shape
     if ft == FloatType.Q40 and not dequantize:
         if lazy:
@@ -289,6 +290,14 @@ def _load_matmul(raw: np.ndarray, shape: tuple[int, int], ft: FloatType, dtype, 
         scales = rec[:, :2].copy().view(np.float16)
         packed = rec[:, 2:]
         return QTensor.from_file_layout(packed, scales, n_out, k_in, device=False)
+    if ft == FloatType.Q80 and q80_packed and not dequantize:
+        # keep Q80 weights packed on device (int8 + f16 scales, 1.0625
+        # bytes/weight vs 2 for the dense fallback); unsharded engines only —
+        # the mesh slicers know QTensor/dense layouts, not Q8Tensor
+        rec = raw.reshape(n_out * k_in // Q_BLOCK, 2 + Q_BLOCK)
+        scales = rec[:, :2].copy().view(np.float16)
+        codes = rec[:, 2:].view(np.int8)
+        return Q8Tensor.from_file_layout(codes, scales, n_out, k_in, device=False)
     return decode_dense(raw, shape, ft).T.astype(dtype, order="C")
 
 
@@ -310,6 +319,7 @@ def load_params(
     dtype=jnp.bfloat16,
     dequantize: bool = False,
     put: Callable[[str, object], object] | None = None,
+    q80_packed: bool = False,
 ):
     """Load the full parameter pytree.
 
@@ -340,7 +350,8 @@ def load_params(
         elif name in ("final_norm",):
             params["final_norm"] = put(name, decode_dense(raw, shape, ft))
         elif name == "wcls":
-            params["wcls"] = put(name, _load_matmul(raw, shape, ft, dtype, dequantize, lazy=True))
+            params["wcls"] = put(name, _load_matmul(raw, shape, ft, dtype, dequantize,
+                                                    lazy=True, q80_packed=q80_packed))
         else:
             _, _, short = name.split(".")
             if short in ("rms_att", "rms_ffn"):
@@ -351,7 +362,8 @@ def load_params(
             elif short.startswith("moe_"):
                 leaf = _load_expert_matmul(raw, shape, ft, dtype, dequantize)
             else:
-                leaf = _load_matmul(raw, shape, ft, dtype, dequantize, lazy=True)
+                leaf = _load_matmul(raw, shape, ft, dtype, dequantize, lazy=True,
+                                    q80_packed=q80_packed)
             layer_acc.setdefault(short, []).append(leaf)
 
     layers = {}

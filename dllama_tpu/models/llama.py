@@ -149,12 +149,18 @@ def fuse_layer_weights(layers: dict) -> dict:
     output dim last. Unsharded engines only — under tp the q and kv blocks
     shard at different granularity, so fused weights would mis-slice.
     Dense (unquantized) leaves concatenate the same way."""
-    from dllama_tpu.ops.quant import QTensor
+    from dllama_tpu.ops.quant import Q8Tensor, QTensor
 
     def cat(*ws):
         if isinstance(ws[0], QTensor):
             return QTensor(
                 jnp.concatenate([w.packed for w in ws], axis=-1),
+                jnp.concatenate([w.scales for w in ws], axis=-1),
+            )
+        if isinstance(ws[0], Q8Tensor):
+            # same output-dim-last layout argument as QTensor
+            return Q8Tensor(
+                jnp.concatenate([w.codes for w in ws], axis=-1),
                 jnp.concatenate([w.scales for w in ws], axis=-1),
             )
         return jnp.concatenate(ws, axis=-1)

@@ -26,7 +26,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from dllama_tpu.ops.quant import QTensor, slice_leaf
+from dllama_tpu.ops.quant import Q8Tensor, QTensor, slice_leaf
 
 # module-level backend switch; the CLI sets this once at startup.
 BACKEND = "auto"
@@ -76,35 +76,45 @@ def engine_matmul(kernels: str, shardings) -> "functools.partial":
     return functools.partial(matmul, backend=backend)
 
 
+def _route_xla_prefill(x: jax.Array) -> bool:
+    """Prefill-GEMM routing rule, shared by the Q40 and Q80 fused paths.
+
+    Prefill-shaped only (ADVICE r3): model activations are [b, t, d], so
+    t > 1 distinguishes prefill from batched decode — a 64-slot decode step
+    must NOT lose the packed-weights bandwidth win just because its
+    flattened m crosses the threshold. 2-D calls (no seq axis) are
+    decode-shaped by construction."""
+    if XLA_PREFILL_MIN_M is None or not (x.ndim >= 3 and x.shape[-2] > 1):
+        return False
+    m = 1
+    for d in x.shape[:-1]:
+        m *= d
+    return m >= XLA_PREFILL_MIN_M
+
+
 def matmul(x: jax.Array, w, layer=None, backend: str | None = None) -> jax.Array:
-    """``x @ w`` (or ``x @ w[layer]``) where ``w`` is a QTensor or dense array.
+    """``x @ w`` (or ``x @ w[layer]``) where ``w`` is a QTensor/Q8Tensor or
+    dense array.
 
     x: [..., k] activations (bf16/f32); returns [..., n] in x.dtype.
     ``layer``: traced index into a layer-stacked weight ([L, k, n] logical) —
     the Pallas path indexes the stack via DMA, the XLA path slices it.
     """
-    if isinstance(w, QTensor):
+    if isinstance(w, (QTensor, Q8Tensor)):
         if resolve_backend(backend) == "pallas":
-            from dllama_tpu.ops.pallas.q40_matmul import q40_matmul, supported
+            # Q80 gets the same fused treatment as Q40 (1.0625 B/weight
+            # streamed vs 2 for the dense-bf16 fallback), same routing rule
+            if isinstance(w, QTensor):
+                from dllama_tpu.ops.pallas.q40_matmul import q40_matmul as kernel
+                from dllama_tpu.ops.pallas.q40_matmul import supported
+            else:
+                from dllama_tpu.ops.pallas.q80_matmul import q80_matmul as kernel
+                from dllama_tpu.ops.pallas.q80_matmul import supported
 
-            m = 1
-            for d in x.shape[:-1]:
-                m *= d
-            # prefill-shaped only (ADVICE r3): model activations are [b, t, d],
-            # so t > 1 distinguishes prefill from batched decode — a 64-slot
-            # decode step must NOT lose the packed-weights bandwidth win just
-            # because its flattened m crosses the threshold. 2-D calls (no seq
-            # axis) are decode-shaped by construction.
-            prefill_shaped = x.ndim >= 3 and x.shape[-2] > 1
-            route_xla = (
-                XLA_PREFILL_MIN_M is not None
-                and prefill_shaped
-                and m >= XLA_PREFILL_MIN_M
-            )
-            if supported(x.shape, w) and not route_xla:
+            if supported(x.shape, w) and not _route_xla_prefill(x):
                 interp = INTERPRET if INTERPRET is not None else _platform() != "tpu"
-                return q40_matmul(x, w, layer, interpret=interp)
-        if layer is not None and w.packed.ndim == 3:
+                return kernel(x, w, layer, interpret=interp)
+        if layer is not None and len(w.shape) == 3:
             w = slice_leaf(w, layer)
         wd = w.dequantize(x.dtype)
     else:

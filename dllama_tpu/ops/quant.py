@@ -241,8 +241,55 @@ class QTensor:
         return w.reshape(*lead, k, n).astype(dtype)
 
 
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Q8Tensor:
+    """A Q80 2-D weight for ``x @ W`` with ``W: [k_in, n_out]`` logical shape.
+
+    ``codes: i8[k, n]``, ``scales: f16[k/32, n]`` — same lane-major layout
+    rationale as :class:`QTensor` (the output dim rides the 128-wide lanes).
+    1.0625 bytes/weight in HBM vs bf16's 2 — the reference runs Q80-weight
+    models natively (nn-quants.hpp Q80 rows); this keeps them packed on
+    device instead of the dense-bf16 fallback."""
+
+    codes: jax.Array  # i8 [(L,) k, n]
+    scales: jax.Array  # f16 [(L,) k//32, n] (f32 accepted for hand-built)
+
+    def tree_flatten(self):
+        return (self.codes, self.scales), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        """Logical [..., k, n] (leading axes = layer/expert stacking)."""
+        return tuple(self.codes.shape)
+
+    @classmethod
+    def from_file_layout(cls, codes: np.ndarray, scales: np.ndarray, n_out: int,
+                         k_in: int, device: bool = True) -> "Q8Tensor":
+        """Build from the `.m` on-disk layout: blocks row-major over
+        [n_out, k_in] (mirrors QTensor.from_file_layout)."""
+        codes = codes.reshape(n_out, k_in)
+        scales = scales.reshape(n_out, k_in // Q_BLOCK)
+        codes = np.ascontiguousarray(codes.T)
+        scales = np.ascontiguousarray(scales.T, dtype=np.float16)
+        if not device:
+            return cls(codes, scales)
+        return cls(jnp.asarray(codes), jnp.asarray(scales))
+
+    def dequantize(self, dtype=jnp.float32) -> jax.Array:
+        """Pure-jnp reference dequant -> [..., k, n] (the XLA fallback path)."""
+        *lead, k, n = self.shape
+        c = self.codes.astype(jnp.float32).reshape(*lead, k // Q_BLOCK, Q_BLOCK, n)
+        w = c * self.scales.reshape(*lead, k // Q_BLOCK, 1, n).astype(jnp.float32)
+        return w.reshape(*lead, k, n).astype(dtype)
+
+
 def slice_leaf(w, li):
-    """One layer's slice of a stacked weight leaf (QTensor or dense array).
+    """One layer's slice of a stacked weight leaf (QTensor/Q8Tensor or dense).
 
     The single place that knows how to index a stacked QTensor — callers that
     must materialize a per-layer slice (XLA matmul path, q80 col_fn, MoE
@@ -250,6 +297,8 @@ def slice_leaf(w, li):
     site to update."""
     if isinstance(w, QTensor):
         return QTensor(w.packed[li], w.scales[li])
+    if isinstance(w, Q8Tensor):
+        return Q8Tensor(w.codes[li], w.scales[li])
     return w[li]
 
 

@@ -354,12 +354,18 @@ class Scheduler:
                     del self._admit_gaps_ms[:-256]
             start_rows = {s: int(self.engine.pos[s]) for s in self.slots}
             # speculative cycle when every in-flight slot has a K+1 window of
-            # cache room; otherwise a plain chunk advances the near-full
-            # slots to their length finish (spec_step freezes them, which
-            # would livelock here)
-            use_spec = bool(getattr(self.engine, "spec_k", 0)) and all(
-                start_rows[s] + self.engine.spec_k + 1 <= self.engine.seq_len
-                for s in self.slots
+            # cache room AND at least one slot is greedy (sampled slots never
+            # accept drafts, so an all-sampled batch would pay the (K+1)-wide
+            # forward for one token per cycle); otherwise a plain chunk
+            # advances the near-full slots to their length finish (spec_step
+            # freezes them, which would livelock here)
+            use_spec = (
+                bool(getattr(self.engine, "spec_k", 0))
+                and any(float(self.engine.temperature[s]) == 0.0 for s in self.slots)
+                and all(
+                    start_rows[s] + self.engine.spec_k + 1 <= self.engine.seq_len
+                    for s in self.slots
+                )
             )
             try:
                 if use_spec:

@@ -92,6 +92,18 @@ def cases(full: bool):
                 style_case(f"blockdot tiles tk={tk} tn={tn}", "blockdot",
                            8, 2048, 8192, False, tk=tk, tn=tn)
 
+    # q80 fused matmuls (packed int8 weights, the Q80-file fast path): the
+    # same decode/prefill split as q40, production on unsharded engines
+    from dllama_tpu.ops.pallas.q80_matmul import q80_matmul
+    from dllama_tpu.ops.quant import Q8Tensor
+
+    q8w = Q8Tensor(S((L, 2048, 8192), jnp.int8), S((L, 2048 // Q_BLOCK, 8192), jnp.uint16))
+    for q8m in (8, 256):
+        out.append((f"q80 {'blockdot' if q8m <= 16 else 'deq'} m={q8m} w1(2048x8192)",
+                    lambda x, l, c, s: q80_matmul(x, Q8Tensor(c, s), l),
+                    (S((q8m, 2048), jnp.bfloat16), S((), jnp.int32),
+                     q8w.codes, q8w.scales), True))
+
     # flash attention: decode (t=1, group=4 folded+pad) and prefill shapes
     from dllama_tpu.ops.pallas.flash_attention import flash_gqa_attention
 

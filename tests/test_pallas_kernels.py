@@ -244,3 +244,44 @@ class TestDispatchKnobs:
         ref = np.asarray(w.dequantize(jnp.float32), np.float32)
         want = np.asarray(x, np.float32) @ ref
         np.testing.assert_allclose(got, want, atol=3e-2, rtol=3e-2)
+
+
+# ---------------------------------------------------------------- q80 matmul
+
+
+@pytest.mark.parametrize("m", [1, 8, 64])
+def test_q80_matmul_matches_dequant_dot(rng, m):
+    """Fused Q80 kernels (blockdot m<=16, deq m>16) vs the XLA dequant dot."""
+    from dllama_tpu.ops.pallas.q80_matmul import q80_matmul, supported
+    from dllama_tpu.ops.quant import Q8Tensor, quantize_q80_np
+
+    k, n = 128, 256
+    w = (rng.standard_normal((n, k)) * 0.1).astype(np.float32)
+    codes, scales = quantize_q80_np(w.reshape(-1))
+    qt = Q8Tensor.from_file_layout(codes, scales, n, k)
+    assert supported((m, k), qt)
+    x = jnp.asarray(rng.standard_normal((m, k)), jnp.float32)
+    got = q80_matmul(x, qt, interpret=True)
+    want = jnp.dot(x, qt.dequantize(jnp.float32))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-3, rtol=1e-3)
+
+
+def test_q80_matmul_stacked_layer_index(rng):
+    from dllama_tpu.ops.pallas.q80_matmul import q80_matmul
+    from dllama_tpu.ops.quant import Q8Tensor, quantize_q80_np
+
+    k, n, L = 128, 128, 3
+    layers = []
+    for _ in range(L):
+        w = (rng.standard_normal((n, k)) * 0.1).astype(np.float32)
+        codes, scales = quantize_q80_np(w.reshape(-1))
+        layers.append(Q8Tensor.from_file_layout(codes, scales, n, k))
+    st = Q8Tensor(jnp.stack([l.codes for l in layers]),
+                  jnp.stack([l.scales for l in layers]))
+    x = jnp.asarray(rng.standard_normal((8, k)), jnp.float32)
+    for li in range(L):
+        got = q80_matmul(x, st, jnp.int32(li), interpret=True)
+        want = jnp.dot(x, layers[li].dequantize(jnp.float32))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-3, rtol=1e-3)

@@ -143,3 +143,56 @@ def test_q80_weight_model_file_end_to_end(tmp_path, rng):
         np.array([[int(np.argmax(np.asarray(logits)))]]), 6
     )
     assert toks.shape == (6, 1)
+
+
+def test_q8tensor_dequant_matches_numpy(rng):
+    """Q8Tensor.from_file_layout + dequantize == the numpy Q80 codec."""
+    import jax.numpy as jnp
+
+    n_out, k_in = 8, 128
+    w = (rng.standard_normal((n_out, k_in)) * 0.1).astype(np.float32)
+    codes, scales = quant.quantize_q80_np(w.reshape(-1))
+    qt = quant.Q8Tensor.from_file_layout(codes, scales, n_out, k_in)
+    want = quant.dequantize_q80_np(codes, scales).reshape(n_out, k_in).T
+    np.testing.assert_allclose(np.asarray(qt.dequantize(jnp.float32)), want,
+                               atol=0, rtol=0)
+    assert qt.shape == (k_in, n_out)
+    # stacked slice_leaf
+    st = quant.Q8Tensor(np.stack([np.asarray(qt.codes)] * 3),
+                        np.stack([np.asarray(qt.scales)] * 3))
+    sl = quant.slice_leaf(st, 1)
+    np.testing.assert_array_equal(np.asarray(sl.codes), np.asarray(qt.codes))
+
+
+def test_q80_packed_load_matches_dense_path(tmp_path, rng):
+    """load_params(q80_packed=True) keeps Q80 weights as Q8Tensor; the
+    engine's logits must match the dense-bf16 load bit-for-bit on the XLA
+    path (dequantize is exact in f32)."""
+    import jax.numpy as jnp
+
+    from dllama_tpu.engine.engine import InferenceEngine
+    from dllama_tpu.models import formats
+    from dllama_tpu.models.config import LlamaConfig
+
+    cfg = LlamaConfig(dim=128, hidden_dim=256, n_layers=2, n_heads=4,
+                      n_kv_heads=2, vocab_size=128, seq_len=64,
+                      weight_type=quant.FloatType.Q80)
+    tensors = {
+        name: (rng.standard_normal(shape) * 0.05).astype(np.float32)
+        for name, shape, _ in formats.tensor_plan(cfg)
+    }
+    path = str(tmp_path / "q80p.m")
+    formats.save_model(path, cfg, tensors)
+    cfg2, hs = formats.read_header(path)
+
+    dense = formats.load_params(path, cfg2, hs, dtype=jnp.float32)
+    packed = formats.load_params(path, cfg2, hs, dtype=jnp.float32,
+                                 q80_packed=True)
+    assert isinstance(packed["wcls"], quant.Q8Tensor)
+    assert isinstance(packed["layers"]["wq"], quant.Q8Tensor)
+    toks = np.array([[1, 2, 3]], np.int32)
+    ld = np.asarray(InferenceEngine(cfg2, dense, cache_dtype=jnp.float32,
+                                    kernels="xla").prefill(toks))
+    lp = np.asarray(InferenceEngine(cfg2, packed, cache_dtype=jnp.float32,
+                                    kernels="xla").prefill(toks))
+    np.testing.assert_allclose(lp, ld, atol=2e-5, rtol=2e-5)
