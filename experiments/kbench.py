@@ -188,6 +188,19 @@ def run_one(m, label, variants):
                     (x, w),
                 )
                 rows.append(("E xla-deq", t, qbytes))
+            elif v == "Q8":
+                # fused Q80 path (Q8Tensor): int8 codes + f16 scales,
+                # 1.0625 B/weight streamed — same dispatch split as q40
+                from dllama_tpu.ops.pallas.q80_matmul import q80_matmul
+                from dllama_tpu.ops.quant import Q8Tensor, quantize_q80_np
+
+                rng8 = np.random.default_rng(0)
+                w8f = (rng8.standard_normal((n, k)) * 0.02).astype(np.float32)
+                codes, scales = quantize_q80_np(w8f.reshape(-1))
+                w8 = Q8Tensor.from_file_layout(codes, scales, n, k)
+                q8bytes = k * n + (k // Q_BLOCK) * n * 2
+                t = bench(lambda x, w8=w8: q80_matmul(x, w8, interpret=INTERPRET), (x,))
+                rows.append(("Q8 q80-fused", t, q8bytes))
             else:
                 raise SystemExit(f"unknown variant {v!r}; see module docstring")
         except SystemExit:
@@ -204,10 +217,11 @@ def run_one(m, label, variants):
 
 SUITE = [
     # decode shapes: the production dispatch + each forced style + rooflines
-    (8, "w1", ["A", "BD", "MD", "LD", "DQ", "D", "E"]),
+    # (+ Q8: the fused Q80-weight path at the same shape)
+    (8, "w1", ["A", "BD", "MD", "LD", "DQ", "D", "E", "Q8"]),
     (8, "wcls", ["A", "D", "E"]),  # the lm head is ~18% of 1B weight bytes
     # prefill shapes: in-kernel deq vs the XLA dequant-dot the MXU loves
-    (256, "w1", ["DQ", "D", "E"]),
+    (256, "w1", ["DQ", "D", "E", "Q8"]),
     (512, "w1", ["DQ", "D", "E"]),
 ]
 
@@ -228,9 +242,9 @@ def enable_smoke():
         "wcls": (128, 512),
     }
     SUITE = [
-        (8, "w1", ["A", "BD", "MD", "LD", "DQ", "B", "D", "E"]),
+        (8, "w1", ["A", "BD", "MD", "LD", "DQ", "B", "D", "E", "Q8"]),
         (8, "wcls", ["A", "D", "E"]),
-        (32, "w1", ["DQ", "D", "E"]),
+        (32, "w1", ["DQ", "D", "E", "Q8"]),
     ]
     SWEEP_TK = (32, 64)
     SWEEP_TN = (128,)

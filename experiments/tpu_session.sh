@@ -92,8 +92,8 @@ timeout 900 env PYTHONPATH="$PP" python experiments/abench.py $AB_ARGS 2>&1 | te
 probe || { echo "tunnel wedged after abench"; exit 1; }
 
 echo "== 7. kernel validation (per-group, each timeout-bounded)"
-VGROUPS="q40"
-if [ "$FLASH_OK" = "1" ]; then VGROUPS="q40 flash engine spec"; fi
+VGROUPS="q40 q80"
+if [ "$FLASH_OK" = "1" ]; then VGROUPS="q40 q80 flash engine spec"; fi
 : >"$L/validate_$TS.log"
 VFAIL=0
 for g in $VGROUPS; do

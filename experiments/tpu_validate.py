@@ -11,7 +11,7 @@ Checks, each vs the XLA reference:
 Prints PASS/FAIL per item; exits nonzero on any FAIL.
 
 Usage: python experiments/tpu_validate.py [GROUP ...]
-GROUPs: q40 flash engine spec (default: all). The session script runs each
+GROUPs: q40 q80 flash engine spec (default: all). The session script runs each
 group as its own `timeout`-bounded process so a tunnel wedge (the
 2026-07-31 window died at the first flash compile, TPU_VALIDATE_r04.md)
 costs one group's timeout, not the whole stage.
@@ -21,7 +21,7 @@ import time
 
 import numpy as np
 
-_KNOWN_GROUPS = ("q40", "flash", "engine", "spec")
+_KNOWN_GROUPS = ("q40", "q80", "flash", "engine", "spec")
 GROUPS = [a for a in sys.argv[1:] if not a.startswith("-")] or list(_KNOWN_GROUPS)
 _bad = set(GROUPS) - set(_KNOWN_GROUPS)
 if _bad:
@@ -73,6 +73,27 @@ if "q40" in GROUPS:
             print(f"FAIL q40 {style} m={m} (compile/run): {str(e)[:400]}", flush=True)
         finally:
             qmod.STYLE = "auto"
+
+if "q80" in GROUPS:
+    # fused Q80 path (Q8Tensor int8 kernels) — both dispatch tiers on-chip;
+    # its own timeout-bounded group so a wedge here cannot take q40 down
+    from dllama_tpu.ops.pallas.q80_matmul import q80_matmul
+    from dllama_tpu.ops.quant import Q8Tensor, quantize_q80_np
+
+    w8f = (rng.standard_normal((N, K)) * 0.05).astype(np.float32)
+    codes, scales = quantize_q80_np(w8f.reshape(-1))
+    w8 = Q8Tensor.from_file_layout(codes, scales, N, K)
+    w8d = w8.dequantize(jnp.float32)
+    for m in (8, 128):
+        x = jnp.asarray(rng.standard_normal((m, K)), jnp.bfloat16)
+        try:
+            got = q80_matmul(x, w8, interpret=_interp)
+            want = jnp.dot(x, w8d.astype(jnp.bfloat16),
+                           preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+            check(f"q80 {'blockdot' if m <= 16 else 'deq'} m={m}", got, want)
+        except Exception as e:
+            failures.append(f"q80-m{m}")
+            print(f"FAIL q80 m={m} (compile/run): {str(e)[:400]}", flush=True)
 
 if "flash" in GROUPS:
     # flash attention with pruning

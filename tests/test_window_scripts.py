@@ -296,7 +296,7 @@ def test_tpu_session_shell_end_to_end():
                    "EBENCH DONE fails=0", "ABENCH DONE fails=0",
                    # the full group list: a failing canary would degrade
                    # VGROUPS to just q40, which must not pass CI silently
-                   "VALIDATE STAGE CLEAN (groups: q40 flash engine spec)",
+                   "VALIDATE STAGE CLEAN (groups: q40 q80 flash engine spec)",
                    "== done"):
         assert marker in p.stdout, f"missing {marker!r}:\n{p.stdout[-3000:]}"
 
