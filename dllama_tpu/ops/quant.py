@@ -255,6 +255,15 @@ class Q8Tensor:
     codes: jax.Array  # i8 [(L,) k, n]
     scales: jax.Array  # f16 [(L,) k//32, n] (f32 accepted for hand-built)
 
+    @classmethod
+    def quantize(cls, w) -> "Q8Tensor":
+        """f32[k, n] -> Q8Tensor (numpy path; tests/benches/converters — the
+        one construction site, like QTensor.quantize)."""
+        w = np.asarray(w, dtype=np.float32)
+        n_out = w.shape[1]
+        codes, scales = quantize_q80_np(np.ascontiguousarray(w.T).reshape(-1))
+        return cls.from_file_layout(codes, scales, n_out, w.shape[0])
+
     def tree_flatten(self):
         return (self.codes, self.scales), None
 

@@ -84,7 +84,8 @@ def q40_weight_bytes(cfg: LlamaConfig) -> int:
     return total
 
 
-def kernel_stream_bytes(cfg: LlamaConfig, live_frac: float = 1.0) -> int:
+def kernel_stream_bytes(cfg: LlamaConfig, live_frac: float = 1.0,
+                        weight_bytes_per: float = 18 / 32) -> int:
     """Per-decode-token HBM bytes of the fused-Pallas step, from the
     BlockSpec DMA contract (ops/pallas/q40_matmul.py, flash_attention.py):
 
@@ -103,7 +104,9 @@ def kernel_stream_bytes(cfg: LlamaConfig, live_frac: float = 1.0) -> int:
     total = 0
 
     def mm(k, n):
-        return (k // 2) * n + (k // Q_BLOCK) * n * 2 + m * k * 2 + m * n * 4
+        # weight_bytes_per covers packed codes + scales: 18/32 for Q40
+        # (nibbles + f16 scales), 34/32 for Q80 (int8 + f16 scales)
+        return int(k * n * weight_bytes_per) + m * k * 2 + m * n * 4
 
     per_layer = (mm(d, d) * 2 + mm(d, kv) * 2  # wq, wo, wk, wv
                  + mm(d, h) * 2 + mm(h, d)  # w1, w3 (d->h); w2 (h->d)
@@ -245,6 +248,17 @@ def main():
             rows.append((f"{preset} fused pallas", None, floor, None, ""))
             print(f"{preset} pallas: FAILED {e!r}"[:300])
 
+        # Q80-weight variant of the same model (34/32 B/weight fused vs the
+        # 2 B/weight dense-bf16 fallback meshes still use) — DMA-contract
+        # accounting like the Q40 rows; Mosaic acceptance of the q80 kernels
+        # is covered by MOSAIC_AOT.md
+        if preset == "8b":
+            q80_floor = int(floor / (18 / 32) * (34 / 32))
+            for wb, tag in ((34 / 32, "q80 fused"), (2.0, "q80 dense-bf16 fallback")):
+                by = kernel_stream_bytes(cfg, live_frac=0.5, weight_bytes_per=wb)
+                rows.append((f"{preset} {tag} (cache half full)", by, q80_floor,
+                             by / V5E_HBM_GBS / 1e6, "DMA contract"))
+
         # XLA dequant-dot step: plain HLO, compiler accounting is valid
         try:
             ca = compile_step(cfg, topo, backend="xla", style=None,
@@ -290,9 +304,12 @@ def main():
         with open(md_path, "w") as f:
             f.write(
                 "# HBM traffic per decode token (v5e target, offline)\n\n"
-                "Produced by `experiments/hbm_traffic.py`. Every row's graph\n"
-                "was AOT-compiled for v5e via the local libtpu (Mosaic\n"
-                "acceptance, same mechanism as MOSAIC_AOT.md). Accounting:\n"
+                "Produced by `experiments/hbm_traffic.py`. The Q40 fused and\n"
+                "xla rows' graphs were AOT-compiled for v5e via the local\n"
+                "libtpu (Mosaic acceptance, same mechanism as MOSAIC_AOT.md);\n"
+                "the q80 rows are DMA-contract accounting only — the q80\n"
+                "kernels' acceptance is recorded separately in MOSAIC_AOT.md.\n"
+                "Accounting:\n"
                 "the fused-Pallas rows use the kernels' BlockSpec DMA\n"
                 "contract (exact by construction; XLA's cost model treats\n"
                 "Mosaic custom-calls as opaque and reports less than the\n"

@@ -192,12 +192,11 @@ def run_one(m, label, variants):
                 # fused Q80 path (Q8Tensor): int8 codes + f16 scales,
                 # 1.0625 B/weight streamed — same dispatch split as q40
                 from dllama_tpu.ops.pallas.q80_matmul import q80_matmul
-                from dllama_tpu.ops.quant import Q8Tensor, quantize_q80_np
+                from dllama_tpu.ops.quant import Q8Tensor
 
                 rng8 = np.random.default_rng(0)
-                w8f = (rng8.standard_normal((n, k)) * 0.02).astype(np.float32)
-                codes, scales = quantize_q80_np(w8f.reshape(-1))
-                w8 = Q8Tensor.from_file_layout(codes, scales, n, k)
+                w8 = Q8Tensor.quantize(
+                    (rng8.standard_normal((k, n)) * 0.02).astype(np.float32))
                 q8bytes = k * n + (k // Q_BLOCK) * n * 2
                 t = bench(lambda x, w8=w8: q80_matmul(x, w8, interpret=INTERPRET), (x,))
                 rows.append(("Q8 q80-fused", t, q8bytes))
