@@ -37,6 +37,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--steps", type=int, default=64, help="max tokens to generate")
     p.add_argument("--temperature", type=float, default=0.8)
     p.add_argument("--topp", type=float, default=0.9)
+    p.add_argument("--presence-penalty", type=float, default=0.0,
+                   help="subtract this from logits of any already-seen token "
+                        "(OpenAI presence_penalty semantics)")
+    p.add_argument("--frequency-penalty", type=float, default=0.0,
+                   help="subtract count*this from logits per occurrence "
+                        "(OpenAI frequency_penalty semantics)")
     p.add_argument("--exact-topp", action="store_true",
                    help="reference-exact nucleus: full-vocab sort per step instead "
                         "of the approx-top-256 candidate set (slower on big vocabs)")
@@ -159,7 +165,9 @@ def cmd_inference(args) -> int:
         return 1
     m = _load(args)
     tok = m.tokenizer
-    sampler = Sampler(args.temperature, args.topp, args.seed if args.seed is not None else int(time.time()))
+    sampler = Sampler(args.temperature, args.topp,
+                      args.seed if args.seed is not None else int(time.time()),
+                      presence=args.presence_penalty, frequency=args.frequency_penalty)
     prompt_tokens = tok.encode(args.prompt, add_bos=True)
     max_tokens = min(args.steps, m.engine.seq_len - len(prompt_tokens))
     stats = GenerationStats()
@@ -222,7 +230,9 @@ def cmd_chat(args) -> int:
     tok = m.tokenizer
     template = ChatTemplate(ChatTemplateType.UNKNOWN, tok.chat_template, "")
     stops = chat_stops(tok)
-    sampler = Sampler(args.temperature, args.topp, args.seed if args.seed is not None else int(time.time()))
+    sampler = Sampler(args.temperature, args.topp,
+                      args.seed if args.seed is not None else int(time.time()),
+                      presence=args.presence_penalty, frequency=args.frequency_penalty)
 
     print("💬 chat mode — empty line or Ctrl-D to exit")
     try:

@@ -181,6 +181,11 @@ class InferenceEngine:
             static_argnums=(6,),
             donate_argnums=donate,
         )
+        self._decode_penalized_n = jax.jit(
+            partial(self._decode_penalized_n_impl, fwd),
+            static_argnums=(6,),
+            donate_argnums=donate,
+        )
 
     @staticmethod
     def _step_impl(fwd, params, cache, tokens, pos, rope_cache):
@@ -220,6 +225,30 @@ class InferenceEngine:
             return (nxt, cache, p + 1, key), nxt[:, 0]
 
         (_, cache, _, _), toks = jax.lax.scan(body, (token, cache, pos, key), None, length=n)
+        return toks, cache
+
+    @staticmethod
+    def _decode_penalized_n_impl(fwd, params, cache, token, pos, rope_cache,
+                                 key, n, temperature, topp, counts,
+                                 presence, frequency):
+        """The sampled scan with OpenAI-style repetition penalties: token
+        occurrence counts ride the scan carry (each fed token is counted
+        before its successor is sampled), so penalized generation keeps the
+        one-host-roundtrip-per-chunk property. Separate jit from the
+        penalty-free scan — requests without penalties pay zero extra."""
+        from dllama_tpu.engine.sampling import apply_penalties, sample_logits
+
+        def body(carry, _):
+            token, cache, p, key, counts = carry
+            counts = counts.at[jnp.arange(counts.shape[0]), token[:, 0]].add(1)
+            logits, cache = fwd(params, cache, token, p, rope_cache, last_only=True)
+            key, sub = jax.random.split(key)
+            pen = apply_penalties(logits[:, -1], counts, presence, frequency)
+            nxt = sample_logits(pen, sub, temperature, topp)[:, None]
+            return (nxt, cache, p + 1, key, counts), nxt[:, 0]
+
+        (_, cache, _, _, _), toks = jax.lax.scan(
+            body, (token, cache, pos, key, counts), None, length=n)
         return toks, cache
 
     # ------------------------------------------------------------------ core
@@ -427,13 +456,17 @@ class InferenceEngine:
         self._spec_h = (h_out, self.pos, int(toks[-1])) if m else None
         return toks
 
-    def decode_sample_n(self, token: np.ndarray, n: int, sampler: Sampler) -> np.ndarray:
+    def decode_sample_n(self, token: np.ndarray, n: int, sampler: Sampler,
+                        counts: np.ndarray | None = None) -> np.ndarray:
         """Fused n-step sampled decode on device; returns tokens [n, B].
-        Advances the sampler's PRNG key once per call."""
+        Advances the sampler's PRNG key once per call. ``counts`` ([B, V]
+        occurrence counts of the text so far, EXCLUDING the unfed ``token`` —
+        it is counted in-scan) routes through the penalized scan when the
+        sampler carries presence/frequency penalties."""
         if self.pos + n > self.seq_len:
             raise ValueError(f"position {self.pos}+{n} exceeds seq_len {self.seq_len}")
         sampler.key, sub = jax.random.split(sampler.key)
-        toks, self.cache = self._decode_sample_n(
+        args = (
             self.params,
             self.cache,
             jnp.asarray(token, jnp.int32).reshape(self.batch, 1),
@@ -444,6 +477,12 @@ class InferenceEngine:
             jnp.float32(sampler.temperature),
             jnp.float32(sampler.topp),
         )
+        if counts is not None and sampler.has_penalties:
+            toks, self.cache = self._decode_penalized_n(
+                *args, jnp.asarray(counts, jnp.int32).reshape(self.batch, -1),
+                jnp.float32(sampler.presence), jnp.float32(sampler.frequency))
+        else:
+            toks, self.cache = self._decode_sample_n(*args)
         self.pos += n
         return np.asarray(toks)
 
@@ -473,9 +512,20 @@ class InferenceEngine:
         runs ignore it.
         """
         assert self.batch == 1, "generate() drives a single sequence; use step() for batches"
-        use_spec = spec > 0 and sampler.temperature == 0.0
+        # penalized greedy is argmax of MODIFIED logits: speculative drafting
+        # verifies against raw argmax, so penalties force the plain scan
+        use_spec = spec > 0 and sampler.temperature == 0.0 and not sampler.has_penalties
+        penalized = sampler.has_penalties
         t0 = time.perf_counter()
         logits = self.prefill(np.asarray([prompt_tokens], dtype=np.int32))
+        if penalized:
+            # OpenAI semantics: counts cover tokens SAMPLED in this
+            # completion only — the prompt (and any KV-cached earlier turns)
+            # carries no penalty, so output is independent of prefix-cache
+            # state. No sampled tokens exist yet: the first token is
+            # penalty-free by the same formula (all counts zero).
+            v = logits.shape[-1]
+            text: list[int] = []  # tokens sampled so far
         token = int(sampler(logits)[0])
         jax.block_until_ready(logits)
         t1 = time.perf_counter()
@@ -504,6 +554,15 @@ class InferenceEngine:
                         break
                     fed.extend([token] + [int(t) for t in flat[:-1]])
                     toks = flat[:, None]
+            elif penalized:
+                # counts of the text so far EXCLUDING the unfed token (the
+                # scan counts it before its successor is sampled); rebuilt
+                # from host history per chunk — one [1, V] ship per chunk
+                counts = np.bincount(text, minlength=v)[None, :v]
+                toks = self.decode_sample_n(np.array([[token]]), c, sampler,
+                                            counts=counts)
+                text.append(token)
+                text.extend(int(t) for t in toks[:-1, 0])
             else:
                 toks = self.decode_sample_n(np.array([[token]]), c, sampler)
             if stats is not None:

@@ -22,6 +22,27 @@ import jax.numpy as jnp
 NUCLEUS_K: int | None = 256
 
 
+def apply_penalties(logits: jax.Array, counts, presence, frequency) -> jax.Array:
+    """OpenAI-style repetition penalties on raw logits:
+    ``mu[j] = logit[j] - presence * 1[counts[j] > 0] - frequency * counts[j]``.
+
+    counts: [B, V] occurrence counts of each token SAMPLED in this
+    completion so far (OpenAI's published formula: the prompt — and any
+    KV-cached earlier turns — carries no penalty, so output never depends
+    on prefix-cache state). presence/frequency: scalars or [B] vectors —
+    branchless like temperature/topp so per-request values never recompile.
+    The reference has no analog (its sampler is temp/top-p only,
+    tokenizer.cpp:352-416); OpenAI clients send these fields routinely."""
+    presence = jnp.asarray(presence, jnp.float32)
+    frequency = jnp.asarray(frequency, jnp.float32)
+    if presence.ndim == 1:
+        presence = presence[:, None]
+    if frequency.ndim == 1:
+        frequency = frequency[:, None]
+    c = counts.astype(jnp.float32)
+    return logits - presence * (c > 0) - frequency * c
+
+
 def sample_logits(logits: jax.Array, key: jax.Array, temperature, topp) -> jax.Array:
     """logits f32 [B, V] -> tokens i32 [B]. Branchless in temperature/topp so
     both can be *traced* scalars — the fused decode loop and the API server
@@ -92,12 +113,20 @@ def sample(logits: jax.Array, key: jax.Array, temperature=0.8, topp=0.9) -> jax.
 
 
 class Sampler:
-    """Stateful host-side wrapper (the analog of the reference Sampler object)."""
+    """Stateful host-side wrapper (the analog of the reference Sampler object,
+    plus the OpenAI repetition-penalty fields it lacks)."""
 
-    def __init__(self, temperature: float = 0.8, topp: float = 0.9, seed: int = 0):
+    def __init__(self, temperature: float = 0.8, topp: float = 0.9, seed: int = 0,
+                 presence: float = 0.0, frequency: float = 0.0):
         self.temperature = float(temperature)
         self.topp = float(topp)
+        self.presence = float(presence)
+        self.frequency = float(frequency)
         self.key = jax.random.PRNGKey(seed)
+
+    @property
+    def has_penalties(self) -> bool:
+        return self.presence != 0.0 or self.frequency != 0.0
 
     def set_seed(self, seed: int) -> None:
         self.key = jax.random.PRNGKey(seed)

@@ -99,6 +99,9 @@ class ApiServer:
             raise ApiError(400, "messages must be a non-empty array")
         temperature = float(body.get("temperature", self.defaults["temperature"]))
         topp = float(body.get("top_p", self.defaults["topp"]))
+        # `or 0.0`: OpenAI treats an explicit JSON null as "use default"
+        presence = float(body.get("presence_penalty") or 0.0)
+        frequency = float(body.get("frequency_penalty") or 0.0)
         seed = body.get("seed", self.defaults["seed"])
         max_tokens = int(body.get("max_tokens") or body.get("max_completion_tokens") or 0)
         extra_stops = body.get("stop") or []
@@ -106,6 +109,12 @@ class ApiServer:
             extra_stops = [extra_stops]
 
         if self.scheduler is not None:
+            if presence or frequency:
+                # the batched tier's fused multi-slot scans don't carry
+                # per-slot count state (yet); be explicit rather than
+                # silently ignoring a sampling parameter
+                raise ApiError(400, "presence/frequency penalties require "
+                                    "the single-engine tier (--slots 0)")
             return self._complete_batched(
                 body, messages, temperature, topp, max_tokens, extra_stops, emit,
                 seed=seed,
@@ -126,7 +135,9 @@ class ApiServer:
             if max_tokens > 0:
                 budget = min(budget, max_tokens)
 
-            sampler = Sampler(temperature, topp, seed if seed is not None else int(time.time()))
+            sampler = Sampler(temperature, topp,
+                              seed if seed is not None else int(time.time()),
+                              presence=presence, frequency=frequency)
             detector = EosDetector(
                 self.tokenizer.eos_ids,
                 self.stops + list(extra_stops),

@@ -168,3 +168,39 @@ def test_bad_requests(server):
     assert status == 400
     status, _ = post(port, "/nope", {})
     assert status == 404
+
+
+def test_penalties_param_single_tier(server):
+    """presence/frequency penalties are honored (deterministic seed: the
+    penalized and plain completions must differ) on the single-engine tier."""
+    port, _ = server
+    base = {"messages": [{"role": "user", "content": "hello hello hello"}],
+            "temperature": 0.0, "max_tokens": 12, "seed": 3}
+    st1, d1 = post(port, "/v1/chat/completions", base)
+    st2, d2 = post(port, "/v1/chat/completions",
+                   dict(base, frequency_penalty=0.8, presence_penalty=0.5))
+    assert st1 == st2 == 200
+    plain, pen = json.loads(d1), json.loads(d2)
+    assert plain["choices"][0]["message"] != pen["choices"][0]["message"]
+
+
+def test_penalties_rejected_on_batched_tier(tmp_path):
+    """The continuous-batching tier must reject penalties explicitly (400),
+    not silently ignore a sampling parameter."""
+    import threading
+
+    from dllama_tpu.engine.loader import load_model
+    from dllama_tpu.serve.api import make_server
+
+    mpath, tpath, cfg = make_tiny_files(tmp_path)
+    loaded = load_model(mpath, tpath, mesh=None)
+    httpd, api = make_server(loaded, host="127.0.0.1", port=0, n_slots=2)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        status, data = post(httpd.server_address[1], "/v1/chat/completions",
+                            {"messages": [{"role": "user", "content": "hi"}],
+                             "max_tokens": 4, "presence_penalty": 0.5})
+        assert status == 400
+        assert b"penalt" in data
+    finally:
+        httpd.shutdown()
