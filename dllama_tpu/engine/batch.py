@@ -98,6 +98,11 @@ class BatchEngine:
         self.last_token = np.zeros(n_slots, np.int32)
         self.temperature = np.zeros(n_slots, np.float32)
         self.topp = np.full(n_slots, 0.9, np.float32)
+        # OpenAI repetition penalties, per slot; counts ([B, V] sampled-token
+        # occurrences) allocate lazily on the first penalized request
+        self.presence = np.zeros(n_slots, np.float32)
+        self.frequency = np.zeros(n_slots, np.float32)
+        self._counts: jax.Array | None = None
         # per-slot PRNG keys (threefry uint32[2]); requests without a seed get
         # a unique key derived from the engine seed + admission counter
         self.keys = np.tile(np.array(jax.random.PRNGKey(seed)), (n_slots, 1))
@@ -135,6 +140,11 @@ class BatchEngine:
         self._decode = jax.jit(
             partial(self._decode_impl, cfg, attn_fn, self._col_fn, mm, mm_in, moe_impl),
             static_argnums=(8,), donate_argnums=(1,),
+        )
+        self._decode_pen = jax.jit(
+            partial(self._decode_penalized_impl, cfg, attn_fn, self._col_fn, mm,
+                    mm_in, moe_impl),
+            static_argnums=(8,), donate_argnums=(1, 10),
         )
         self._copy_rows = jax.jit(self._copy_rows_impl, donate_argnums=(0,))
 
@@ -209,6 +219,39 @@ class BatchEngine:
             body, (tokens, cache, pos_vec, keys), None, length=n
         )
         return toks, cache, keys
+
+    @staticmethod
+    def _decode_penalized_impl(cfg, attn_fn, col_fn, mm, mm_in, moe_impl, params,
+                               cache, tokens, pos_vec, active, keys, temps, topps,
+                               n, rope, counts, presence, frequency):
+        """The fused multi-slot scan with OpenAI repetition penalties:
+        per-slot counts of sampled-this-request tokens ride the carry (the
+        fed token is counted before its successor is sampled — active slots
+        only, so a frozen slot's repeated last token never inflates its
+        counts). A separate jit from _decode_impl: penalty-free serving pays
+        nothing."""
+        from dllama_tpu.engine.sampling import apply_penalties
+
+        b = tokens.shape[0]
+
+        def body(carry, _):
+            tok, cache, p, keys, counts = carry
+            counts = counts.at[jnp.arange(b), tok[:, 0]].add(
+                active.astype(jnp.int32))
+            logits, cache = forward(cfg, params, tok, p, cache, rope, attn_fn,
+                                    active=jnp.asarray(active), col_fn=col_fn, mm=mm,
+                                    mm_in=mm_in, moe_impl=moe_impl, last_only=True)
+            splits = jax.vmap(jax.random.split)(keys)
+            keys, subs = splits[:, 0], splits[:, 1]
+            pen = apply_penalties(logits[:, -1], counts, presence, frequency)
+            nxt = _sample_rows(pen, subs, temps, topps)[:, None]
+            nxt = jnp.where(active[:, None], nxt, tok)
+            return (nxt, cache, p + active.astype(jnp.int32), keys, counts), nxt[:, 0]
+
+        (_, cache, _, keys, counts), toks = jax.lax.scan(
+            body, (tokens, cache, pos_vec, keys, counts), None, length=n
+        )
+        return toks, cache, keys, counts
 
     @staticmethod
     def _spec_step_impl(cfg, attn_fn, col_fn, mm, mm_in, moe_impl, k, ngram,
@@ -408,7 +451,8 @@ class BatchEngine:
         return adm.off >= n
 
     def add_commit(self, adm: "Admission", temperature: float = 0.8,
-                   topp: float = 0.9, seed: int | None = None) -> int:
+                   topp: float = 0.9, seed: int | None = None,
+                   presence: float = 0.0, frequency: float = 0.0) -> int:
         """Sample the first token from the finished admission and activate
         the slot. Must follow add_step returning True."""
         assert adm.off >= len(adm.toks) and adm.logits is not None, "admission not pumped"
@@ -428,6 +472,17 @@ class BatchEngine:
         self.last_token[slot] = first
         self.temperature[slot] = temperature
         self.topp[slot] = topp
+        self.presence[slot] = presence
+        self.frequency[slot] = frequency
+        if presence or frequency:
+            if self._counts is None:
+                self._counts = jnp.zeros((self.n_slots, self.cfg.vocab_size),
+                                         jnp.int32)
+            # fresh request: no sampled tokens yet (OpenAI counts exclude
+            # the prompt, so recycled-slot state must not leak). Slots with
+            # zero penalties never read their counts, so stale rows are
+            # harmless and non-penalized admissions pay nothing.
+            self._counts = self._counts.at[slot].set(0)
         if self.spec_k:
             # invariant: history[slot, pos] holds the slot's unfed token
             self.history = self._hist_write(
@@ -437,7 +492,8 @@ class BatchEngine:
         return first
 
     def add(self, slot: int, prompt_tokens: list[int], temperature: float = 0.8,
-            topp: float = 0.9, start_pos: int = 0, seed: int | None = None) -> int:
+            topp: float = 0.9, start_pos: int = 0, seed: int | None = None,
+            presence: float = 0.0, frequency: float = 0.0) -> int:
         """Prefill `prompt_tokens` into `slot` (rows from start_pos — pass a
         cached-prefix length to reuse earlier rows, NaiveCache-style) and
         sample the first token. Other slots are untouched (masked writes).
@@ -448,7 +504,8 @@ class BatchEngine:
         adm = self.add_begin(slot, prompt_tokens, start_pos)
         while not self.add_step(adm):
             pass
-        return self.add_commit(adm, temperature, topp, seed)
+        return self.add_commit(adm, temperature, topp, seed,
+                               presence=presence, frequency=frequency)
 
     def decode(self, n: int) -> np.ndarray:
         """n fused decode steps across all active slots; returns tokens [n, B]
@@ -459,7 +516,7 @@ class BatchEngine:
         n = min(n, room)
         if n <= 0:
             raise ValueError("active slot at seq_len; release it first")
-        toks, self.cache, keys = self._decode(
+        args = (
             self.params, self.cache,
             jnp.asarray(self.last_token[:, None].copy()),
             jnp.asarray(self.pos.copy(), jnp.int32),
@@ -470,6 +527,17 @@ class BatchEngine:
             n,
             self.rope_cache,
         )
+        if self._counts is not None and (
+            (self.presence[self.active] != 0).any()
+            or (self.frequency[self.active] != 0).any()
+        ):
+            toks, self.cache, keys, self._counts = self._decode_pen(
+                *args, self._counts,
+                jnp.asarray(self.presence.copy()),
+                jnp.asarray(self.frequency.copy()),
+            )
+        else:
+            toks, self.cache, keys = self._decode(*args)
         toks = np.asarray(toks)
         self.keys = np.array(keys)  # writable copy — add() mutates rows
         if self.spec_k:
@@ -506,6 +574,12 @@ class BatchEngine:
         if not eff.any():
             raise ValueError("no active slot has room for a spec cycle; "
                              "use decode() or release the full slots")
+        if ((self.presence[eff] != 0) | (self.frequency[eff] != 0)).any():
+            # spec cycles don't carry penalty counts (greedy acceptance would
+            # compare against raw argmax); the scheduler routes penalized
+            # batches through decode() — enforce it here too
+            raise ValueError("spec_step cannot serve slots with repetition "
+                             "penalties; use decode()")
         emit, adv, nxt, self.cache, self.history, keys = self._spec_step(
             self.params, self.cache, self.history,
             jnp.asarray(self.last_token.copy()),
@@ -526,5 +600,6 @@ class BatchEngine:
         """Free a slot. keep_rows rewinds pos to the valid prefix (mid-chunk
         stop), preserving the slot's cache for NaiveCache-style reuse."""
         self.active[slot] = False
+        self.presence[slot] = self.frequency[slot] = 0.0
         if keep_rows is not None:
             self.pos[slot] = keep_rows

@@ -109,15 +109,9 @@ class ApiServer:
             extra_stops = [extra_stops]
 
         if self.scheduler is not None:
-            if presence or frequency:
-                # the batched tier's fused multi-slot scans don't carry
-                # per-slot count state (yet); be explicit rather than
-                # silently ignoring a sampling parameter
-                raise ApiError(400, "presence/frequency penalties require "
-                                    "the single-engine tier (--slots 0)")
             return self._complete_batched(
                 body, messages, temperature, topp, max_tokens, extra_stops, emit,
-                seed=seed,
+                seed=seed, presence=presence, frequency=frequency,
             )
 
         with self.lock:
@@ -195,7 +189,8 @@ class ApiServer:
         }
 
     def _complete_batched(self, body, messages, temperature, topp, max_tokens,
-                          extra_stops, emit, seed=None) -> dict:
+                          extra_stops, emit, seed=None, presence=0.0,
+                          frequency=0.0) -> dict:
         """Continuous-batching completion: submit to the scheduler, stream from
         the per-request queue. Per-request `seed` pins the slot's own PRNG
         stream (reproducible regardless of batch-mates). Prefix reuse lives in
@@ -221,6 +216,7 @@ class ApiServer:
         decoder = self.tokenizer.make_stream_decoder()
         req = self.scheduler.submit(
             prompt_tokens, temperature, topp, budget, self.tokenizer.eos_ids,
+            presence=presence, frequency=frequency,
             seed=int(seed) if seed is not None else None,
         )
         parts: list[str] = []

@@ -46,6 +46,8 @@ class Request:
     max_tokens: int
     eos_ids: frozenset[int]
     seed: int | None = None
+    presence: float = 0.0
+    frequency: float = 0.0
     out: queue.Queue = field(default_factory=queue.Queue)
     produced: int = 0
     slot: int = -1
@@ -117,9 +119,11 @@ class Scheduler:
     # ------------------------------------------------------------------- api
 
     def submit(self, prompt, temperature, topp, max_tokens, eos_ids,
-               seed: int | None = None) -> Request:
+               seed: int | None = None, presence: float = 0.0,
+               frequency: float = 0.0) -> Request:
         req = Request(list(prompt), float(temperature), float(topp), int(max_tokens),
-                      frozenset(eos_ids), seed=seed, submitted_at=time.monotonic())
+                      frozenset(eos_ids), seed=seed, presence=float(presence),
+                      frequency=float(frequency), submitted_at=time.monotonic())
         self.pending.put(req)
         self._wake.set()
         return req
@@ -312,7 +316,9 @@ class Scheduler:
                 worked = True
                 if done:
                     first = self.engine.add_commit(adm, req.temperature, req.topp,
-                                                   seed=req.seed)
+                                                   seed=req.seed,
+                                                   presence=req.presence,
+                                                   frequency=req.frequency)
                     self._inflight.pop(0)
                     self.reused_prefix_tokens += reuse  # rows actually served
                     self.slot_tokens[adm.slot] = list(req.prompt)
@@ -362,6 +368,7 @@ class Scheduler:
             use_spec = (
                 bool(getattr(self.engine, "spec_k", 0))
                 and any(float(self.engine.temperature[s]) == 0.0 for s in self.slots)
+                and not any(r.presence or r.frequency for r in self.slots.values())
                 and all(
                     start_rows[s] + self.engine.spec_k + 1 <= self.engine.seq_len
                     for s in self.slots

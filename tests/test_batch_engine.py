@@ -347,3 +347,29 @@ def test_spec_frozen_sampled_slot_keeps_seed_stream():
         return [int(t) for t in be.decode(3)[:, 1]]
 
     assert tail(False) == tail(True)
+
+
+def test_batched_penalties_match_single_engine():
+    """A penalized request in the batched tier must produce the same greedy
+    stream as the single-engine penalized generate (same OpenAI
+    sampled-token-counts semantics), while an un-penalized batch-mate's
+    stream stays untouched."""
+    from dllama_tpu.engine.sampling import Sampler as _S
+
+    p1, p2 = [1, 2, 3], [7, 8, 9]
+    eng1 = InferenceEngine(CFG, PARAMS, cache_dtype=jnp.float32)
+    want_pen = list(eng1.generate(p1, 9, _S(temperature=0.0, presence=0.6,
+                                            frequency=0.4)))
+    want_plain = greedy_ref(p2, 9)
+
+    be = BatchEngine(CFG, PARAMS, n_slots=2, cache_dtype=jnp.float32)
+    got_pen = [be.add(0, p1, temperature=0.0, presence=0.6, frequency=0.4)]
+    got_plain = [be.add(1, p2, temperature=0.0)]
+    toks = be.decode(8)
+    got_pen += [int(t) for t in toks[:, 0]]
+    got_plain += [int(t) for t in toks[:, 1]]
+    assert got_pen == want_pen
+    assert got_plain == want_plain[:9]
+    # recycled slot must not inherit penalties
+    be.release(0)
+    assert be.presence[0] == 0.0 and be.frequency[0] == 0.0

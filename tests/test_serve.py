@@ -184,9 +184,10 @@ def test_penalties_param_single_tier(server):
     assert plain["choices"][0]["message"] != pen["choices"][0]["message"]
 
 
-def test_penalties_rejected_on_batched_tier(tmp_path):
-    """The continuous-batching tier must reject penalties explicitly (400),
-    not silently ignore a sampling parameter."""
+def test_penalties_on_batched_tier(tmp_path):
+    """The continuous-batching tier honors penalties too: penalized and
+    plain greedy completions differ (per-slot counts in the fused
+    multi-slot scan)."""
     import threading
 
     from dllama_tpu.engine.loader import load_model
@@ -197,10 +198,13 @@ def test_penalties_rejected_on_batched_tier(tmp_path):
     httpd, api = make_server(loaded, host="127.0.0.1", port=0, n_slots=2)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     try:
-        status, data = post(httpd.server_address[1], "/v1/chat/completions",
-                            {"messages": [{"role": "user", "content": "hi"}],
-                             "max_tokens": 4, "presence_penalty": 0.5})
-        assert status == 400
-        assert b"penalt" in data
+        base = {"messages": [{"role": "user", "content": "hello hello"}],
+                "temperature": 0.0, "max_tokens": 10, "seed": 3}
+        st1, d1 = post(httpd.server_address[1], "/v1/chat/completions", base)
+        st2, d2 = post(httpd.server_address[1], "/v1/chat/completions",
+                       dict(base, frequency_penalty=0.9, presence_penalty=0.5))
+        assert st1 == st2 == 200
+        plain, pen = json.loads(d1), json.loads(d2)
+        assert plain["choices"][0]["message"] != pen["choices"][0]["message"]
     finally:
         httpd.shutdown()
