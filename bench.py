@@ -869,6 +869,33 @@ def worker():
             except Exception as e:
                 _mmod.XLA_PREFILL_MIN_M = None
                 results[name + "_xla_prefill"] = {"error": repr(e)[:200]}
+        # long-context bucketed-grid A/B (VERDICT r3 weak #4): the deep
+        # preset re-measures decode with the pow-2 cache-view dispatch so the
+        # unattended window captures the engine-level flip decision, not
+        # just kbench's kernel-level sweep. Guards: the baseline must be the
+        # CLEAN fused rung (kernels=auto, no widened scales, no jnp attn —
+        # the rerun uses the same defaults, so a degraded baseline would make
+        # a confounded A/B), and the device must be a TPU (kernel_select only
+        # arms s_buckets on the flash path; on CPU the flag is a no-op and
+        # the "A/B" would measure the same config twice).
+        if (name == "8b_long"
+                and "decode_ms_per_token" in results.get(name, {})
+                and results[name].get("path", "").endswith("kernels=auto")
+                and dev.platform == "tpu"
+                and not os.environ.get("DLLAMA_FLASH_BUCKETS")
+                and time.monotonic() < deadline - 240):
+            try:
+                os.environ["DLLAMA_FLASH_BUCKETS"] = "1"
+                r3 = bench_engine(cfg, params, min(n_decode, 32), unroll,
+                                  prompt_len=PROMPT_LENS.get(name, 512))
+                r3["path"] = (results[name]["path"] + " flash_buckets=1"
+                              + (" xla_prefill_m=64"
+                                 if _mmod.XLA_PREFILL_MIN_M else ""))
+                results[name + "_bucketed"] = r3
+            except Exception as e:
+                results[name + "_bucketed"] = {"error": repr(e)[:200]}
+            finally:
+                del os.environ["DLLAMA_FLASH_BUCKETS"]
         del wide_params  # params persists: the next preset may share its shapes
         dump_partial()
 
