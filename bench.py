@@ -880,13 +880,17 @@ def worker():
         # the "A/B" would measure the same config twice).
         if (name == "8b_long"
                 and "decode_ms_per_token" in results.get(name, {})
-                and results[name].get("path", "").endswith("kernels=auto")
+                # exactly the clean default rung — a fallback-style baseline
+                # (maskdot/deq/widened) would make a style-confounded A/B
+                and results[name].get("path") == f"style={q40_style} kernels=auto"
                 and dev.platform == "tpu"
                 and not os.environ.get("DLLAMA_FLASH_BUCKETS")
                 and time.monotonic() < deadline - 240):
             try:
                 os.environ["DLLAMA_FLASH_BUCKETS"] = "1"
-                r3 = bench_engine(cfg, params, min(n_decode, 32), unroll,
+                # same n_decode as the baseline: decode ms/token IS the
+                # compared metric, so the averaging window must match
+                r3 = bench_engine(cfg, params, n_decode, unroll,
                                   prompt_len=PROMPT_LENS.get(name, 512))
                 r3["path"] = (results[name]["path"] + " flash_buckets=1"
                               + (" xla_prefill_m=64"

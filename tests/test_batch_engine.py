@@ -373,3 +373,19 @@ def test_batched_penalties_match_single_engine():
     # recycled slot must not inherit penalties
     be.release(0)
     assert be.presence[0] == 0.0 and be.frequency[0] == 0.0
+
+
+def test_batched_penalized_sampled_reproducible():
+    """Penalized SAMPLED requests stay seed-reproducible and differ from the
+    same seed without penalties (the penalty reshapes the distribution)."""
+
+    def run(freq):
+        be = BatchEngine(CFG, PARAMS, n_slots=2, cache_dtype=jnp.float32)
+        out = [be.add(0, [1, 2, 3], temperature=1.0, topp=0.9, seed=42,
+                      frequency=freq)]
+        out += [int(t) for t in be.decode(8)[:, 0]]
+        return out
+
+    a, b = run(0.9), run(0.9)
+    assert a == b  # reproducible under penalties
+    assert run(0.0) != a  # and the penalty actually reshapes sampling

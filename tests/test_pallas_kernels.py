@@ -285,3 +285,39 @@ def test_q80_matmul_stacked_layer_index(rng):
         want = jnp.dot(x, layers[li].dequantize(jnp.float32))
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=1e-3, rtol=1e-3)
+
+
+def test_flash_attention_bucketed_vector_pos(rng):
+    """Bucketed dispatch under PER-ROW positions (batched decode): the
+    horizon is max(pos) + t, so the batch rides the view covering its
+    deepest slot and every row stays exact."""
+    from dllama_tpu.ops.pallas.flash_attention import flash_gqa_attention
+
+    q = jnp.asarray(rng.standard_normal((2, 1, 8, 64)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 4, 2048, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 4, 2048, 64)), jnp.float32)
+    for pos in ([3, 300], [500, 511], [100, 1900]):
+        pv = jnp.asarray(pos, jnp.int32)
+        want = flash_gqa_attention(q, k, v, pv, interpret=True)
+        got = flash_gqa_attention(q, k, v, pv, interpret=True, s_buckets=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=0, rtol=0)
+
+
+def test_q80_matmul_bf16_and_odd_rows(rng):
+    """q80 kernels: bf16 activations keep exactness of int8 codes, and odd
+    row counts take the pad path."""
+    from dllama_tpu.ops.pallas.q80_matmul import q80_matmul
+    from dllama_tpu.ops.quant import Q8Tensor
+
+    k, n = 256, 128
+    w = Q8Tensor.quantize((rng.standard_normal((k, n)) * 0.1).astype(np.float32))
+    for m, dt in ((3, jnp.float32), (8, jnp.bfloat16), (2, jnp.bfloat16)):
+        x = jnp.asarray(rng.standard_normal((m, k)), dt)
+        got = q80_matmul(x, w, interpret=True)
+        want = jnp.dot(x, w.dequantize(dt),
+                       preferred_element_type=jnp.float32).astype(dt)
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32),
+                                   atol=5e-2, rtol=5e-2)
+        assert got.dtype == dt and got.shape == (m, n)
