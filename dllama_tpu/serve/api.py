@@ -1,10 +1,11 @@
 """OpenAI-compatible HTTP API server — the `dllama-api` binary's role
 (dllama-api.cpp:509-581).
 
-Routes: POST /v1/chat/completions (stream + non-stream), GET /v1/models,
-GET /health. Request params override the CLI defaults the way the reference's
-params do (dllama-api.cpp:455-484): temperature, top_p, seed, max_tokens,
-stop, stream.
+Routes: POST /v1/chat/completions and the legacy POST /v1/completions (both
+stream + non-stream), GET /v1/models, GET /health. Request params override
+the CLI defaults the way the reference's params do (dllama-api.cpp:455-484):
+temperature, top_p, presence/frequency_penalty, seed, max_tokens, stop,
+stream.
 
 The **prefix cache** reproduces NaiveCache (dllama-api.cpp:264-309): the chat
 history from the previous request is kept with its KV-cache position; when a
@@ -132,38 +133,9 @@ class ApiServer:
             sampler = Sampler(temperature, topp,
                               seed if seed is not None else int(time.time()),
                               presence=presence, frequency=frequency)
-            detector = EosDetector(
-                self.tokenizer.eos_ids,
-                self.stops + list(extra_stops),
-                padding_left=2,
-                padding_right=2,
-            )
-            self.tokenizer.reset_decoder()
-            parts: list[str] = []
-            n_generated = 0
-            finish = "length"
-            for t in self.engine.generate(prompt_tokens, budget, sampler,
-                                          spec=self.spec):
-                n_generated += 1
-                piece = self.tokenizer.decode(t)
-                res = detector.append(t, piece)
-                text = detector.get_delta()
-                if text:
-                    parts.append(text)
-                    if emit is not None:
-                        emit(text)
-                if res == EosResult.EOS:
-                    finish = "stop"
-                    break
-            else:
-                # budget exhausted mid-held-prefix: the partial stop never completes
-                text = detector.flush()
-                if text:
-                    parts.append(text)
-                    if emit is not None:
-                        emit(text)
-
-            content = "".join(parts)
+            content, finish, n_generated = self._run_single(
+                prompt_tokens, budget, sampler,
+                self.stops + list(extra_stops), emit)
             # cache the full conversation incl. the reply for the next turn
             self.cache.messages = messages + [("assistant", content)]
             self.cache.pos = self.engine.pos
@@ -188,6 +160,55 @@ class ApiServer:
             },
         }
 
+    def prevalidate(self, body: dict, legacy: bool = False) -> None:
+        """Raise ApiError for request-shape problems that can be detected
+        without touching the engine (used before streaming headers are
+        sent). Deeper failures (context window) still surface as HTTP 4xx on
+        the non-streaming path."""
+        if legacy:
+            prompt = body.get("prompt")
+            if isinstance(prompt, list):
+                if len(prompt) != 1:
+                    raise ApiError(400, "only a single prompt is supported")
+                prompt = prompt[0]
+            if not isinstance(prompt, str) or not prompt:
+                raise ApiError(400, "prompt must be a non-empty string")
+        elif not body.get("messages"):
+            raise ApiError(400, "messages must be a non-empty array")
+
+    def _run_single(self, prompt_tokens, budget, sampler, stops, emit
+                    ) -> tuple[str, str, int]:
+        """Token loop of a single-engine completion (generate + EOS/stop
+        detection + held-prefix flush) -> (content, finish_reason, n_tokens).
+        Shared by the chat and legacy endpoints — caller holds self.lock and
+        has positioned the engine."""
+        detector = EosDetector(self.tokenizer.eos_ids, stops,
+                               padding_left=2, padding_right=2)
+        self.tokenizer.reset_decoder()
+        parts: list[str] = []
+        n_generated = 0
+        finish = "length"
+        for t in self.engine.generate(prompt_tokens, budget, sampler,
+                                      spec=self.spec):
+            n_generated += 1
+            res = detector.append(t, self.tokenizer.decode(t))
+            text = detector.get_delta()
+            if text:
+                parts.append(text)
+                if emit is not None:
+                    emit(text)
+            if res == EosResult.EOS:
+                finish = "stop"
+                break
+        else:
+            # budget exhausted mid-held-prefix: the partial stop never completes
+            text = detector.flush()
+            if text:
+                parts.append(text)
+                if emit is not None:
+                    emit(text)
+        return "".join(parts), finish, n_generated
+
     def _complete_batched(self, body, messages, temperature, topp, max_tokens,
                           extra_stops, emit, seed=None, presence=0.0,
                           frequency=0.0) -> dict:
@@ -201,6 +222,38 @@ class ApiServer:
             [ChatItem(r, c) for r, c in messages], append_generation_prompt=True
         )
         prompt_tokens = self.tokenizer.encode(generated.content, add_bos=True)
+        content, finish, n_generated = self._run_batched(
+            prompt_tokens, temperature, topp, max_tokens,
+            self.stops + list(extra_stops), emit,
+            seed=seed, presence=presence, frequency=frequency)
+        return {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:16]}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": body.get("model", self.model_name),
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": content},
+                    "finish_reason": finish,
+                }
+            ],
+            "usage": {
+                "prompt_tokens": len(prompt_tokens),
+                "completion_tokens": n_generated,
+                "total_tokens": len(prompt_tokens) + n_generated,
+            },
+        }
+
+    def _run_batched(self, prompt_tokens, temperature, topp, max_tokens,
+                     stops, emit, seed=None, presence=0.0,
+                     frequency=0.0) -> tuple[str, str, int]:
+        """Token-level core of a batched completion: submit, stream-decode
+        with EOS/stop detection, return (content, finish_reason, n_tokens).
+        Shared by the chat and legacy-completions endpoints — the caller
+        decides the stop-string set (chat adds the template stops, the
+        legacy raw-prompt endpoint uses only explicit ones, matching its
+        single-engine tier)."""
         budget = self.scheduler.engine.seq_len - len(prompt_tokens) - 1
         if budget <= 0:
             raise ApiError(400, "context window exhausted")
@@ -209,7 +262,7 @@ class ApiServer:
 
         detector = EosDetector(
             self.tokenizer.eos_ids,
-            self.stops + list(extra_stops),
+            stops,
             padding_left=2,
             padding_right=2,
         )
@@ -245,19 +298,61 @@ class ApiServer:
         # scheduler reasons: stop/length pass through; a cancel here means the
         # stream ended on a string stop-sequence -> "stop"
         finish = req.finish_reason if req.finish_reason in ("stop", "length") else "stop"
+        return "".join(parts), finish, n_generated
 
-        content = "".join(parts)
+    def complete_legacy(self, body: dict, emit=None) -> dict:
+        """POST /v1/completions — the pre-chat OpenAI surface some clients
+        still speak: a RAW prompt string, no chat template, `text` in the
+        choices. Shares the sampling params and generation machinery with
+        the chat endpoint."""
+        prompt = body.get("prompt")
+        if isinstance(prompt, list):
+            if len(prompt) != 1:
+                raise ApiError(400, "only a single prompt is supported")
+            prompt = prompt[0]
+        if not isinstance(prompt, str) or not prompt:
+            raise ApiError(400, "prompt must be a non-empty string")
+        temperature = float(body.get("temperature", self.defaults["temperature"]))
+        topp = float(body.get("top_p", self.defaults["topp"]))
+        presence = float(body.get("presence_penalty") or 0.0)
+        frequency = float(body.get("frequency_penalty") or 0.0)
+        seed = body.get("seed", self.defaults["seed"])
+        max_tokens = int(body.get("max_tokens") or 16)  # OpenAI legacy default
+        extra_stops = body.get("stop") or []
+        if isinstance(extra_stops, str):
+            extra_stops = [extra_stops]
+        prompt_tokens = self.tokenizer.encode(prompt, add_bos=True)
+
+        if self.scheduler is not None:
+            content, finish, n_generated = self._run_batched(
+                prompt_tokens, temperature, topp, max_tokens,
+                list(extra_stops),  # raw prompt: no chat-template stops
+                emit, seed=seed, presence=presence, frequency=frequency)
+        else:
+            with self.lock:
+                # raw-prompt rows overwrite the chat prefix cache's claim
+                self.cache.clear()
+                self.engine.reset(0)
+                budget = self.engine.seq_len - len(prompt_tokens) - 1
+                if budget <= 0:
+                    raise ApiError(400, "context window exhausted")
+                if max_tokens > 0:
+                    budget = min(budget, max_tokens)
+                sampler = Sampler(temperature, topp,
+                                  seed if seed is not None else int(time.time()),
+                                  presence=presence, frequency=frequency)
+                # legacy endpoint: no chat stop strings, only explicit ones
+                content, finish, n_generated = self._run_single(
+                    prompt_tokens, budget, sampler, list(extra_stops), emit)
+
         return {
-            "id": f"chatcmpl-{uuid.uuid4().hex[:16]}",
-            "object": "chat.completion",
+            "id": f"cmpl-{uuid.uuid4().hex[:16]}",
+            "object": "text_completion",
             "created": int(time.time()),
             "model": body.get("model", self.model_name),
             "choices": [
-                {
-                    "index": 0,
-                    "message": {"role": "assistant", "content": content},
-                    "finish_reason": finish,
-                }
+                {"index": 0, "text": content, "logprobs": None,
+                 "finish_reason": finish}
             ],
             "usage": {
                 "prompt_tokens": len(prompt_tokens),
@@ -312,7 +407,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(404, {"error": {"message": "not found"}})
 
     def do_POST(self):
-        if self.path not in ("/v1/chat/completions", "/chat/completions"):
+        chat = self.path in ("/v1/chat/completions", "/chat/completions")
+        legacy = self.path in ("/v1/completions", "/completions")
+        if not (chat or legacy):
             self._send_json(404, {"error": {"message": "not found"}})
             return
         try:
@@ -323,7 +420,13 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             if body.get("stream"):
-                self._stream(body)
+                # cheap validation BEFORE the 200/chunked headers go out — an
+                # ApiError raised mid-stream would write a second status line
+                # into the chunk stream (a protocol violation)
+                self.api.prevalidate(body, legacy=legacy)
+                self._stream(body, legacy=legacy)
+            elif legacy:
+                self._send_json(200, self.api.complete_legacy(body))
             else:
                 self._send_json(200, self.api.complete(body))
         except ApiError as e:
@@ -334,21 +437,22 @@ class _Handler(BaseHTTPRequestHandler):
             log.exception("completion failed")
             self._send_json(500, {"error": {"message": "internal error"}})
 
-    def _stream(self, body: dict) -> None:
-        """SSE chunked streaming (dllama-api.cpp:203-223's role)."""
+    def _stream(self, body: dict, legacy: bool = False) -> None:
+        """SSE chunked streaming (dllama-api.cpp:203-223's role). `legacy`
+        streams `text_completion` chunks (text field) instead of chat deltas."""
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
-        cid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
+        cid = f"{'cmpl' if legacy else 'chatcmpl'}-{uuid.uuid4().hex[:16]}"
         created = int(time.time())
 
         def chunk(payload: bytes) -> None:
             self.wfile.write(f"{len(payload):x}\r\n".encode() + payload + b"\r\n")
             self.wfile.flush()
 
-        def emit_delta(delta: dict, finish=None) -> None:
+        def emit_chat(delta: dict, finish=None) -> None:
             data = {
                 "id": cid,
                 "object": "chat.completion.chunk",
@@ -358,9 +462,23 @@ class _Handler(BaseHTTPRequestHandler):
             }
             chunk(b"data: " + json.dumps(data).encode() + b"\n\n")
 
-        emit_delta({"role": "assistant"})
-        result = self.api.complete(body, emit=lambda text: emit_delta({"content": text}))
-        emit_delta({}, finish=result["choices"][0]["finish_reason"])
+        def emit_text(text: str, finish=None) -> None:
+            data = {
+                "id": cid,
+                "object": "text_completion",
+                "created": created,
+                "model": body.get("model", self.api.model_name),
+                "choices": [{"index": 0, "text": text, "finish_reason": finish}],
+            }
+            chunk(b"data: " + json.dumps(data).encode() + b"\n\n")
+
+        if legacy:
+            result = self.api.complete_legacy(body, emit=emit_text)
+            emit_text("", finish=result["choices"][0]["finish_reason"])
+        else:
+            emit_chat({"role": "assistant"})
+            result = self.api.complete(body, emit=lambda text: emit_chat({"content": text}))
+            emit_chat({}, finish=result["choices"][0]["finish_reason"])
         chunk(b"data: [DONE]\n\n")
         chunk(b"")  # terminating zero-length chunk
 

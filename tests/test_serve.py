@@ -208,3 +208,69 @@ def test_penalties_on_batched_tier(tmp_path):
         assert plain["choices"][0]["message"] != pen["choices"][0]["message"]
     finally:
         httpd.shutdown()
+
+
+def test_legacy_completions_endpoint(server):
+    """POST /v1/completions: raw prompt (no chat template), text choices,
+    greedy determinism, and explicit stop strings."""
+    port, _ = server
+    body = {"prompt": "hello", "temperature": 0.0, "max_tokens": 8, "seed": 1}
+    st1, d1 = post(port, "/v1/completions", body)
+    st2, d2 = post(port, "/v1/completions", body)
+    assert st1 == st2 == 200
+    r1, r2 = json.loads(d1), json.loads(d2)
+    assert r1["object"] == "text_completion"
+    assert r1["choices"][0]["text"] == r2["choices"][0]["text"]
+    assert r1["usage"]["completion_tokens"] <= 8
+    # bad prompt -> 400
+    st3, _ = post(port, "/v1/completions", {"prompt": ""})
+    assert st3 == 400
+
+
+def test_legacy_completions_stream(server):
+    port, _ = server
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", "/v1/completions",
+                 json.dumps({"prompt": "hi", "temperature": 0.0,
+                             "max_tokens": 6, "stream": True}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read().decode()
+    conn.close()
+    assert resp.status == 200
+    assert '"object": "text_completion"' in data
+    assert "data: [DONE]" in data
+
+
+def test_legacy_completions_batched_tier(tmp_path):
+    import threading
+
+    from dllama_tpu.engine.loader import load_model
+    from dllama_tpu.serve.api import make_server
+
+    mpath, tpath, cfg = make_tiny_files(tmp_path)
+    loaded = load_model(mpath, tpath, mesh=None)
+    httpd, api = make_server(loaded, host="127.0.0.1", port=0, n_slots=2)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        st, d = post(httpd.server_address[1], "/v1/completions",
+                     {"prompt": "abc", "temperature": 0.0, "max_tokens": 6,
+                      "seed": 2})
+        assert st == 200
+        r = json.loads(d)
+        assert r["object"] == "text_completion"
+        assert r["choices"][0]["finish_reason"] in ("stop", "length")
+    finally:
+        httpd.shutdown()
+
+
+def test_stream_validation_errors_before_headers(server):
+    """A stream request with an invalid body must get a clean HTTP 400, not
+    a corrupted chunked stream (validation runs before headers go out)."""
+    port, _ = server
+    st, data = post(port, "/v1/completions",
+                    {"prompt": "", "stream": True})
+    assert st == 400 and b"prompt" in data
+    st2, data2 = post(port, "/v1/chat/completions",
+                      {"messages": [], "stream": True})
+    assert st2 == 400 and b"messages" in data2
