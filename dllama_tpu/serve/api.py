@@ -124,15 +124,9 @@ class ApiServer:
                 [ChatItem(r, c) for r, c in delta], append_generation_prompt=True
             )
             prompt_tokens = self.tokenizer.encode(generated.content, add_bos=add_bos)
-            budget = self.engine.seq_len - self.engine.pos - len(prompt_tokens) - 1
-            if budget <= 0:
-                raise ApiError(400, "context window exhausted")
-            if max_tokens > 0:
-                budget = min(budget, max_tokens)
-
-            sampler = Sampler(temperature, topp,
-                              seed if seed is not None else int(time.time()),
-                              presence=presence, frequency=frequency)
+            budget, sampler = self._budget_and_sampler(
+                len(prompt_tokens), max_tokens, temperature, topp, seed,
+                presence, frequency)
             content, finish, n_generated = self._run_single(
                 prompt_tokens, budget, sampler,
                 self.stops + list(extra_stops), emit)
@@ -160,21 +154,48 @@ class ApiServer:
             },
         }
 
+    @staticmethod
+    def _normalize_legacy_prompt(body: dict) -> str:
+        """The legacy endpoint's prompt field: a string or a 1-element list
+        of strings. One definition serves prevalidate and complete_legacy."""
+        prompt = body.get("prompt")
+        if isinstance(prompt, list):
+            if len(prompt) != 1:
+                raise ApiError(400, "only a single prompt is supported")
+            prompt = prompt[0]
+        if not isinstance(prompt, str) or not prompt:
+            raise ApiError(400, "prompt must be a non-empty string")
+        return prompt
+
     def prevalidate(self, body: dict, legacy: bool = False) -> None:
         """Raise ApiError for request-shape problems that can be detected
         without touching the engine (used before streaming headers are
-        sent). Deeper failures (context window) still surface as HTTP 4xx on
-        the non-streaming path."""
+        sent — a failure after the 200/chunked headers would corrupt the
+        stream). Deeper failures (context window) still surface as HTTP 4xx
+        on the non-streaming path."""
         if legacy:
-            prompt = body.get("prompt")
-            if isinstance(prompt, list):
-                if len(prompt) != 1:
-                    raise ApiError(400, "only a single prompt is supported")
-                prompt = prompt[0]
-            if not isinstance(prompt, str) or not prompt:
-                raise ApiError(400, "prompt must be a non-empty string")
-        elif not body.get("messages"):
-            raise ApiError(400, "messages must be a non-empty array")
+            self._normalize_legacy_prompt(body)
+            return
+        messages = body.get("messages")
+        if (not isinstance(messages, list) or not messages
+                or not all(isinstance(m, dict) and "role" in m and "content" in m
+                           for m in messages)):
+            raise ApiError(400, "messages must be a non-empty array of "
+                                "{role, content} objects")
+
+    def _budget_and_sampler(self, prompt_len, max_tokens, temperature, topp,
+                            seed, presence, frequency):
+        """Shared single-engine budget clamp + Sampler construction (the
+        seed-or-wallclock fallback must never diverge between endpoints)."""
+        budget = self.engine.seq_len - self.engine.pos - prompt_len - 1
+        if budget <= 0:
+            raise ApiError(400, "context window exhausted")
+        if max_tokens > 0:
+            budget = min(budget, max_tokens)
+        sampler = Sampler(temperature, topp,
+                          seed if seed is not None else int(time.time()),
+                          presence=presence, frequency=frequency)
+        return budget, sampler
 
     def _run_single(self, prompt_tokens, budget, sampler, stops, emit
                     ) -> tuple[str, str, int]:
@@ -305,13 +326,7 @@ class ApiServer:
         still speak: a RAW prompt string, no chat template, `text` in the
         choices. Shares the sampling params and generation machinery with
         the chat endpoint."""
-        prompt = body.get("prompt")
-        if isinstance(prompt, list):
-            if len(prompt) != 1:
-                raise ApiError(400, "only a single prompt is supported")
-            prompt = prompt[0]
-        if not isinstance(prompt, str) or not prompt:
-            raise ApiError(400, "prompt must be a non-empty string")
+        prompt = self._normalize_legacy_prompt(body)
         temperature = float(body.get("temperature", self.defaults["temperature"]))
         topp = float(body.get("top_p", self.defaults["topp"]))
         presence = float(body.get("presence_penalty") or 0.0)
@@ -333,14 +348,9 @@ class ApiServer:
                 # raw-prompt rows overwrite the chat prefix cache's claim
                 self.cache.clear()
                 self.engine.reset(0)
-                budget = self.engine.seq_len - len(prompt_tokens) - 1
-                if budget <= 0:
-                    raise ApiError(400, "context window exhausted")
-                if max_tokens > 0:
-                    budget = min(budget, max_tokens)
-                sampler = Sampler(temperature, topp,
-                                  seed if seed is not None else int(time.time()),
-                                  presence=presence, frequency=frequency)
+                budget, sampler = self._budget_and_sampler(
+                    len(prompt_tokens), max_tokens, temperature, topp, seed,
+                    presence, frequency)
                 # legacy endpoint: no chat stop strings, only explicit ones
                 content, finish, n_generated = self._run_single(
                     prompt_tokens, budget, sampler, list(extra_stops), emit)

@@ -1,0 +1,183 @@
+"""Turn a TPU window's logs into concrete default recommendations.
+
+The measurement session (tpu_session.sh) is fully unattended; this closes
+the loop on the other side: parse the kbench/ebench/bench logs it left in
+experiments/logs/ and print, mechanically, the decisions PLAYBOOK.md
+describes in prose — decode style ranking, blockdot tile override, prefill
+GEMM routing, flash bucketing flip, unroll choice, MoE scheme. Every
+recommendation cites the numbers it derives from, so a round's
+"committed with data" defaults are reproducible from the logs alone.
+
+Usage: python experiments/decide.py [LOGS_DIR]   (default experiments/logs)
+Exit 0 always; prints NO-DATA sections for stages that never ran. Pure
+stdlib/regex — safe to run anywhere, no JAX import.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import sys
+
+
+def _latest(d: str, pat: str) -> str | None:
+    files = sorted(glob.glob(os.path.join(d, pat)))
+    return files[-1] if files else None
+
+
+def _read(path: str | None) -> str:
+    if path is None:
+        return ""
+    with open(path, errors="replace") as f:
+        return f.read()
+
+
+_ROW = re.compile(r"(\w[\w-]*) ([\w.-]+)=(\d+)us\((\d+)GB/s\)")
+
+
+def parse_kbench_rows(text: str) -> dict[str, dict[str, tuple[int, int]]]:
+    """-> {"m=8 w1": {"BD": (us, gbs), ...}, ...} from run_one output."""
+    out: dict[str, dict[str, tuple[int, int]]] = {}
+    for line in text.splitlines():
+        m = re.match(r"(m=\d+ \w+): (.*)", line)
+        if not m:
+            continue
+        rows = {}
+        for code, _name, us, gbs in _ROW.findall(m.group(2)):
+            rows[code] = (int(us), int(gbs))
+        if rows:
+            out[m.group(1)] = rows
+    return out
+
+
+def decide_kbench(text: str) -> list[str]:
+    rec: list[str] = []
+    rows = parse_kbench_rows(text)
+
+    dec = rows.get("m=8 w1", {})
+    styles = {c: dec[c] for c in ("BD", "LD", "MD", "DQ") if c in dec}
+    if styles:
+        best = min(styles, key=lambda c: styles[c][0])
+        name = {"BD": "blockdot", "LD": "loopdot", "MD": "maskdot", "DQ": "deq"}[best]
+        detail = " ".join(f"{c}={styles[c][0]}us" for c in styles)
+        if best == "BD":
+            rec.append(f"decode STYLE: keep 'auto' (blockdot fastest: {detail})")
+        else:
+            rec.append(f"decode STYLE: set q40_matmul.STYLE='{name}' ({detail})")
+        if "D" in dec and dec[best][1] and dec["D"][1]:
+            ratio = dec[best][1] / dec["D"][1]
+            rec.append(f"  decode GB/s vs bf16 roofline kernel: {ratio:.2f}x "
+                       f"({dec[best][1]} vs {dec['D'][1]} GB/s; >=0.7x is healthy)")
+    else:
+        rec.append("decode STYLE: NO-DATA (no m=8 w1 rows)")
+
+    m_sweep = re.search(r"tile sweep m=\d+ \w+ best-first: (\S+)=(\d+)us", text)
+    if m_sweep and styles and "BD" in styles:
+        tk_tn = re.match(r"tk(\d+)/tn(\d+)", m_sweep.group(1))
+        if tk_tn and int(m_sweep.group(2)) < 0.9 * styles["BD"][0]:
+            rec.append(f"blockdot tiles: set BLOCKDOT_TK={tk_tn.group(1)}, "
+                       f"BLOCKDOT_TN={tk_tn.group(2)} "
+                       f"({m_sweep.group(2)}us vs default {styles['BD'][0]}us, >10% win)")
+        elif tk_tn:
+            rec.append("blockdot tiles: keep defaults (sweep best "
+                       f"{m_sweep.group(2)}us is not >10% under the default pick)")
+
+    for label in ("m=256 w1", "m=512 w1", "m=32 w1"):
+        pf = rows.get(label, {})
+        if "DQ" in pf and "E" in pf:
+            if pf["E"][0] < 0.9 * pf["DQ"][0]:
+                rec.append(f"prefill route: set matmul.XLA_PREFILL_MIN_M={label.split()[0][2:]} "
+                           f"(E={pf['E'][0]}us beats DQ={pf['DQ'][0]}us at {label})")
+            else:
+                rec.append(f"prefill route: keep fused (DQ={pf['DQ'][0]}us vs "
+                           f"E={pf['E'][0]}us at {label})")
+            break
+    if "Q8" in dec and "D" in dec:
+        rec.append(f"q80 fused path: {dec['Q8'][1]} GB/s vs bf16 {dec['D'][1]} GB/s "
+                   f"(informational — Q80-file models only)")
+
+    # flash depth sweep: static vs bucketed at the shallowest position
+    stat = {int(p): int(us) for p, us in
+            re.findall(r"flash decode S=\d+ pos=(\d+): (\d+)us", text)}
+    buck = {int(p): int(us) for p, us in
+            re.findall(r"flash decode BUCKETED S=\d+ pos=(\d+): (\d+)us", text)}
+    common = sorted(set(stat) & set(buck))
+    if common:
+        p0, p1 = common[0], common[-1]
+        win = stat[p0] / buck[p0] if buck[p0] else 0.0
+        deep_ok = buck[p1] <= 1.15 * stat[p1]
+        if win >= 1.3 and deep_ok:
+            rec.append(f"flash buckets: FLIP DLLAMA_FLASH_BUCKETS=1 default "
+                       f"(pos={p0}: {stat[p0]}us -> {buck[p0]}us, {win:.1f}x; "
+                       f"deep pos={p1} within 15%: {stat[p1]} vs {buck[p1]}us)")
+        else:
+            rec.append(f"flash buckets: keep off (pos={p0} win {win:.2f}x, "
+                       f"deep pos={p1}: static {stat[p1]}us vs bucketed {buck[p1]}us)")
+    return rec
+
+
+def decide_ebench(text: str) -> list[str]:
+    rec = []
+    rows = {m.group(1).strip(): float(m.group(2)) for m in
+            re.finditer(r"^([\w+ -]+): decode=[\d.]+ms/tok \((\d+)tok/s\)",
+                        text, re.M)}
+    if rows:
+        best = max(rows, key=rows.get)
+        rec.append(f"engine knobs: best decode config '{best}' "
+                   f"({rows[best]:.1f} tok/s; all: "
+                   + " ".join(f"{k}={v:.1f}" for k, v in sorted(rows.items())) + ")")
+    else:
+        rec.append("engine knobs: NO-DATA (no ebench decode rows parsed)")
+    return rec
+
+
+def decide_bench(text: str) -> list[str]:
+    rec = []
+    m = re.search(r'\{.*"vs_baseline".*\}', text)
+    if not m:
+        return ["bench: NO-DATA (no JSON record line)"]
+    import json
+
+    try:
+        r = json.loads(m.group(0))
+    except ValueError:
+        return ["bench: JSON record unparsable"]
+    rec.append(f"bench headline: {r.get('value')} {r.get('unit')} "
+               f"(vs_baseline {r.get('vs_baseline')}, "
+               f"tpu={'NO' if r.get('tpu_unavailable') else 'yes'})")
+    moe = r.get("moe") or {}
+    times = {k: moe[k] for k in ("sort_ms", "dispatch_ms", "dense_ms") if k in moe}
+    if times:
+        best = min(times, key=times.get)
+        rec.append(f"moe auto: '{best.split('_')[0]}' is fastest "
+                   + " ".join(f"{k}={v}" for k, v in times.items())
+                   + (" — matches the shipped default" if best == "sort_ms"
+                      else " — flip ops.layers auto accordingly"))
+    pre = r.get("presets") or {}
+    base = pre.get("8b_long") or {}
+    ab = pre.get("8b_long_bucketed") or {}
+    if "decode_ms_per_token" in base and "decode_ms_per_token" in ab:
+        rec.append(f"8b_long bucketed A/B: {base['decode_ms_per_token']}ms -> "
+                   f"{ab['decode_ms_per_token']}ms per token "
+                   + ("(flip DLLAMA_FLASH_BUCKETS=1)"
+                      if ab["decode_ms_per_token"] < 0.9 * base["decode_ms_per_token"]
+                      else "(keep off)"))
+    return rec
+
+
+def main() -> None:
+    d = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "logs")
+    for title, pat, fn in (("kbench", "kbench_*.log", decide_kbench),
+                           ("ebench", "ebench_*.log", decide_ebench),
+                           ("bench", "bench_*.log", decide_bench)):
+        path = _latest(d, pat)
+        print(f"== {title}: {os.path.basename(path) if path else 'NO LOG'}")
+        for line in fn(_read(path)) if path else ():
+            print("  " + line)
+    print("DECIDE DONE")
+
+
+if __name__ == "__main__":
+    main()

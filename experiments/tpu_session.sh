@@ -110,4 +110,7 @@ if [ "$VFAIL" = "0" ] && ! grep -q FAIL "$L/validate_$TS.log"; then
   echo "VALIDATE STAGE CLEAN (groups: $VGROUPS)"
 fi
 
+echo "== 8. decision summary (pure log parsing, no device)"
+python experiments/decide.py "$L" 2>&1 | tee "$L/decide_$TS.log"
+
 echo "== done; logs in $L/*_$TS.log"

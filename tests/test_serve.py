@@ -274,3 +274,8 @@ def test_stream_validation_errors_before_headers(server):
     st2, data2 = post(port, "/v1/chat/completions",
                       {"messages": [], "stream": True})
     assert st2 == 400 and b"messages" in data2
+    # malformed shapes too (a TypeError after headers would corrupt the stream)
+    for bad in ("hi", [{"content": "x"}]):
+        st3, _ = post(port, "/v1/chat/completions",
+                      {"messages": bad, "stream": True})
+        assert st3 == 400
