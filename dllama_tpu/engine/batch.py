@@ -552,6 +552,16 @@ class BatchEngine:
         self.last_token[self.active] = toks[-1, self.active]
         return toks
 
+    def spec_eligible(self) -> np.ndarray:
+        """bool[B]: slots the next spec_step cycle will serve rather than
+        freeze — active, K+1 rows of cache room, no repetition penalties.
+        THE freeze rule: spec_step uses this mask verbatim, and the serving
+        scheduler keys its spec/decode alternation off it, so a new freeze
+        condition added here reaches both automatically."""
+        room_ok = self.pos + self.spec_k + 1 <= self.seq_len
+        pen = (self.presence != 0) | (self.frequency != 0)
+        return self.active & room_ok & ~pen
+
     def spec_step(self) -> tuple[np.ndarray, np.ndarray]:
         """One speculative verify cycle across the batch: returns
         (tokens [B, K+1], counts [B]) where each active slot emitted
@@ -560,8 +570,12 @@ class BatchEngine:
         Costs ~one decode step (the forward is HBM-bound; K+1 rows ride the
         same weight stream), so greedy acceptance multiplies batch tok/s.
 
-        Slots within K+1 rows of seq_len are frozen for the cycle (their KV
-        writes would overflow); finish those with decode()/release(). The
+        Slots within K+1 rows of seq_len — and slots with repetition
+        penalties, whose sampling needs the counts-carrying decode path (spec
+        acceptance compares raw argmax) — are frozen for the cycle: they emit
+        nothing and their PRNG/history/pos state is untouched. Advance them
+        with decode(); a caller serving a mixed batch alternates spec cycles
+        with decode chunks so frozen slots still reach their finish. The
         reference decodes strictly one token per forward per request
         (dllama.cpp:69-88) and its server has no batching at all — this is
         both lifted to the serving tier at once."""
@@ -569,17 +583,11 @@ class BatchEngine:
             raise ValueError("engine built with spec=0")
         if not self.active.any():
             raise ValueError("no active slots")
-        room_ok = self.pos + self.spec_k + 1 <= self.seq_len
-        eff = self.active & room_ok
+        eff = self.spec_eligible()
         if not eff.any():
-            raise ValueError("no active slot has room for a spec cycle; "
-                             "use decode() or release the full slots")
-        if ((self.presence[eff] != 0) | (self.frequency[eff] != 0)).any():
-            # spec cycles don't carry penalty counts (greedy acceptance would
-            # compare against raw argmax); the scheduler routes penalized
-            # batches through decode() — enforce it here too
-            raise ValueError("spec_step cannot serve slots with repetition "
-                             "penalties; use decode()")
+            raise ValueError("no active slot is spec-eligible (needs room for "
+                             "K+1 rows and no repetition penalties); use "
+                             "decode() or release the full slots")
         emit, adv, nxt, self.cache, self.history, keys = self._spec_step(
             self.params, self.cache, self.history,
             jnp.asarray(self.last_token.copy()),

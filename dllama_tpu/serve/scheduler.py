@@ -109,6 +109,10 @@ class Scheduler:
         # consecutive decode chunks whenever admission work ran in between —
         # the stall decoding slots actually experienced
         self._admit_gaps_ms: list[float] = []
+        # mixed-batch speculation: when some active slot is spec-ineligible
+        # (near seq_len or penalized), spec cycles freeze it — alternate spec
+        # with plain decode chunks so it still advances (toggle state)
+        self._spec_tick = False
         self._completed: list[Request] = []  # ring of recent requests (metrics)
         self._metrics_lock = threading.Lock()
         self._wake = threading.Event()
@@ -359,21 +363,24 @@ class Scheduler:
                     self._admit_gaps_ms.append(gap_ms)
                     del self._admit_gaps_ms[:-256]
             start_rows = {s: int(self.engine.pos[s]) for s in self.slots}
-            # speculative cycle when every in-flight slot has a K+1 window of
-            # cache room AND at least one slot is greedy (sampled slots never
-            # accept drafts, so an all-sampled batch would pay the (K+1)-wide
-            # forward for one token per cycle); otherwise a plain chunk
-            # advances the near-full slots to their length finish (spec_step
-            # freezes them, which would livelock here)
-            use_spec = (
-                bool(getattr(self.engine, "spec_k", 0))
-                and any(float(self.engine.temperature[s]) == 0.0 for s in self.slots)
-                and not any(r.presence or r.frequency for r in self.slots.values())
-                and all(
-                    start_rows[s] + self.engine.spec_k + 1 <= self.engine.seq_len
+            # speculative cycle when some slot can profit: greedy (sampled
+            # slots never accept drafts), K+1 rows of cache room, and no
+            # repetition penalties (spec acceptance compares raw argmax;
+            # penalized sampling rides the counts-carrying decode path).
+            # Ineligible slots are frozen by spec_step, not poisoned — a
+            # mixed batch alternates spec cycles with plain decode chunks so
+            # frozen slots still advance to their finish (no livelock) while
+            # eligible ones keep multi-token acceptance on their cycles.
+            use_spec = False
+            if getattr(self.engine, "spec_k", 0):
+                elig = self.engine.spec_eligible()  # the engine's freeze rule
+                use_spec = any(
+                    elig[s] and float(self.engine.temperature[s]) == 0.0
                     for s in self.slots
                 )
-            )
+                if use_spec and not all(elig[s] for s in self.slots):
+                    self._spec_tick = not self._spec_tick
+                    use_spec = self._spec_tick
             try:
                 if use_spec:
                     emit_toks, adv = self.engine.spec_step()

@@ -335,3 +335,39 @@ def test_scheduler_spec_matches_plain_greedy():
     want, want_fin = run(0)
     got, got_fin = run(6)
     assert got == want and got_fin == want_fin == "length"
+
+
+def test_scheduler_spec_survives_mixed_penalized_batch():
+    """One penalized request in the batch must not disable speculation for
+    everyone (VERDICT r4 next #6): the scheduler alternates spec cycles with
+    decode chunks, and BOTH streams match their spec=0 runs exactly."""
+    import jax.numpy as jnp
+
+    from dllama_tpu.engine.batch import BatchEngine
+    from dllama_tpu.models.config import LlamaConfig
+    from dllama_tpu.models.llama import random_params
+    from dllama_tpu.serve.scheduler import Scheduler
+
+    cfg = LlamaConfig(dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                      vocab_size=96, seq_len=64)
+    params = random_params(cfg, seed=4, dtype=jnp.float32, quantize=False)
+    p_plain, p_pen = [1, 2, 3, 1, 2, 3, 1, 2], [9, 8, 7]
+
+    def run(spec):
+        eng = BatchEngine(cfg, params, n_slots=2, cache_dtype=jnp.float32,
+                          spec=spec)
+        sched = Scheduler(eng, chunk=4)
+        try:
+            r1 = sched.submit(p_plain, 0.0, 0.9, 16, eos_ids=frozenset())
+            r2 = sched.submit(p_pen, 0.0, 0.9, 16, eos_ids=frozenset(),
+                              presence=0.6, frequency=0.4)
+            out2 = list(r2.tokens())  # drain penalized first: r1 keeps the
+            out1 = list(r1.tokens())  # batch mixed while r2 is in flight
+            return out1, out2
+        finally:
+            sched.shutdown()
+
+    want1, want2 = run(0)
+    got1, got2 = run(6)
+    assert got1 == want1
+    assert got2 == want2
