@@ -70,6 +70,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host", default="127.0.0.1", help="HTTP bind address (serve mode)")
     p.add_argument("--slots", type=int, default=0,
                    help="serve mode: continuous-batching slots (0 = single-request + prefix cache)")
+    p.add_argument("--admit-budget-ms", type=float, default=None,
+                   help="serve mode, needs --slots > 0: max decode stall (ms) a "
+                        "joining prompt's prefill may insert per visit (default "
+                        "250; 0 = strict one-chunk-per-decode interleaving)")
+    p.add_argument("--admit-ttft-deadline-ms", type=float, default=None,
+                   help="serve mode, needs --slots > 0: joiners older than this "
+                        "pump their prefill to completion despite the stall "
+                        "budget (hard TTFT bound; default off)")
     p.add_argument("--kernels", choices=["auto", "pallas", "xla"], default="auto")
     p.add_argument("--fuse-weights", action="store_true",
                    help="fused wqkv/w13 kernel launches (single-device engines; "
@@ -299,6 +307,8 @@ def cmd_serve(args) -> int:
         default_topp=args.topp,
         spec=args.spec,
         default_seed=args.seed,
+        admit_stall_budget_ms=args.admit_budget_ms,
+        admit_ttft_deadline_ms=args.admit_ttft_deadline_ms,
     )
 
 

@@ -523,7 +523,16 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
             sync=getattr(loaded, "sync", "bf16"),
             spec=spec_n,
         )
-        scheduler = Scheduler(be)
+        # admission pacing (serve/scheduler.py): budget bounds the decode
+        # stall a joining prefill may insert per visit; the optional TTFT
+        # deadline hard-bounds a joiner's wait (CLI: --admit-budget-ms /
+        # --admit-ttft-deadline-ms)
+        sched_kw = {}
+        if defaults.get("admit_stall_budget_ms") is not None:
+            sched_kw["admit_stall_budget_ms"] = float(defaults["admit_stall_budget_ms"])
+        if defaults.get("admit_ttft_deadline_ms") is not None:
+            sched_kw["admit_ttft_deadline_ms"] = float(defaults["admit_ttft_deadline_ms"])
+        scheduler = Scheduler(be, **sched_kw)
     api = ApiServer(
         loaded,
         default_temperature=defaults.get("default_temperature", 0.8),

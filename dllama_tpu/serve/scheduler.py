@@ -29,6 +29,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+import jax
 import numpy as np
 
 from dllama_tpu.engine.batch import BatchEngine
@@ -86,16 +87,29 @@ class Request:
 
 class Scheduler:
     def __init__(self, engine: BatchEngine, chunk: int = 4, admit_timeout: float = 0.05,
-                 admit_interleave: bool = True):
+                 admit_interleave: bool = True,
+                 admit_stall_budget_ms: float = 250.0,
+                 admit_ttft_deadline_ms: float | None = None):
         self.engine = engine
         self.chunk = chunk
         self.admit_timeout = admit_timeout
-        # interleaved admission (VERDICT r3 weak #5): pump ONE prefill chunk
-        # of a joining prompt per decode chunk instead of running the whole
+        # interleaved admission (VERDICT r3 weak #5): pump prefill chunks of a
+        # joining prompt BETWEEN decode chunks instead of running the whole
         # chunked prefill synchronously — a 2 Ki-token admission no longer
         # stalls every decoding slot for its full prefill. False = legacy
         # synchronous admission (the A/B baseline, experiments/abench.py).
         self.admit_interleave = admit_interleave
+        # pacing (VERDICT r4 weak #3: fixed 1-chunk pacing cost joiners 5-6x
+        # TTFT on slow chunks): each admission visit keeps pumping prefill
+        # chunks until ~budget ms elapsed, so decoders stall at most
+        # budget + one chunk while joiner TTFT approaches the synchronous
+        # floor whenever chunks are fast (always, on a TPU). 0 restores
+        # strict one-chunk-per-decode pacing.
+        self.admit_stall_budget_ms = float(admit_stall_budget_ms)
+        # optional hard TTFT bound: an admission older than this pumps to
+        # completion regardless of the stall budget (decoders eat one big
+        # stall rather than the joiner waiting forever behind a slow batch)
+        self.admit_ttft_deadline_ms = admit_ttft_deadline_ms
         self.pending: queue.Queue[Request] = queue.Queue()
         self.slots: dict[int, Request] = {}
         # admissions being pumped chunk-by-chunk: [(req, Admission), ...];
@@ -305,10 +319,13 @@ class Scheduler:
         self._finish(req, reason)
 
     def _pump_admissions(self) -> bool:
-        """Advance in-flight admissions: ONE prefill chunk of the head
-        admission when interleaving (decode chunks run between calls), the
-        whole queue when not. Returns True if any admission work ran."""
+        """Advance in-flight admissions: when interleaving, pump prefill
+        chunks of the head admission until the stall budget is spent (decode
+        chunks run between calls); when not, the whole queue. An admission
+        past the TTFT deadline ignores the budget and pumps to completion.
+        Returns True if any admission work ran."""
         worked = False
+        t0 = time.monotonic()
         while self._inflight:
             req, adm, reuse = self._inflight[0]
             if req.cancelled.is_set():
@@ -317,6 +334,19 @@ class Scheduler:
                 continue
             try:
                 done = self.engine.add_step(adm)
+                if self.slots and adm.logits is not None:
+                    # sync whenever decoders could stall: JAX dispatch is
+                    # async, so without this the pacing clock AND the
+                    # admission-gap metric would see host dispatch time only
+                    # (near zero on TPU) while the chunk's device time
+                    # silently serialized into the next decode chunk —
+                    # under-pacing the budget and mis-attributing the stall.
+                    # Applied in every admission mode so the sync/strict/
+                    # paced A/B compares like with like; the chunk must
+                    # finish before the next decode chunk anyway (same
+                    # device stream). With no decoders there is no stall to
+                    # attribute and dispatch stays pipelined.
+                    jax.block_until_ready(adm.logits)
                 worked = True
                 if done:
                     first = self.engine.add_commit(adm, req.temperature, req.topp,
@@ -333,9 +363,30 @@ class Scheduler:
                 self._inflight.pop(0)
                 self._abort_admission(req, adm, e)
                 continue
-            if self.admit_interleave and self.slots:
-                # one chunk per loop iteration: let a decode chunk run now
+            if not (self.admit_interleave and self.slots):
+                continue  # no decoders to protect: drain the queue
+            # evaluated AFTER the chunk ran (and its device sync), so an
+            # admission that crosses the deadline during the chunk is
+            # honored this visit, not one decode chunk late
+            overdue = (
+                self.admit_ttft_deadline_ms is not None
+                and (time.monotonic() - req.submitted_at) * 1000.0
+                >= self.admit_ttft_deadline_ms
+            )
+            if done and overdue:
+                # an overdue admission just committed under the deadline
+                # override: yield a decode chunk before touching the next
+                # head, so a burst of overdue joiners costs one prefill per
+                # visit — never the sum of all of them — regardless of how
+                # much budget the override left unspent
                 return worked
+            if (time.monotonic() - t0) * 1000.0 < self.admit_stall_budget_ms:
+                continue  # cheap so far: keep pumping
+            if not done and overdue:
+                # TTFT deadline: finish THIS admission despite the budget
+                continue
+            # stall budget spent: let a decode chunk run now
+            return worked
         return worked
 
     def _run(self) -> None:

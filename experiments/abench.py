@@ -1,9 +1,11 @@
 """Admission-stall A/B bench: what do decoding batch-mates experience while a
 long prompt joins the batch? (VERDICT r3 #4 / weak #5.)
 
-Runs the serving tier twice — admit_interleave=False (legacy synchronous
-admission: the whole chunked prefill runs between two decode chunks) vs True
-(one prefill chunk per decode chunk) — and reports, for each mode:
+Runs the serving tier three times — 'synchronous' (legacy: the whole chunked
+prefill runs between two decode chunks), 'strict' (one prefill chunk per
+decode chunk; the r4 default whose joiner TTFT was unbounded, r4 weak #3) and
+'paced' (the shipped default: prefill chunks pumped per visit until the
+scheduler's stall budget is spent) — and reports, for each mode:
 
 * client_gap_ms_max — the largest inter-token gap a DECODING request's
   stream observed while the admission was in flight (chunk-granular, i.e.
@@ -63,10 +65,10 @@ def main():
     # bench.bench_admission (prefix-cache reuse would gut the A/B otherwise)
     warm_prompt, bg_maker, long_prompt = admission_streams(cfg, pf_chunk, prompt_len)
 
-    def run(interleave: bool) -> dict:
+    def run(mode: str, **kw) -> dict:
         eng = BatchEngine(cfg, params, n_slots=n_slots, cache_dtype=jnp.bfloat16,
                           max_prefill_chunk=pf_chunk)
-        sched = Scheduler(eng, chunk=chunk, admit_interleave=interleave)
+        sched = Scheduler(eng, chunk=chunk, **kw)
         try:
             w = sched.submit(warm_prompt, 0.0, 0.9, chunk, frozenset(), seed=7)
             list(w.tokens())
@@ -95,7 +97,7 @@ def main():
             after = gaps[arr[1:] > t_admit]
             s = sched.latency_summary()
             return {
-                "mode": "interleave" if interleave else "synchronous",
+                "mode": mode,
                 "client_gap_ms_base": round(float(np.max(before)), 1) if before.size else None,
                 "client_gap_ms_max": round(float(np.max(after)), 1) if after.size else None,
                 "sched_stall_ms_max": round(s["admission_stall_ms_max"], 1)
@@ -108,21 +110,36 @@ def main():
         finally:
             sched.shutdown()
 
-    rows = []
-    for mode in (False, True):
+    from bench import ADMISSION_MODES
+
+    # same policy table as bench.bench_admission; 'sync' reads better as
+    # 'synchronous' in these human-facing rows
+    modes = {("synchronous" if m == "sync" else m): kw
+             for m, kw in ADMISSION_MODES.items()}
+    rows = {}
+    for mode, kw in modes.items():
         try:
-            r = run(mode)
-            rows.append(r)
+            r = run(mode, **kw)
+            rows[mode] = r
             print(r, flush=True)
         except Exception as e:
-            print(f"{'interleave' if mode else 'synchronous'}: FAILED {e!r}"[:300],
-                  flush=True)
-    if (len(rows) == 2 and rows[0]["client_gap_ms_max"] is not None
-            and rows[1]["client_gap_ms_max"] is not None):
+            print(f"{mode}: FAILED {e!r}"[:300], flush=True)
+    if len(rows) == 3 and all(r["client_gap_ms_max"] is not None
+                              for r in rows.values()):
         # timer-noise floor: a 0.0 best-case yields a large finite ratio
-        ratio = rows[0]["client_gap_ms_max"] / max(rows[1]["client_gap_ms_max"], 0.05)
-        print(f"stall reduction (sync/interleave): {ratio:.1f}x", flush=True)
-    print(f"ABENCH DONE fails={2 - len(rows)}", flush=True)
+        gap = {m: rows[m]["client_gap_ms_max"] for m in rows}
+        ttft = {m: rows[m]["long_ttft_ms"] for m in rows}
+        print(f"stall reduction (sync/paced): {gap['synchronous'] / max(gap['paced'], 0.05):.1f}x",
+              flush=True)
+        # the r4 weak-#3 acceptance bar: the default (paced) must keep BOTH
+        # metrics within 2x of the best mode for that metric
+        best_gap, best_ttft = min(gap.values()), min(ttft.values())
+        ok = (gap["paced"] <= 2 * max(best_gap, 0.05)
+              and ttft["paced"] <= 2 * max(best_ttft, 0.05))
+        print(f"paced within 2x of best on stall ({gap['paced']:.1f} vs {best_gap:.1f}) "
+              f"and ttft ({ttft['paced']:.1f} vs {best_ttft:.1f}): "
+              f"{'PASS' if ok else 'FAIL'}", flush=True)
+    print(f"ABENCH DONE fails={3 - len(rows)}", flush=True)
 
 
 if __name__ == "__main__":

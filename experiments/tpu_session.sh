@@ -87,8 +87,8 @@ echo "== 5. engine-knob A/B (1B, one process)"
 timeout 900 env PYTHONPATH="$PP" python experiments/ebench.py $EB_N 2>&1 | tee "$L/ebench_$TS.log"
 probe || { echo "tunnel wedged after ebench"; exit 1; }
 
-echo "== 6. admission-stall A/B (8b serving tier, sync vs interleaved)"
-timeout 900 env PYTHONPATH="$PP" python experiments/abench.py $AB_ARGS 2>&1 | tee "$L/abench_$TS.log"
+echo "== 6. admission-stall A/B (8b serving tier, sync vs strict vs paced)"
+timeout 1400 env PYTHONPATH="$PP" python experiments/abench.py $AB_ARGS 2>&1 | tee "$L/abench_$TS.log"
 probe || { echo "tunnel wedged after abench"; exit 1; }
 
 echo "== 7. kernel validation (per-group, each timeout-bounded)"
