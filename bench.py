@@ -1,12 +1,14 @@
 """Benchmark: single-chip throughput on synthetic Q40 Llamas (1B + 8B).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
-The headline value is the best tokens/sec/chip across configs — the north
-star (BASELINE.json) is Llama-3.1-8B-Q40 at 1000 tok/s/chip, a serving
-throughput number, so the batched-decode sweep (BatchEngine slots) is what
-vs_baseline is judged on; batch=1 decode/prefill latency per preset is
-reported alongside (presets.{1b,8b}), size-adjusted like before
-(north_star = 1000 * 8.03e9 / params).
+The headline value is the best tokens/sec/chip across configs. vs_baseline
+has ONE pinned definition (VERDICT r4 weak #8): 8B serving aggregate
+tok/s/chip / 1000 — BASELINE.json's north star (Llama-3.1-8B-Q40 at
+1000 tok/s/chip) — emitted only when this run measured that config
+(vs_baseline_config names the winning row; 0.0 + null means unmeasured this
+run, e.g. a tiny-preset CPU fallback). Everything else — batch=1
+decode/prefill latency per preset, the tiny/1b rows, f8/spec sweep rows —
+rides along as named fields and never feeds vs_baseline.
 
 Hardened against the axon-tunnel wedge (VERDICT r1 #1): the parent process
 never initializes a JAX backend. It probes the tunnel in a subprocess with a
@@ -686,6 +688,13 @@ def worker():
     results = {}
     batch_results = []
     best = (0.0, "", 0.0)  # (tok_s/north_star, label, tok_s)
+    # vs_baseline is PINNED (VERDICT r4 weak #8: its semantics drifted across
+    # rounds): it is 8B serving aggregate tok/s/chip / 1000 — BASELINE.json's
+    # north star — and is emitted ONLY when this run measured that exact
+    # config. Every other preset rides along as named fields; a tiny-preset
+    # CPU fallback reports 0.0 + vs_baseline_config=null instead of a
+    # tiny-normalized number that isn't comparable round-over-round.
+    pinned = (0.0, None)  # (agg_tok_s / 1000, config label) for the 8b sweep
     setup_s = 0.0
     params, last_pkey = None, None
 
@@ -701,7 +710,9 @@ def worker():
                 "metric": f"tokens/sec/chip, {best[1]} (PARTIAL: worker died "
                           f"mid-run), Q40 synthetic, 1 chip ({dev.platform})",
                 "value": best[2], "unit": "tok/s",
-                "vs_baseline": round(best[0], 4),
+                "vs_baseline": round(pinned[0], 4),
+                "vs_baseline_def": "8B serving aggregate tok/s/chip / 1000 (BASELINE.json)",
+                "vs_baseline_config": pinned[1],
                 "presets": dict(results), "batch": list(batch_results),
                 "device": str(dev), "partial": True,
             }
@@ -791,6 +802,9 @@ def worker():
                 batch_results.append(br)
                 if br["agg_tok_s"] / north > best[0]:
                     best = (br["agg_tok_s"] / north, f"{LABELS[name]} {slots}-slot serving", br["agg_tok_s"])
+                if name == "8b" and br["agg_tok_s"] / 1000.0 > pinned[0]:
+                    pinned = (br["agg_tok_s"] / 1000.0,
+                              f"8b {slots}-slot serving ({br['path']})")
                 dump_partial()
             # f8-cache variant at the largest slot count that produced a bf16
             # row (half the cache bytes — the sweep's bottleneck), with that
@@ -813,6 +827,9 @@ def worker():
                         best = (br["agg_tok_s"] / north,
                                 f"{LABELS[name]} {slots_f8}-slot serving (f8 KV)",
                                 br["agg_tok_s"])
+                    # deliberately NOT fed into pinned/vs_baseline: the pinned
+                    # number compares bf16-cache serving round-over-round; the
+                    # f8 row is a named capacity data point alongside
                     dump_partial()
                 except Exception as e:
                     batch_results.append({"slots": "f8", "error": repr(e)[:200]})
@@ -968,7 +985,11 @@ def worker():
         "metric": f"tokens/sec/chip, {best[1]}, Q40 synthetic, 1 chip ({dev.platform})",
         "value": best[2],
         "unit": "tok/s",
-        "vs_baseline": round(best[0], 4),
+        # pinned definition — comparable by construction round-over-round;
+        # 0.0 + config null = the north-star config wasn't measured this run
+        "vs_baseline": round(pinned[0], 4),
+        "vs_baseline_def": "8B serving aggregate tok/s/chip / 1000 (BASELINE.json)",
+        "vs_baseline_config": pinned[1],
         "presets": results,
         "batch": batch_results,
         "device": str(dev),

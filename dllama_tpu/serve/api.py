@@ -499,6 +499,15 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
     device). n_slots == 0 keeps the single-engine tier with the NaiveCache
     prefix reuse (the reference server's semantics)."""
     scheduler = None
+    if n_slots <= 0 and any(
+        defaults.get(k) is not None
+        for k in ("admit_stall_budget_ms", "admit_ttft_deadline_ms")
+    ):
+        # same treatment as --spec on dp>1 meshes: an inapplicable serve
+        # knob warns instead of vanishing silently
+        log.warning("admission pacing flags (--admit-budget-ms / "
+                    "--admit-ttft-deadline-ms) need --slots > 0; the "
+                    "single-engine tier has no admission scheduler — ignored")
     if n_slots > 0:
         from dllama_tpu.engine.batch import BatchEngine
         from dllama_tpu.serve.scheduler import Scheduler
