@@ -1,0 +1,35 @@
+"""Control canary: compile + run ONE tiny NON-flash Pallas kernel (the q40
+blockdot decode matmul, the shape class the 2026-07-31 window PASSed before
+wedging at the first flash compile — TPU_VALIDATE_r04.md).
+
+Runs immediately before canary_flash.py in the session script. Its verdict is
+what turns a later flash-canary hang into a yes/no wedge diagnosis instead of
+an ambiguity (VERDICT r4 next #2 / #9):
+
+  control OK + flash hang + post-hang probe dead  -> flash compile wedges the
+                                                     server (reproduced)
+  control OK + flash hang + post-hang probe alive -> flash-specific client
+                                                     hang; server fine
+  control hang                                    -> wedge is NOT flash-
+                                                     specific (general Mosaic
+                                                     compile / tunnel wedge)
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dllama_tpu.ops.pallas import q40_matmul as qmod
+from dllama_tpu.ops.quant import QTensor
+
+interp = jax.devices()[0].platform != "tpu"
+rng = np.random.default_rng(0)
+w = QTensor.quantize((rng.standard_normal((512, 512)) * 0.05).astype(np.float32))
+x = jnp.asarray(rng.standard_normal((8, 512)), jnp.bfloat16)
+qmod.STYLE = "blockdot"
+try:
+    out = qmod.q40_matmul(x, w, interpret=interp)
+    jax.block_until_ready(out)
+finally:
+    qmod.STYLE = "auto"
+assert np.isfinite(np.asarray(out, np.float32)).all()
+print("CONTROL CANARY OK", flush=True)

@@ -11,18 +11,26 @@ Checks, each vs the XLA reference:
 Prints PASS/FAIL per item; exits nonzero on any FAIL.
 
 Usage: python experiments/tpu_validate.py [GROUP ...]
-GROUPs: q40 q80 flash engine spec (default: all). The session script runs each
-group as its own `timeout`-bounded process so a tunnel wedge (the
-2026-07-31 window died at the first flash compile, TPU_VALIDATE_r04.md)
-costs one group's timeout, not the whole stage.
+GROUPs: q40 q80 flash engine spec wcls (default: all except wcls). The
+session script runs each group as its own `timeout`-bounded process so a
+tunnel wedge (the 2026-07-31 window died at the first flash compile,
+TPU_VALIDATE_r04.md) costs one group's timeout, not the whole stage.
+
+`wcls` (VERDICT r4 weak #6: on-chip PASSes covered one w1-sized shape
+point) validates the decode/prefill q40 ladder and the fused q80 kernel at
+the 8B preset's REAL classifier-head shape (4096x128256) — random Q40/Q80
+codes, so no multi-GB host quantization; parity is vs XLA dequant-dot on
+the same bits. Opt-in (not in the default list) because interpret-mode CPU
+runs would crawl at this width; the session script requests it explicitly.
 """
 import sys
 import time
 
 import numpy as np
 
-_KNOWN_GROUPS = ("q40", "q80", "flash", "engine", "spec")
-GROUPS = [a for a in sys.argv[1:] if not a.startswith("-")] or list(_KNOWN_GROUPS)
+_KNOWN_GROUPS = ("q40", "q80", "flash", "engine", "spec", "wcls")
+_DEFAULT_GROUPS = ("q40", "q80", "flash", "engine", "spec")
+GROUPS = [a for a in sys.argv[1:] if not a.startswith("-")] or list(_DEFAULT_GROUPS)
 _bad = set(GROUPS) - set(_KNOWN_GROUPS)
 if _bad:
     # a typo'd group must not run zero checks and still print the green
@@ -94,6 +102,64 @@ if "q80" in GROUPS:
         except Exception as e:
             failures.append(f"q80-m{m}")
             print(f"FAIL q80 m={m} (compile/run): {str(e)[:400]}", flush=True)
+
+if "wcls" in GROUPS:
+    # vocab-wide (8B wcls: 4096x128256) parity for the q40 decode/prefill
+    # ladder and the fused q80 kernel. Weights are RANDOM CODES in the
+    # device layout (bit-exact parity vs XLA dequant of the same bits needs
+    # no realistic values), so host setup is cheap; each matmul also gets a
+    # crude wall-time print — a window datum at the real head shape.
+    from dllama_tpu.ops.quant import Q_BLOCK, Q8Tensor
+
+    # full-range random codes make outputs O(50), so the reference must be
+    # f32 (a bf16-rounded reference's own error exceeds rtol at k=4096);
+    # atol 0.5 ~ 1% of typical magnitude absorbs cancellation-killed entries
+    K8, N8 = 4096, 128256
+
+    def timed_check(name, kernel_fn, wd, m):
+        """warm (compile) -> parity vs f32 dequant reference -> mean ms over
+        3 timed calls; one protocol for every wcls row."""
+        x = jnp.asarray(rng.standard_normal((m, K8)), jnp.bfloat16)
+        try:
+            want = jnp.dot(x.astype(jnp.float32), wd,
+                           preferred_element_type=jnp.float32
+                           ).astype(jnp.bfloat16).block_until_ready()
+            got = kernel_fn(x).block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(3):
+                got = kernel_fn(x)
+            got.block_until_ready()
+            dt = (time.perf_counter() - t0) / 3
+            check(f"{name} [{dt*1e3:.2f} ms/call]", got, want, atol=0.5)
+        except Exception as e:
+            failures.append(f"wcls-{name.split()[0]}-{name.split()[1]}")
+            print(f"FAIL {name} (compile/run): {str(e)[:400]}", flush=True)
+
+    packed_np = rng.integers(0, 256, (K8 // 2, N8), dtype=np.uint8)
+    scales_np = rng.uniform(0.005, 0.05, (K8 // Q_BLOCK, N8)).astype(np.float16)
+    wq = QTensor(jnp.asarray(packed_np), jnp.asarray(scales_np))
+    wqd = wq.dequantize(jnp.float32)
+    # m=256 deq matches both the real prefill chunk and the AOT gate's
+    # wcls8b row — the window runs exactly the pre-gated shapes
+    for style, m in (("blockdot", 8), ("deq", 256)):
+        qmod.STYLE = style
+        try:
+            timed_check(f"q40 {style} m={m} wcls8b(4096x128256)",
+                        lambda x: qmod.q40_matmul(x, wq, interpret=_interp),
+                        wqd, m)
+        finally:
+            qmod.STYLE = "auto"
+    del wqd, wq
+
+    from dllama_tpu.ops.pallas.q80_matmul import q80_matmul as _q80mm
+
+    w8w = Q8Tensor(jnp.asarray(rng.integers(-127, 128, (K8, N8), dtype=np.int8)),
+                   jnp.asarray(rng.uniform(0.005, 0.05,
+                                           (K8 // Q_BLOCK, N8)).astype(np.float16)))
+    w8wd = w8w.dequantize(jnp.float32)
+    timed_check("q80 blockdot m=8 wcls8b(4096x128256)",
+                lambda x: _q80mm(x, w8w, interpret=_interp), w8wd, 8)
+    del w8wd, w8w
 
 if "flash" in GROUPS:
     # flash attention with pruning

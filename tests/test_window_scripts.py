@@ -292,7 +292,9 @@ def test_tpu_session_shell_end_to_end():
         text=True, timeout=2400, env=env,
     )
     assert p.returncode == 0, f"stdout:\n{p.stdout[-3000:]}\nstderr:\n{p.stderr[-2000:]}"
-    for marker in ("canary ok", "TOTAL ALL PASS", "KBENCH DONE",
+    # "flash canary ok" is deliberately NOT a substring of "control canary
+    # ok": each canary's success must be asserted independently
+    for marker in ("control canary ok", "flash canary ok", "TOTAL ALL PASS", "KBENCH DONE",
                    "EBENCH DONE fails=0", "ABENCH DONE fails=0",
                    # the full group list: a failing canary would degrade
                    # VGROUPS to just q40, which must not pass CI silently
