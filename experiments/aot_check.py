@@ -83,14 +83,30 @@ def cases(full: bool):
 
     style_case("blockdot m=8 w1(2048x8192)", "blockdot", 8, 2048, 8192, True)
     style_case("blockdot m=8 wcls(2048x128256)", "blockdot", 8, 2048, 128256, True)
-    # the 8B preset's wcls (dim 4096) — the widest shape the flagship hits;
-    # VERDICT r4 weak #6: on-chip validation covered one shape point, so the
-    # offline gate must at least prove lowering at the real vocab-wide shapes
-    style_case("blockdot m=8 wcls8b(4096x128256)", "blockdot", 8, 4096, 128256, True)
-    # m=256 matches the on-chip wcls validate group's deq row exactly — the
-    # gate must pre-compile the very shapes the window will run
-    style_case("deq m=256 wcls8b(4096x128256)", "deq", 256, 4096, 128256, True)
     style_case("deq m=256 w1(2048x8192)", "deq", 256, 2048, 8192, True)
+
+    # the 8B preset's wcls (dim 4096) — the widest shape the flagship hits
+    # (VERDICT r4 weak #6: on-chip validation covered one w1-sized point).
+    # UNSTACKED 2-D weights with f16 scales and no layer index: byte-for-byte
+    # the operands production wcls and the window's wcls validate group run,
+    # so the gate pre-proves (and the compile cache pre-warms) those exact
+    # executables.
+    def flat_case(name, style, m, k, n):
+        def fn(x, p, s, style=style):
+            qmod.STYLE = style
+            try:
+                return qmod.q40_matmul(x, QTensor(p, s))
+            finally:
+                qmod.STYLE = "auto"
+
+        out.append((name, fn,
+                    (S((m, k), jnp.bfloat16), S((k // 2, n), jnp.uint8),
+                     S((k // Q_BLOCK, n), jnp.float16)), True))
+
+    from dllama_tpu.ops.quant import QTensor
+
+    flat_case("blockdot m=8 wcls8b(4096x128256) flat", "blockdot", 8, 4096, 128256)
+    flat_case("deq m=256 wcls8b(4096x128256) flat", "deq", 256, 4096, 128256)
     style_case("maskdot m=8 w1", "maskdot", 8, 2048, 8192, False)
     style_case("loopdot m=8 w1", "loopdot", 8, 2048, 8192, False)
     if full:
@@ -110,12 +126,12 @@ def cases(full: bool):
                     lambda x, l, c, s: q80_matmul(x, Q8Tensor(c, s), l),
                     (S((q8m, 2048), jnp.bfloat16), S((), jnp.int32),
                      q8w.codes, q8w.scales), True))
-    q8wcls = Q8Tensor(S((L, 4096, 128256), jnp.int8),
-                      S((L, 4096 // Q_BLOCK, 128256), jnp.uint16))
-    out.append(("q80 blockdot m=8 wcls8b(4096x128256)",
-                lambda x, l, c, s: q80_matmul(x, Q8Tensor(c, s), l),
-                (S((8, 4096), jnp.bfloat16), S((), jnp.int32),
-                 q8wcls.codes, q8wcls.scales), True))
+    # unstacked + f16 scales + no layer: identical operands to the wcls
+    # validate group / a production Q80 head (see flat_case rationale)
+    out.append(("q80 blockdot m=8 wcls8b(4096x128256) flat",
+                lambda x, c, s: q80_matmul(x, Q8Tensor(c, s)),
+                (S((8, 4096), jnp.bfloat16), S((4096, 128256), jnp.int8),
+                 S((4096 // Q_BLOCK, 128256), jnp.float16)), True))
 
     # flash attention: decode (t=1, group=4 folded+pad) and prefill shapes
     from dllama_tpu.ops.pallas.flash_attention import flash_gqa_attention

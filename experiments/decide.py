@@ -22,7 +22,9 @@ import sys
 
 
 def _latest(d: str, pat: str) -> str | None:
-    files = sorted(glob.glob(os.path.join(d, pat)))
+    # by mtime, not name: session logs use time-of-day-only timestamps, so
+    # a lexically-late log from yesterday must not shadow today's
+    files = sorted(glob.glob(os.path.join(d, pat)), key=os.path.getmtime)
     return files[-1] if files else None
 
 
@@ -166,11 +168,52 @@ def decide_bench(text: str) -> list[str]:
     return rec
 
 
+def decide_abench(text: str) -> list[str]:
+    """Three-mode admission record (sync/strict/paced) -> budget decision."""
+    rec = []
+    rows: dict[str, dict] = {}
+    for line in text.splitlines():
+        m = re.match(r"\{'mode': '(\w+)', (.*)\}", line)
+        if not m:
+            continue
+        vals = dict(re.findall(r"'([\w_]+)': ([\d.]+)", m.group(2)))
+        rows[m.group(1)] = {k: float(v) for k, v in vals.items()}
+    if not rows:
+        return ["admission: NO-DATA (no abench mode rows)"]
+    for mode, r in rows.items():
+        stall = r.get("sched_stall_ms_max")
+        rec.append(f"  {mode}: stall_max={'n/a' if stall is None else f'{stall}ms'} "
+                   f"ttft={r.get('long_ttft_ms')}ms")
+    verdict = re.search(r"paced within 2x of best on stall .*: (PASS|FAIL)", text)
+    if verdict:
+        if verdict.group(1) == "PASS":
+            rec.append("admission: keep 'paced' default (2x acceptance bar PASS)")
+        else:
+            rec.append("admission: paced FAILED the 2x bar — tune "
+                       "admit_stall_budget_ms toward whichever metric "
+                       "regressed (raise for TTFT, lower for stall)")
+    return rec
+
+
+def decide_wedge(d: str) -> list[str]:
+    """Surface any WEDGE_DIAG verdict from the latest canary/control logs."""
+    rec = []
+    for pat in ("control_*.log", "canary_*.log"):
+        text = _read(_latest(d, pat))
+        for m in re.finditer(r"WEDGE_DIAG (verdict=\S+.*)", text):
+            rec.append(f"{pat.split('_')[0]}: {m.group(1)}")
+    return rec or ["wedge: no WEDGE_DIAG lines (canaries passed or never ran)"]
+
+
 def main() -> None:
     d = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "logs")
+    print("== wedge:")
+    for line in decide_wedge(d):
+        print("  " + line)
     for title, pat, fn in (("kbench", "kbench_*.log", decide_kbench),
                            ("ebench", "ebench_*.log", decide_ebench),
+                           ("abench", "abench_*.log", decide_abench),
                            ("bench", "bench_*.log", decide_bench)):
         path = _latest(d, pat)
         print(f"== {title}: {os.path.basename(path) if path else 'NO LOG'}")

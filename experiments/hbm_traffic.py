@@ -175,8 +175,50 @@ def abstract_model(cfg: LlamaConfig, sharding):
     return params, cache, tokens, pos, rope
 
 
+def inventory_cross_check(compiled) -> dict:
+    """Compiler-verified op inventory (VERDICT r4 next #9: the analytic
+    roofline 'is accounting, not a stopwatch' — so at least the *inventory*
+    it accounts must be the compiler's). Parses the v5e-AOT-compiled fused
+    decode step's optimized HLO for Mosaic custom calls: the per-layer scan
+    body must contain exactly 7 q40 matmuls (wq wk wv wo w1 w2 w3) + 1
+    flash attention, and exactly 1 call (the wcls matmul) must sit outside
+    the loop — the same inventory kernel_stream_bytes() sums. A mismatch
+    means the formula forgot or double-counted an op and every roofline in
+    HBM_TRAFFIC.md inherits the error."""
+    import re
+
+    text = compiled.as_text()
+    # count tpu_custom_call occurrences per HLO computation: computations
+    # open with '<name> (<params>) -> <type> {' and close with a bare '}'
+    counts: dict[str, int] = {}
+    cur = None
+    for line in text.splitlines():
+        if re.match(r"^(ENTRY\s+)?%?[\w\.\-]+ \(.*\) -> .* \{", line):
+            cur = line.split(" ", 1)[0].lstrip("%")
+            counts.setdefault(cur, 0)
+        elif line.startswith("}"):
+            cur = None
+        elif cur is not None and "tpu_custom_call" in line:
+            counts[cur] += 1
+    total = sum(counts.values())
+    body = max(counts.values(), default=0)  # the scan body computation
+    outside = total - body
+    expected_body, expected_outside = 7 + 1, 1
+    ok = body == expected_body and outside == expected_outside
+    return {"per_layer": body, "outside_loop": outside,
+            "expected_per_layer": expected_body,
+            "expected_outside": expected_outside, "ok": ok}
+
+
+def cost_of(compiled) -> dict:
+    """Unwrap compiled.cost_analysis() across jax versions (list vs dict)."""
+    ca = compiled.cost_analysis()
+    return ca[0] if isinstance(ca, (list, tuple)) else ca
+
+
 def compile_step(cfg, topo, *, backend: str, style: str | None, on_cpu=False):
-    """AOT-compile one decode step for the target; returns cost_analysis."""
+    """AOT-compile one decode step for the target; returns the compiled
+    executable (cost_of() extracts the compiler accounting)."""
     if on_cpu:
         mesh = Mesh(jax.devices("cpu")[:1], ("x",))
     else:
@@ -201,10 +243,7 @@ def compile_step(cfg, topo, *, backend: str, style: str | None, on_cpu=False):
             mmod.INTERPRET = None
             qmod.STYLE = old_style
 
-    compiled = jax.jit(step).trace(*args).lower().compile()
-    ca = compiled.cost_analysis()
-    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-    return ca
+    return jax.jit(step).trace(*args).lower().compile()
 
 
 def main():
@@ -226,6 +265,7 @@ def main():
         topo = topologies.get_topology_desc("v5e:2x2", platform="tpu")
 
     rows = []
+    inventories = {}
     for preset in presets:
         cfg = PRESETS[preset]
         floor = q40_weight_bytes(cfg)
@@ -234,12 +274,23 @@ def main():
         # v5e), then account the kernel stream from the BlockSpec contract —
         # XLA's cost model under-counts opaque Mosaic calls (below)
         try:
-            ca = compile_step(cfg, topo, backend="pallas", style="blockdot",
-                              on_cpu=on_cpu)
+            compiled = compile_step(cfg, topo, backend="pallas", style="blockdot",
+                                    on_cpu=on_cpu)
             if show_undercount:
+                ca = cost_of(compiled)
                 print(f"  [xla cost model claims {ca.get('bytes accessed', 0)/1e9:.3f}GB "
                       f"for the pallas step — BELOW the {floor/1e9:.3f}GB "
                       f"physical weight floor, hence unusable here]")
+            if not on_cpu:
+                # compiler-verified inventory: the same compiled module the
+                # rows below account must contain exactly the ops they sum
+                inv = inventory_cross_check(compiled)
+                inventories[preset] = inv
+                print(f"{preset} inventory: {inv['per_layer']}/layer "
+                      f"(expect {inv['expected_per_layer']}), "
+                      f"{inv['outside_loop']} outside loop "
+                      f"(expect {inv['expected_outside']}) -> "
+                      f"{'OK' if inv['ok'] else 'FAILED (inventory mismatch)'}")
             for lf, tag in ((0.5, "cache half full"), (1.0, "cache full")):
                 by = kernel_stream_bytes(cfg, live_frac=lf)
                 rows.append((f"{preset} fused pallas ({tag})", by, floor,
@@ -261,8 +312,8 @@ def main():
 
         # XLA dequant-dot step: plain HLO, compiler accounting is valid
         try:
-            ca = compile_step(cfg, topo, backend="xla", style=None,
-                              on_cpu=on_cpu)
+            ca = cost_of(compile_step(cfg, topo, backend="xla", style=None,
+                                      on_cpu=on_cpu))
             by = ca.get("bytes accessed", 0.0)
             if not by:
                 # a cost-analysis schema change must not be committed as a
@@ -339,6 +390,21 @@ def main():
             for label, by, step_ms, agg in batched:
                 f.write(f"| {label} | {by/1e9:.2f} GB | {step_ms:.2f} ms "
                         f"| {agg:.0f} |\n")
+            if inventories:
+                f.write(
+                    "\n## Op-inventory cross-check (compiler-verified)\n\n"
+                    "The DMA-contract rows above are only as honest as the op\n"
+                    "inventory they sum. This section parses the SAME v5e-AOT-\n"
+                    "compiled module for Mosaic custom calls: the per-layer\n"
+                    "scan body must hold exactly 7 q40 matmuls + 1 flash\n"
+                    "attention, with exactly 1 call (the wcls matmul) outside\n"
+                    "the loop — anything else means the formula forgot or\n"
+                    "double-counted an op (VERDICT r4 next #9 offline leg).\n\n"
+                    "| preset | calls/layer (expect 8) | outside loop (expect 1) | verdict |\n"
+                    "|---|---|---|---|\n")
+                for p, inv in inventories.items():
+                    f.write(f"| {p} | {inv['per_layer']} | {inv['outside_loop']} | "
+                            f"{'OK' if inv['ok'] else 'MISMATCH'} |\n")
             f.write(
                 "\nReading the table: the fused decode tier sits within a\n"
                 "few percent of the physical Q40 floor plus the live KV\n"
@@ -350,6 +416,13 @@ def main():
                 "fused rows' roofline; further off means scheduling, not\n"
                 "bandwidth, is the problem.\n")
         print(f"wrote {md_path}")
+    if any(not inv["ok"] for inv in inventories.values()):
+        # an inventory mismatch invalidates every DMA-contract roofline row:
+        # fail loudly instead of regenerating a wrong artifact as a success
+        raise SystemExit("HBM TRAFFIC FAILED: op-inventory mismatch — "
+                         "kernel_stream_bytes() no longer matches the "
+                         "compiled module; fix the formula before trusting "
+                         "the rooflines")
     print("HBM TRAFFIC DONE")
 
 

@@ -61,9 +61,9 @@ else
   cat "$L/control_$TS.log"
   CONTROL_OK=0
   if probe; then
-    echo "WEDGE_DIAG verdict=CONTROL_FAIL_SERVER_ALIVE detail=non-flash-pallas-compile-failed-but-tunnel-fine"
+    echo "WEDGE_DIAG verdict=CONTROL_FAIL_SERVER_ALIVE detail=non-flash-pallas-compile-failed-but-tunnel-fine" | tee -a "$L/control_$TS.log"
   else
-    echo "WEDGE_DIAG verdict=GENERAL_WEDGE detail=non-flash-pallas-compile-wedged-tunnel (NOT flash-specific)"
+    echo "WEDGE_DIAG verdict=GENERAL_WEDGE detail=non-flash-pallas-compile-wedged-tunnel (NOT flash-specific)" | tee -a "$L/control_$TS.log"
     echo "tunnel wedged by control canary; logs kept, watcher will re-arm"; exit 1
   fi
 fi
@@ -89,12 +89,12 @@ else
   # wedges the server" from "flash-specific client failure" from "tunnel
   # died coincidentally"
   if probe; then
-    echo "WEDGE_DIAG verdict=FLASH_FAIL_SERVER_ALIVE control_ok=$CONTROL_OK detail=flash-canary-failed-but-tunnel-fine (client/compile error, not a server wedge)"
+    echo "WEDGE_DIAG verdict=FLASH_FAIL_SERVER_ALIVE control_ok=$CONTROL_OK detail=flash-canary-failed-but-tunnel-fine (client/compile error, not a server wedge)" | tee -a "$L/canary_$TS.log"
   else
     if [ "$CONTROL_OK" = "1" ]; then
-      echo "WEDGE_DIAG verdict=FLASH_WEDGES_SERVER control_ok=1 detail=non-flash-compile-passed-then-flash-compile-killed-the-tunnel (r4 wedge REPRODUCED)"
+      echo "WEDGE_DIAG verdict=FLASH_WEDGES_SERVER control_ok=1 detail=non-flash-compile-passed-then-flash-compile-killed-the-tunnel (r4 wedge REPRODUCED)" | tee -a "$L/canary_$TS.log"
     else
-      echo "WEDGE_DIAG verdict=GENERAL_WEDGE control_ok=0 detail=both-canaries-failed-and-tunnel-dead"
+      echo "WEDGE_DIAG verdict=GENERAL_WEDGE control_ok=0 detail=both-canaries-failed-and-tunnel-dead" | tee -a "$L/canary_$TS.log"
     fi
     echo "tunnel wedged by canary; logs kept, watcher will re-arm"; exit 1
   fi

@@ -321,7 +321,7 @@ def test_decide_smoke(tmp_path):
     stage with no log prints NO LOG and the script always exits 0."""
     p = _run(["experiments/decide.py", str(tmp_path)])  # empty dir: all NO LOG
     assert p.returncode == 0 and "DECIDE DONE" in p.stdout
-    assert p.stdout.count("NO LOG") == 3
+    assert p.stdout.count("NO LOG") == 4  # kbench/ebench/abench/bench
     # against the repo's real smoke logs (written by the session smoke test)
     p2 = _run(["experiments/decide.py"])
     assert p2.returncode == 0 and "DECIDE DONE" in p2.stdout
