@@ -86,9 +86,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="MoE compute: capacity-bucketed dispatch (O(k) FLOPs, rare "
                         "capacity drops), sort (grouped-GEMM ragged segments — "
                         "O(k) FLOPs AND exact), or exact dense all-experts")
-    p.add_argument("--sync", choices=["bf16", "q80"], default="bf16",
-                   help="tp activation exchange: native bf16 collectives or the "
-                        "reference's Q80 quantized payload (half the ICI bytes)")
+    p.add_argument("--sync", choices=["bf16", "q80", "auto"], default="bf16",
+                   help="tp activation exchange: bf16 (exact, default), q80 "
+                        "(the reference's quantized payload), or auto — the "
+                        "measured recommendation: q80 only at tp=2, where it "
+                        "wins on BOTH byte accountings; at tp>=4 the compiled "
+                        "HLO says the gather formulation costs more "
+                        "(COLLECTIVES.md)")
     p.add_argument("--distributed", action="store_true",
                    help="multi-host: jax.distributed.initialize (run the same command on every host)")
     p.add_argument("--coordinator", default=None, help="host:port rendezvous (omit on TPU pods)")

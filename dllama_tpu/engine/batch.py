@@ -59,7 +59,8 @@ class BatchEngine:
         seed: int = 0,
         shardings=None,  # parallel/sharding.LlamaShardings: multi-chip serving
         attn_impl: str = "auto",  # 'auto' | 'jnp' | 'flash' (same as InferenceEngine)
-        sync: str = "bf16",  # 'bf16' | 'q80' quantized tp exchange (as InferenceEngine)
+        sync: str = "bf16",  # 'bf16' | 'q80' | 'auto' tp exchange
+        # (resolved like InferenceEngine via parallel/collectives.resolve_sync)
         kernels: str = "auto",  # 'auto' | 'pallas' | 'xla' matmul backend
         moe_impl: str = "auto",  # 'auto' | 'dispatch' | 'sort' | 'dense' (ops.layers.moe_ffn)
         fuse_weights: bool = False,  # wqkv/w13 fused launches (unsharded only,
@@ -109,8 +110,9 @@ class BatchEngine:
         self._base_key = jax.random.PRNGKey(seed)
         self._admissions = 0
 
-        if sync not in ("bf16", "q80"):
-            raise ValueError(f"sync must be 'bf16' or 'q80', got {sync!r}")
+        from dllama_tpu.parallel.collectives import resolve_sync
+
+        self.sync = sync = resolve_sync(sync, shardings)
         self._col_fn = None
         if sync == "q80" and shardings is not None and shardings.mesh.shape["tp"] > 1:
             from dllama_tpu.parallel.collectives import make_q80_col_matmul

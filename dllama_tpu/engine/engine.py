@@ -84,7 +84,9 @@ class InferenceEngine:
         donate_cache: bool = True,
         attn_impl: str = "auto",  # 'auto' | 'jnp' | 'flash' (Pallas online-softmax)
         layer_unroll: int | bool = 1,  # lax.scan unroll over layers
-        sync: str = "bf16",  # 'bf16' (native collectives) | 'q80' (quantized exchange)
+        sync: str = "bf16",  # 'bf16' (exact, default) | 'q80' (quantized
+        # exchange) | 'auto' (the data-earned policy: q80 iff tp=2 —
+        # parallel/collectives.resolve_sync has the numbers)
         kernels: str = "auto",  # 'auto' | 'pallas' | 'xla' matmul backend
         moe_impl: str = "auto",  # 'auto' | 'dispatch' | 'sort' | 'dense' (ops.layers.moe_ffn)
         pp_micro: int = 1,  # GPipe microbatches on pp meshes (batch % pp_micro == 0)
@@ -124,8 +126,9 @@ class InferenceEngine:
         sel = resolve_kernels(cfg, self.seq_len, batch, kernels, attn_impl, shardings)
         mm, mm_in, attn_fn = sel.mm, sel.mm_in, sel.attn_fn
         self.backend = sel.backend
-        if sync not in ("bf16", "q80"):
-            raise ValueError(f"sync must be 'bf16' or 'q80', got {sync!r}")
+        from dllama_tpu.parallel.collectives import resolve_sync
+
+        self.sync = sync = resolve_sync(sync, shardings)
         col_fn = None
         if sync == "q80":
             # the reference's Q80 ZQ-pipe exchange as an ICI option: wo/w2

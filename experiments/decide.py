@@ -23,8 +23,10 @@ import sys
 
 def _latest(d: str, pat: str) -> str | None:
     # by mtime, not name: session logs use time-of-day-only timestamps, so
-    # a lexically-late log from yesterday must not shadow today's
-    files = sorted(glob.glob(os.path.join(d, pat)), key=os.path.getmtime)
+    # a lexically-late log from yesterday must not shadow today's; filename
+    # tiebreak keeps equal-mtime checkouts deterministic
+    files = sorted(glob.glob(os.path.join(d, pat)),
+                   key=lambda p: (os.path.getmtime(p), p))
     return files[-1] if files else None
 
 
@@ -170,14 +172,19 @@ def decide_bench(text: str) -> list[str]:
 
 def decide_abench(text: str) -> list[str]:
     """Three-mode admission record (sync/strict/paced) -> budget decision."""
+    import ast
+
     rec = []
     rows: dict[str, dict] = {}
     for line in text.splitlines():
-        m = re.match(r"\{'mode': '(\w+)', (.*)\}", line)
-        if not m:
+        if not line.startswith("{'mode': "):
             continue
-        vals = dict(re.findall(r"'([\w_]+)': ([\d.]+)", m.group(2)))
-        rows[m.group(1)] = {k: float(v) for k, v in vals.items()}
+        try:
+            r = ast.literal_eval(line.strip())  # abench prints dict reprs
+        except (ValueError, SyntaxError):
+            continue
+        if isinstance(r, dict) and "mode" in r:
+            rows[r["mode"]] = r
     if not rows:
         return ["admission: NO-DATA (no abench mode rows)"]
     for mode, r in rows.items():

@@ -199,6 +199,37 @@ def test_engine_sync_q80_matches_within_quantization_noise():
     assert ref_toks.tolist() == got_toks.tolist()
 
 
+def test_resolve_sync_policy():
+    """'auto' encodes the COLLECTIVES.md recommendation — q80 only at tp=2
+    (both byte accountings agree there), bf16 at tp>=4, on pp meshes, and
+    unsharded; explicit choices always win; junk is rejected."""
+    from dllama_tpu.parallel.collectives import resolve_sync
+    from dllama_tpu.parallel.sharding import LlamaShardings
+
+    sh = lambda **kw: LlamaShardings(make_mesh(MeshConfig(**kw)), CFG)
+    assert resolve_sync("auto", None) == "bf16"
+    assert resolve_sync("auto", sh(tp=2, dp=2)) == "q80"
+    assert resolve_sync("auto", sh(tp=4)) == "bf16"
+    assert resolve_sync("auto", sh(tp=2, pp=2)) == "bf16"
+    assert resolve_sync("q80", sh(tp=4)) == "q80"  # explicit wins
+    assert resolve_sync("bf16", sh(tp=2)) == "bf16"
+    with pytest.raises(ValueError, match="sync"):
+        resolve_sync("fp8", None)
+
+
+def test_engine_sync_auto_quantizes_only_tp2():
+    """An engine built with sync='auto' arms the q80 col_fn exactly when the
+    policy says q80 (tp=2) and stays on native collectives at tp=4."""
+    params = random_params(CFG, seed=3, dtype=jnp.float32, quantize=False)
+    eng2 = InferenceEngine(CFG, params, cache_dtype=jnp.float32,
+                           shardings=LlamaShardings(make_mesh(MeshConfig(tp=2, dp=2)), CFG),
+                           sync="auto")
+    eng4 = InferenceEngine(CFG, params, cache_dtype=jnp.float32,
+                           shardings=LlamaShardings(make_mesh(MeshConfig(tp=4)), CFG),
+                           sync="auto")
+    assert eng2.sync == "q80" and eng4.sync == "bf16"
+
+
 def test_uneven_vocab_replicates_instead_of_crashing(tmp_path):
     """A vocab that doesn't divide tp must load with wcls replicated (the
     reference refuses such configs outright; we sanitize the spec). Caught by
