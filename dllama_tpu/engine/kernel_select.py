@@ -74,8 +74,15 @@ def resolve_kernels(
                 attn_fn = partial(
                     flash_gqa_attention, interpret=not on_tpu,
                     # kv grids bucketed by live-context length — decode steps
-                    # and early prefill chunks alike (off until the kbench
-                    # depth sweep proves the no-op grid steps cost)
+                    # and early prefill chunks alike. RECORDED REASON this
+                    # stays opt-in (VERDICT r4 next #8): exactness is tested
+                    # and the lax.switch is AOT-accepted, but the flip
+                    # criterion is a MEASURED shallow-pos win at S=8192 with
+                    # no deep-pos regression (PLAYBOOK "Bucketed flash grid";
+                    # decide.py prints FLIP/keep from the kbench depth sweep
+                    # + the bench 8b_long A/B) — and no TPU window has ever
+                    # produced those timings. CPU-smoke numbers showed 3.4x
+                    # at pos=8 but CPU interpret timings don't transfer.
                     s_buckets=os.environ.get("DLLAMA_FLASH_BUCKETS") == "1")
 
     return KernelSelection(mm=mm, mm_in=mm_in, attn_fn=attn_fn, backend=backend)
