@@ -255,10 +255,16 @@ def _save_last_tpu_record(result):
         if "tpu" not in str(rec.get("device", "")).lower():
             return
         rec["recorded_at_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-        # full records supersede partial ones; a partial never overwrites full
-        if rec.get("partial"):
-            old = _load_last_tpu_record()
-            if old is not None and not old.get("partial"):
+        # rank evidence before overwriting: a record that measured the 8b
+        # serving north star (non-null vs_baseline_config — even a partial
+        # wedge snapshot with 8b rows) outranks one that didn't (e.g. the
+        # session's quick 1b record); within a rank, full beats partial;
+        # equal rank -> newest wins
+        old = _load_last_tpu_record()
+        if old is not None:
+            rank = lambda r: (1 if r.get("vs_baseline_config") else 0,
+                              0 if r.get("partial") else 1)
+            if rank(old) > rank(rec):
                 return
         path = _last_tpu_path()
         tmp = f"{path}.{os.getpid()}.tmp"  # watcher + manual runs can overlap

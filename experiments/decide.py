@@ -21,13 +21,18 @@ import re
 import sys
 
 
-def _latest(d: str, pat: str) -> str | None:
+def _latest(d: str, pat: str, must_contain: str | None = None) -> str | None:
     # by mtime, not name: session logs use time-of-day-only timestamps, so
     # a lexically-late log from yesterday must not shadow today's; filename
-    # tiebreak keeps equal-mtime checkouts deterministic
+    # tiebreak keeps equal-mtime checkouts deterministic. must_contain skips
+    # newer-but-empty logs (e.g. a wedged full bench must not hide the
+    # window's earlier quick-bench record)
     files = sorted(glob.glob(os.path.join(d, pat)),
-                   key=lambda p: (os.path.getmtime(p), p))
-    return files[-1] if files else None
+                   key=lambda p: (os.path.getmtime(p), p), reverse=True)
+    for p in files:
+        if must_contain is None or must_contain in _read(p):
+            return p
+    return files[0] if files else None
 
 
 def _read(path: str | None) -> str:
@@ -218,11 +223,15 @@ def main() -> None:
     print("== wedge:")
     for line in decide_wedge(d):
         print("  " + line)
-    for title, pat, fn in (("kbench", "kbench_*.log", decide_kbench),
-                           ("ebench", "ebench_*.log", decide_ebench),
-                           ("abench", "abench_*.log", decide_abench),
-                           ("bench", "bench_*.log", decide_bench)):
-        path = _latest(d, pat)
+    for title, pat, fn, need in (
+        ("kbench", "kbench_*.log", decide_kbench, None),
+        ("ebench", "ebench_*.log", decide_ebench, None),
+        ("abench", "abench_*.log", decide_abench, None),
+        # newest bench log WITH a JSON record: a wedged full bench must not
+        # hide the same window's quick-bench record
+        ("bench", "bench_*.log", decide_bench, '"vs_baseline"'),
+    ):
+        path = _latest(d, pat, must_contain=need)
         print(f"== {title}: {os.path.basename(path) if path else 'NO LOG'}")
         for line in fn(_read(path)) if path else ():
             print("  " + line)

@@ -100,6 +100,22 @@ else
   fi
 fi
 
+echo "== 2c. quick bench (1b, tight budget): a real TPU record inside ~5 min"
+# the 2026-07-31 window lasted ~2 minutes of device time; the full bench
+# needs minutes of 8b param transfer before its first record. This stage
+# lands a complete 1b record (batch=1 + 8-slot serving) early, so even a
+# short window leaves hardware evidence (bench saves it as
+# last_tpu_record; vs_baseline_config stays null on 1b, so watch_done
+# keeps the watcher armed for the full 8b record)
+if [ "$SMOKE" != "1" ]; then
+  env BENCH_PRESET=1b BENCH_DECODE_TOKENS=32 BENCH_SLOTS=8 BENCH_ADMIT=0 \
+      BENCH_BATCH_SPEC=0 BENCH_SPEC=0 BENCH_BUDGET_S=380 \
+      timeout 420 python bench.py 2>&1 | tee "$L/bench_quick_$TS.log" | tail -1
+  probe || { echo "tunnel wedged after quick bench"; exit 1; }
+else
+  echo "quick bench skipped (smoke)"
+fi
+
 echo "== 3. full benchmark (1b + 8b + long + batched sweep) — the BENCH_r04 record"
 # bench self-limits via BENCH_BUDGET_S (default 840, tuned for the driver's
 # `timeout 900`); hand it the full stage budget or the extra time is dead

@@ -245,6 +245,22 @@ def test_bench_last_tpu_record_attach(tmp_path, monkeypatch, capsys):
     assert rec["tpu_unavailable"] is True
     assert rec["last_tpu_record"]["value"] == 200.0
 
+    # 3. evidence ranking: a PARTIAL record that measured the 8b north star
+    # (non-null vs_baseline_config) outranks a later FULL record that did
+    # not — the session's quick 1b record must never destroy 8b evidence —
+    # while a full 8b record supersedes the partial one
+    last2 = tmp_path / "last2.json"
+    monkeypatch.setenv("BENCH_LAST_TPU_PATH", str(last2))
+    bench._save_last_tpu_record({"value": 9.0, "partial": True,
+                                 "vs_baseline_config": "8b 32-slot serving",
+                                 "device": "TPU v5 lite0"})
+    bench._save_last_tpu_record({"value": 7.0, "device": "TPU v5 lite0"})
+    assert _json.loads(last2.read_text())["value"] == 9.0  # 1b full lost
+    bench._save_last_tpu_record({"value": 11.0,
+                                 "vs_baseline_config": "8b 48-slot serving",
+                                 "device": "TPU v5 lite0"})
+    assert _json.loads(last2.read_text())["value"] == 11.0  # 8b full wins
+
 
 def test_bench_worker_writes_partial_snapshot(tmp_path):
     """The worker itself must snapshot as it goes (tiny preset, CPU)."""
@@ -271,13 +287,20 @@ def test_watch_done_condition(tmp_path):
 
     assert not done()  # no logs at all
     (tmp_path / "bench_1.log").write_text(
-        '{"vs_baseline": 0.0, "tpu_unavailable": true}\n')
+        '{"vs_baseline": 0.0, "vs_baseline_config": "8b 32-slot serving", '
+        '"tpu_unavailable": true}\n')
     assert not done()  # CPU fallback record
     (tmp_path / "bench_2.log").write_text(
-        '{"vs_baseline": 0.4, "partial": true}\n')
+        '{"vs_baseline": 0.4, "vs_baseline_config": "8b 32-slot serving", '
+        '"partial": true}\n')
     assert not done()  # wedge partial snapshot
-    (tmp_path / "bench_3.log").write_text('{"vs_baseline": 0.6}\n')
-    assert done()  # full TPU record
+    (tmp_path / "bench_3.log").write_text(
+        '{"vs_baseline": 0.0, "vs_baseline_config": null}\n')
+    assert not done()  # quick-bench 1b record: north star not measured
+    (tmp_path / "bench_4.log").write_text(
+        '{"vs_baseline": 0.6, "vs_baseline_config": "8b 32-slot serving '
+        '(kernels=auto)"}\n')
+    assert done()  # full TPU record incl. the 8b serving sweep
 
 
 def test_tpu_session_shell_end_to_end():
@@ -294,7 +317,8 @@ def test_tpu_session_shell_end_to_end():
     assert p.returncode == 0, f"stdout:\n{p.stdout[-3000:]}\nstderr:\n{p.stderr[-2000:]}"
     # "flash canary ok" is deliberately NOT a substring of "control canary
     # ok": each canary's success must be asserted independently
-    for marker in ("control canary ok", "flash canary ok", "TOTAL ALL PASS", "KBENCH DONE",
+    for marker in ("control canary ok", "flash canary ok",
+                   "quick bench skipped (smoke)", "TOTAL ALL PASS", "KBENCH DONE",
                    "EBENCH DONE fails=0", "ABENCH DONE fails=0",
                    # the full group list: a failing canary would degrade
                    # VGROUPS to just q40, which must not pass CI silently
