@@ -656,7 +656,11 @@ def worker():
     # fits 16 GB HBM; an OOM is caught by the fallback ladder (error recorded,
     # sweep continues), so reaching for the higher-throughput point is safe
     slot_list = [int(s) for s in os.environ.get("BENCH_SLOTS", "8,32,48").split(",")]
-    run_presets = ["1b", "8b", "8b_long"] if preset == "all" else [preset]
+    # 8b FIRST: its serving sweep is the pinned vs_baseline source and must
+    # not be starved by 1b extras in a tight window (the session's quick
+    # stage covers 1b early); 8b_long second shares the just-transferred 8b
+    # params; 1b last pays its own (cheap) param gen
+    run_presets = ["8b", "8b_long", "1b"] if preset == "all" else [preset]
     # the batched serving sweep runs on the north-star config; never on a
     # long-seq preset (n_slots * 8Ki KV exceeds one chip's HBM)
     sweep_on = "8b" if "8b" in run_presets else (
@@ -693,6 +697,8 @@ def worker():
     dev = jax.devices()[0]
     results = {}
     batch_results = []
+    admit_params = None  # the sweep preset's live params (bench_admission
+    # needs params that match its cfg after later presets regenerate)
     best = (0.0, "", 0.0)  # (tok_s/north_star, label, tok_s)
     # vs_baseline is PINNED (VERDICT r4 weak #8: its semantics drifted across
     # rounds): it is 8B serving aggregate tok/s/chip / 1000 — BASELINE.json's
@@ -778,6 +784,7 @@ def worker():
         # vs_baseline is judged on — in a tight window it must not be starved
         # by the batch=1 extras); skip slots we no longer have budget for
         if name == sweep_on:
+            admit_params = params
             ok = []  # (slots, kern, widen) of successful bf16 rows
             for slots in slot_list:
                 if time.monotonic() > deadline - 120:
@@ -883,13 +890,16 @@ def worker():
             finally:
                 _qm.STYLE = q40_style
         dump_partial()
-        # prefill-route self-tune (runs once, on the first preset that
-        # succeeded on a Pallas rung): re-measure with large-m matmuls routed
-        # through the XLA dequant-dot GEMM. If that beats the fused prefill
-        # by >20%, keep the routing for the remaining (bigger) presets. The
-        # driver's bench runs with default env, so the worker must learn this
-        # itself rather than rely on BENCH_XLA_PREFILL_M.
+        # prefill-route A/B (1b ONLY — the cheap preset, which now runs LAST
+        # so this can never starve the 8b sweep in a tight window):
+        # re-measure with large-m matmuls routed through the XLA dequant-dot
+        # GEMM. >20% prefill win records the route; it no longer retunes
+        # same-run routing of earlier presets (8b ran first) — the committed
+        # record + decide.py's kbench rule carry the decision forward instead
+        # (the driver's bench runs with default env, so the data must come
+        # from the worker itself rather than BENCH_XLA_PREFILL_M).
         if (xla_prefill_m is None and not prefill_tuned
+                and name in ("1b", "tiny")
                 and name in results and "prefill_tok_s" in results[name]
                 and "kernels=auto" in results[name].get("path", "")
                 and time.monotonic() < deadline - 240):
@@ -961,17 +971,19 @@ def worker():
         except Exception as e:
             moe = {"error": repr(e)[:200]}
 
-    # serving-tier admission-stall record (uses the last preset's live params;
-    # param shapes are seq-independent, so the sweep preset's cfg applies)
+    # serving-tier admission-stall record: must use the SWEEP preset's own
+    # params (later presets regenerate `params` with different shapes)
     admit = None
-    if (sweep_on and os.environ.get("BENCH_ADMIT") != "0"
+    if (sweep_on and admit_params is not None
+            and os.environ.get("BENCH_ADMIT") != "0"
             and time.monotonic() < deadline - 240):
         try:
-            admit = bench_admission(LlamaConfig(**PRESETS[sweep_on]), params)
+            admit = bench_admission(LlamaConfig(**PRESETS[sweep_on]), admit_params)
         except Exception as e:
             admit = {"error": repr(e)[:200]}
 
-    cfg8 = LlamaConfig(**PRESETS[run_presets[-1]])
+    # bytes/token describes the headline (sweep) config when one ran
+    cfg8 = LlamaConfig(**PRESETS[sweep_on or run_presets[-1]])
     n_dev = jax.device_count()
     kb = collective_bytes_per_token(cfg8, tp=n_dev)["kb_per_token_per_chip"]
     kb_measured = None
@@ -980,7 +992,7 @@ def worker():
             with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                    "experiments", "collectives.json")) as f:
                 tbl = json.load(f)
-            rec = tbl.get(f"{run_presets[-1]}/tp{n_dev}/bf16")
+            rec = tbl.get(f"{sweep_on or run_presets[-1]}/tp{n_dev}/bf16")
             if isinstance(rec, dict) and isinstance(
                 rec.get("measured_kb_per_token_per_chip"), (int, float)
             ):
