@@ -20,7 +20,7 @@ and never prints nothing.
 Env knobs:
   BENCH_PRESET         all (default) | tiny | 1b | 8b — 'all' = 1b + 8b + the
                        8b batched sweep, budget permitting
-  BENCH_SLOTS          comma list for the batched sweep (default '8,32')
+  BENCH_SLOTS          comma list for the batched sweep (default '8,32,48')
   BENCH_DECODE_TOKENS  timed fused-decode length (default 128)
   BENCH_KERNELS        auto (default) | pallas | xla — engine matmul backend
   BENCH_Q40_STYLE      auto (default) | deq | blockdot | maskdot | loopdot —

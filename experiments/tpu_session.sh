@@ -46,7 +46,7 @@ PP="$PWD:${PYTHONPATH:-}"  # quoted at every use: paths with spaces must not wor
 # of eating every later stage's full timeout. Smoke skips (no tunnel).
 probe() {
   if [ "$SMOKE" = "1" ]; then return 0; fi
-  timeout 240 env PYTHONPATH="$PP" python experiments/probe.py >>"$L/probe_$TS.log" 2>&1
+  timeout -k 30 240 env PYTHONPATH="$PP" python experiments/probe.py >>"$L/probe_$TS.log" 2>&1
 }
 
 echo "== 1. probe (compute round-trip)"
@@ -54,7 +54,7 @@ probe || { echo "tunnel down/wedged"; exit 1; }
 
 echo "== 2a. control canary (non-flash pallas compile: the wedge-diag baseline)"
 CONTROL_OK=1
-if timeout 360 env PYTHONPATH="$PP" python experiments/canary_control.py >"$L/control_$TS.log" 2>&1; then
+if timeout -k 30 360 env PYTHONPATH="$PP" python experiments/canary_control.py >"$L/control_$TS.log" 2>&1; then
   cat "$L/control_$TS.log"
   echo "control canary ok"
 else
@@ -72,7 +72,7 @@ echo "== 2b. flash canary (the 2026-07-31 wedge struck at a flash compile)"
 FLASH_OK=1
 # no pipe: a pipeline's status is tee's, which would mask a hung canary and
 # leave flash armed on the exact wedge this stage exists to catch
-if timeout 360 env PYTHONPATH="$PP" python experiments/canary_flash.py >"$L/canary_$TS.log" 2>&1; then
+if timeout -k 30 360 env PYTHONPATH="$PP" python experiments/canary_flash.py >"$L/canary_$TS.log" 2>&1; then
   cat "$L/canary_$TS.log"
   echo "flash canary ok: flash stays on"
   # bench.py re-canaries when BENCH_ATTN is unset; 'auto' (its default)
@@ -110,30 +110,30 @@ echo "== 2c. quick bench (1b, tight budget): a real TPU record inside ~5 min"
 if [ "$SMOKE" != "1" ]; then
   env BENCH_PRESET=1b BENCH_DECODE_TOKENS=32 BENCH_SLOTS=8 BENCH_ADMIT=0 \
       BENCH_BATCH_SPEC=0 BENCH_SPEC=0 BENCH_BUDGET_S=380 \
-      timeout 420 python bench.py 2>&1 | tee "$L/bench_quick_$TS.log" | tail -1
+      timeout -k 30 420 python bench.py 2>&1 | tee "$L/bench_quick_$TS.log" | tail -1
   probe || { echo "tunnel wedged after quick bench"; exit 1; }
 else
   echo "quick bench skipped (smoke)"
 fi
 
-echo "== 3. full benchmark (1b + 8b + long + batched sweep) — the BENCH_r04 record"
+echo "== 3. full benchmark (8b + long + 1b + batched sweep) — the round record"
 # bench self-limits via BENCH_BUDGET_S (default 840, tuned for the driver's
 # `timeout 900`); hand it the full stage budget or the extra time is dead
 if [ "$SMOKE" != "1" ]; then export BENCH_BUDGET_S=1140; fi
-timeout 1200 python bench.py 2>&1 | tee "$L/bench_$TS.log" | tail -1
+timeout -k 30 1200 python bench.py 2>&1 | tee "$L/bench_$TS.log" | tail -1
 if [ "$SMOKE" != "1" ]; then unset BENCH_BUDGET_S; fi
 probe || { echo "tunnel wedged after bench"; exit 1; }
 
 echo "== 4. kernel micro-bench suite (decode m=8 + prefill m=256/512 + tiles)"
-timeout 900 env PYTHONPATH="$PP" python experiments/kbench.py suite $KB_ARGS 2>&1 | tee "$L/kbench_$TS.log"
+timeout -k 30 900 env PYTHONPATH="$PP" python experiments/kbench.py suite $KB_ARGS 2>&1 | tee "$L/kbench_$TS.log"
 probe || { echo "tunnel wedged after kbench"; exit 1; }
 
 echo "== 5. engine-knob A/B (1B, one process)"
-timeout 900 env PYTHONPATH="$PP" python experiments/ebench.py $EB_N 2>&1 | tee "$L/ebench_$TS.log"
+timeout -k 30 900 env PYTHONPATH="$PP" python experiments/ebench.py $EB_N 2>&1 | tee "$L/ebench_$TS.log"
 probe || { echo "tunnel wedged after ebench"; exit 1; }
 
 echo "== 6. admission-stall A/B (8b serving tier, sync vs strict vs paced)"
-timeout 1400 env PYTHONPATH="$PP" python experiments/abench.py $AB_ARGS 2>&1 | tee "$L/abench_$TS.log"
+timeout -k 30 1400 env PYTHONPATH="$PP" python experiments/abench.py $AB_ARGS 2>&1 | tee "$L/abench_$TS.log"
 probe || { echo "tunnel wedged after abench"; exit 1; }
 
 echo "== 7. kernel validation (per-group, each timeout-bounded)"
@@ -151,7 +151,7 @@ for g in $VGROUPS; do
   # timeout-killed or crashed group must set VFAIL even with no FAIL marker.
   # wcls moves ~0.8 GB of synthetic weights through the tunnel: more rope
   GT=420; [ "$g" = "wcls" ] && GT=700
-  timeout "$GT" env PYTHONPATH="$PP" python experiments/tpu_validate.py "$g" >"$L/.vgroup_$TS.log" 2>&1 || VFAIL=1
+  timeout -k 30 "$GT" env PYTHONPATH="$PP" python experiments/tpu_validate.py "$g" >"$L/.vgroup_$TS.log" 2>&1 || VFAIL=1
   cat "$L/.vgroup_$TS.log" >>"$L/validate_$TS.log"
   cat "$L/.vgroup_$TS.log"
   probe || { echo "tunnel wedged during validate $g"; exit 1; }

@@ -15,7 +15,7 @@ while [ "$i" -lt 400 ]; do
   # against a down tunnel just hangs until its timeout anyway. COMPUTE probe,
   # not device enumeration: the 2026-07-31 wedge passed jax.devices() while
   # every execution RPC hung (TPU_VALIDATE_r04.md).
-  if timeout 240 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/probe.py \
+  if timeout -k 30 240 env PYTHONPATH="$PWD:${PYTHONPATH:-}" python experiments/probe.py \
       >>"$W" 2>&1; then
     echo "TUNNEL UP probe=$i $(date -u +%H:%M:%S)" >>"$W"
     sh experiments/tpu_session.sh >>experiments/logs/session.log 2>&1
