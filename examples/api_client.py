@@ -2,12 +2,82 @@
 in stdlib Python, against `python -m dllama_tpu serve`.
 
 Usage: python examples/api_client.py [--port 9990] [--stream] "your message"
+
+`--concurrency N` sends the request N times at once and prints per-request
+TTFT / total latency — against a `--slots` server the requests share the
+device through the continuous-batching scheduler (aggregate wall time well
+under N * single-request time); against the single-engine tier they
+serialize. The reference's server is single-request blocking
+(dllama-api.cpp:522-533), so this demo has no counterpart there.
 """
 
 import argparse
 import json
 import sys
+import threading
+import time
 import urllib.request
+
+
+def iter_sse_content(resp):
+    """Yield the content string of each SSE delta chunk until [DONE]."""
+    for raw in resp:
+        line = raw.decode().strip()
+        if not line.startswith("data:"):
+            continue
+        payload = line[5:].strip()
+        if payload == "[DONE]":
+            break
+        delta = json.loads(payload)["choices"][0]["delta"]
+        yield delta.get("content", "")
+
+
+def _one_request(url: str, body: dict, idx: int, results: list) -> None:
+    t0 = time.perf_counter()
+    req = urllib.request.Request(
+        url, data=json.dumps(dict(body, stream=True)).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    ttft = None
+    chars = 0
+    try:
+        with urllib.request.urlopen(req, timeout=600) as r:
+            for text in iter_sse_content(r):
+                if text and ttft is None:
+                    ttft = time.perf_counter() - t0
+                chars += len(text)
+    except Exception as e:  # server down/stalled: keep the FAILED path clean
+        print(f"req {idx}: {e!r}"[:200], file=sys.stderr)
+        return
+    results[idx] = (ttft, time.perf_counter() - t0, chars)
+
+
+def run_concurrent(url: str, body: dict, n: int) -> int:
+    results: list = [None] * n
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=_one_request, args=(url, body, i, results))
+        for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    for i, r in enumerate(results):
+        if r is None:
+            print(f"req {i}: FAILED")
+            continue
+        ttft, total, chars = r
+        ttft_s = "n/a" if ttft is None else f"{ttft:.2f}s"  # zero visible
+        # text (held-back stop bytes, instant EOS) leaves ttft unset
+        print(f"req {i}: ttft={ttft_s} total={total:.2f}s chars={chars}")
+    done = [r for r in results if r is not None]
+    if done:
+        print(f"aggregate: {n} requests in {wall:.2f}s wall "
+              f"(sum of individual times {sum(r[1] for r in done):.2f}s — "
+              f"well under it means the batch shared the device)")
+    return 0 if len(done) == n else 1
 
 
 def main() -> int:
@@ -17,6 +87,9 @@ def main() -> int:
     p.add_argument("--port", type=int, default=9990)
     p.add_argument("--stream", action="store_true")
     p.add_argument("--max-tokens", type=int, default=128)
+    p.add_argument("--concurrency", type=int, default=0, metavar="N",
+                   help="send the request N times at once (serve --slots M "
+                        "shows continuous batching: N requests share the device)")
     args = p.parse_args()
 
     body = {
@@ -29,8 +102,11 @@ def main() -> int:
         "max_tokens": args.max_tokens,
         "stream": args.stream,
     }
+    url = f"http://{args.host}:{args.port}/v1/chat/completions"
+    if args.concurrency > 0:
+        return run_concurrent(url, body, args.concurrency)
     req = urllib.request.Request(
-        f"http://{args.host}:{args.port}/v1/chat/completions",
+        url,
         data=json.dumps(body).encode(),
         headers={"Content-Type": "application/json"},
     )
@@ -40,15 +116,8 @@ def main() -> int:
             print(out["choices"][0]["message"]["content"])
             print(f"usage: {out.get('usage')}", file=sys.stderr)
             return 0
-        for raw in r:
-            line = raw.decode().strip()
-            if not line.startswith("data:"):
-                continue
-            payload = line[5:].strip()
-            if payload == "[DONE]":
-                break
-            delta = json.loads(payload)["choices"][0]["delta"]
-            print(delta.get("content", ""), end="", flush=True)
+        for text in iter_sse_content(r):
+            print(text, end="", flush=True)
         print()
     return 0
 
