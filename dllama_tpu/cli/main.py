@@ -78,6 +78,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve mode, needs --slots > 0: joiners older than this "
                         "pump their prefill to completion despite the stall "
                         "budget (hard TTFT bound; default off)")
+    p.add_argument("--max-queue", type=int, default=0,
+                   help="serve mode, needs --slots > 0: bound the admission "
+                        "queue — requests beyond this depth are shed with "
+                        "HTTP 429 + Retry-After (0 = unbounded)")
+    p.add_argument("--stall-deadline-s", type=float, default=0.0,
+                   help="serve mode, needs --slots > 0: watchdog deadline — a "
+                        "device chunk silent for longer flips /health to "
+                        "unhealthy (0 = watchdog off)")
+    p.add_argument("--drain-timeout-s", type=float, default=30.0,
+                   help="serve mode: on SIGTERM, stop admission (503) and "
+                        "give in-flight requests this long to finish before "
+                        "shutting down")
+    p.add_argument("--faults", default=None, metavar="SPEC",
+                   help="arm deterministic fault injection (testing/drills): "
+                        "comma-separated point:action[:k=v...] clauses, e.g. "
+                        "'engine.decode:raise:after=2' — see "
+                        "dllama_tpu/utils/faults.py (also: $DLLAMA_FAULTS)")
     p.add_argument("--kernels", choices=["auto", "pallas", "xla"], default="auto")
     p.add_argument("--fuse-weights", action="store_true",
                    help="fused wqkv/w13 kernel launches (single-device engines; "
@@ -313,6 +330,9 @@ def cmd_serve(args) -> int:
         default_seed=args.seed,
         admit_stall_budget_ms=args.admit_budget_ms,
         admit_ttft_deadline_ms=args.admit_ttft_deadline_ms,
+        max_queue=args.max_queue,
+        stall_deadline_s=args.stall_deadline_s,
+        drain_timeout_s=args.drain_timeout_s,
     )
 
 
@@ -322,6 +342,13 @@ def main(argv=None) -> int:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    from dllama_tpu.utils import faults
+
+    # $DLLAMA_FAULTS first, --faults wins when both are set; a bad spec
+    # fails startup here, not by silently never firing
+    faults.configure_from_env()
+    if args.faults:
+        faults.configure(args.faults)
     return {
         "info": cmd_info,
         "inference": cmd_inference,

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 
 from dllama_tpu.engine.engine import InferenceEngine
 from dllama_tpu.models.config import LlamaConfig
-from dllama_tpu.models.formats import load_params, read_header
+from dllama_tpu.models.formats import ModelFileError, load_params, read_header
 from dllama_tpu.parallel.mesh import MeshConfig, auto_mesh_config, make_mesh
 from dllama_tpu.parallel.sharding import LlamaShardings
 from dllama_tpu.tokenizer.tokenizer import Tokenizer
@@ -65,7 +65,16 @@ def load_model(
     # the CLI always drives batch=1, so it exposes no flag for this)
     fuse_weights: bool = False,  # wqkv/w13 fused launches (unsharded engines)
 ) -> LoadedModel:
-    cfg, header_size = read_header(model_path, max_seq_len)
+    # header + size validation happens in formats (ModelFileError: path,
+    # expected-vs-actual bytes, first incomplete tensor). Anything ELSE that
+    # escapes the byte-level reader is re-raised with the path attached, so a
+    # corrupt file never surfaces as a bare struct/mmap traceback.
+    try:
+        cfg, header_size = read_header(model_path, max_seq_len)
+    except (ModelFileError, FileNotFoundError, IsADirectoryError):
+        raise
+    except (OSError, ValueError) as e:
+        raise ModelFileError(f"{model_path}: unreadable .m model file: {e}") from e
     log.info("model: %s", cfg.describe())
     shardings = build_shardings(cfg, mesh)
     # shard-direct: each tensor goes memmap -> its device shards; a 70B/405B

@@ -40,19 +40,44 @@ from dllama_tpu.ops.quant import (
 )
 
 
+class ModelFileError(ValueError):
+    """A .m file that cannot be what it claims: wrong magic, truncated
+    header, or fewer/more tensor bytes than the header's config implies.
+    Every message names the file and the expected-vs-actual numbers — the
+    raw struct/mmap errors these replace said neither."""
+
+
 def read_header(path: str, max_seq_len: int | None = None) -> tuple[LlamaConfig, int]:
     """Returns (config, header_size_bytes). Mirrors loadLlmHeader (llm.cpp:26-98)."""
+    from dllama_tpu.utils import faults
+
+    faults.fire("loader.read")
     with open(path, "rb") as f:
-        magic = struct.unpack("<i", f.read(4))[0]
+        head = f.read(8)
+        if len(head) < 8:
+            raise ModelFileError(
+                f"{path}: not a .m model file — {len(head)} bytes on disk, "
+                f"need at least the 8-byte magic+size header")
+        magic, header_size = struct.unpack("<ii", head)
         if magic in (0xABCD00, 0xABCD01):
-            raise ValueError("old model format is not supported")
+            raise ModelFileError(f"{path}: old model format is not supported")
         if magic != MODEL_MAGIC:
-            raise ValueError(f"unsupported magic number: {magic:#x}")
-        header_size = struct.unpack("<i", f.read(4))[0]
+            raise ModelFileError(
+                f"{path}: unsupported magic number {magic:#x} "
+                f"(expected {MODEL_MAGIC:#x}) — not a .m model file, or corrupt")
+        if header_size < 8 or (header_size - 8) % 8 != 0:
+            raise ModelFileError(
+                f"{path}: corrupt header: headerSize={header_size} "
+                f"(want 8 + a multiple of 8 key/value bytes)")
+        body = f.read(header_size - 8)
+        if len(body) < header_size - 8:
+            raise ModelFileError(
+                f"{path}: truncated header: declares {header_size} bytes but "
+                f"only {8 + len(body)} are on disk")
         n_kv = (header_size - 8) // 4 // 2
         kv = []
-        for _ in range(n_kv):
-            key, value = struct.unpack("<ii", f.read(8))
+        for i in range(n_kv):
+            key, value = struct.unpack_from("<ii", body, i * 8)
             kv.append((key, value))
     config = LlamaConfig.from_header_kv(kv)
     return config.clamp_seq_len(max_seq_len), header_size
@@ -160,14 +185,33 @@ def iter_tensors(path: str, config: LlamaConfig, header_size: int) -> Iterator[t
     (mmap.hpp:35-70); no copy happens until a tensor is decoded.
     """
     data = np.memmap(path, dtype=np.uint8, mode="r")
+    plan = tensor_plan(config)
+    # validate the WHOLE plan against the on-disk size up front: a truncated
+    # download/copy fails here with the offending tensor named, not as an
+    # opaque out-of-bounds view (or worse, a SIGBUS on the mmap) deep inside
+    # the layer-stacking loop
+    total = header_size + sum(ft.nbytes(int(np.prod(shape))) for _, shape, ft in plan)
+    if data.shape[0] < total:
+        offset = header_size
+        for name, shape, ft in plan:
+            nbytes = ft.nbytes(int(np.prod(shape)))
+            if offset + nbytes > data.shape[0]:
+                raise ModelFileError(
+                    f"{path}: truncated .m file: {data.shape[0]:,} bytes on "
+                    f"disk, {total:,} expected for this header's config; "
+                    f"first incomplete tensor is {name!r} "
+                    f"(needs bytes [{offset:,}, {offset + nbytes:,}))")
+            offset += nbytes
+    if data.shape[0] > total:
+        raise ModelFileError(
+            f"{path}: .m file has {data.shape[0]:,} bytes but this header's "
+            f"config accounts for {total:,} — corrupt header or mismatched "
+            f"weight type")
     offset = header_size
-    for name, shape, ft in tensor_plan(config):
-        n = int(np.prod(shape))
-        nbytes = ft.nbytes(n)
+    for name, shape, ft in plan:
+        nbytes = ft.nbytes(int(np.prod(shape)))
         yield name, shape, ft, data[offset : offset + nbytes]
         offset += nbytes
-    if offset != data.shape[0]:
-        raise ValueError(f"model file size mismatch: consumed {offset}, file has {data.shape[0]}")
 
 
 def decode_dense(raw: np.ndarray, shape: tuple, ft: FloatType) -> np.ndarray:

@@ -24,6 +24,9 @@ from __future__ import annotations
 
 import json
 import logging
+import select
+import signal
+import socket
 import threading
 import time
 import uuid
@@ -31,6 +34,11 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from dllama_tpu.engine.sampling import Sampler
+from dllama_tpu.serve.scheduler import (
+    QueueFull,
+    SchedulerDraining,
+    SchedulerRejected,
+)
 from dllama_tpu.tokenizer.chat import (
     ChatItem,
     ChatTemplate,
@@ -41,6 +49,17 @@ from dllama_tpu.tokenizer.chat import (
 )
 
 log = logging.getLogger("dllama_tpu.serve")
+
+#: socket errors meaning "the client went away" — never worth a stack trace,
+#: never answerable with an error response (the pipe is gone)
+CLIENT_GONE = (BrokenPipeError, ConnectionResetError, ConnectionAbortedError,
+               TimeoutError, socket.timeout)
+
+
+class ClientDisconnected(Exception):
+    """Raised inside a completion when the disconnect probe sees the client
+    socket closed — generation is cancelled instead of running to completion
+    into a dead socket."""
 
 
 @dataclass
@@ -88,13 +107,49 @@ class ApiServer:
         # continuous-batching tier: a serve/scheduler.Scheduler over a
         # BatchEngine — concurrent requests share the device, no global lock
         self.scheduler = scheduler
+        # flipped by the SIGTERM drain sequence: new requests get 503 while
+        # in-flight ones finish (single-engine tier included — the scheduler
+        # has its own draining flag for its admission queue)
+        self.draining = False
+
+    # ---------------------------------------------------------------- health
+
+    def health(self) -> dict:
+        """Liveness/readiness payload for GET /health (and the /health/live,
+        /health/ready sub-probes). The continuous-batching tier forwards the
+        scheduler's supervision snapshot; the single-engine tier is live as
+        long as the process answers."""
+        if self.scheduler is not None:
+            h = self.scheduler.health()
+        else:
+            h = {"live": True, "ready": True, "queue_depth": 0,
+                 "busy_slots": 0, "n_slots": 0, "last_step_age_s": 0.0}
+        if self.draining:
+            h["ready"] = False
+            h["draining"] = True
+        h["status"] = "ok" if h["live"] else "unhealthy"
+        h["mode"] = "continuous" if self.scheduler is not None else "single"
+        return h
+
+    def precheck_capacity(self) -> None:
+        """Raise the admission-control rejection a submit() would raise,
+        WITHOUT submitting. Streaming handlers call this before the
+        200/chunked headers go out, so an overloaded/draining server sheds
+        stream requests with a clean 429/503 instead of a corrupted stream."""
+        if self.draining:
+            raise SchedulerDraining("server is draining")
+        if self.scheduler is not None:
+            self.scheduler.check_admission()
 
     # ------------------------------------------------------------------ core
 
-    def complete(self, body: dict, emit=None) -> dict:
+    def complete(self, body: dict, emit=None, probe=None) -> dict:
         """Run one chat completion. `emit(text)` streams deltas when given.
-        Returns the non-streaming response dict (also computed when streaming,
-        for the final usage accounting)."""
+        `probe()` (optional) returns True when the client socket is gone —
+        polled during batched generation so a disconnected non-streaming
+        client cancels its scheduler request instead of generating to
+        completion into a dead socket. Returns the non-streaming response
+        dict (also computed when streaming, for the final usage accounting)."""
         messages = [(m["role"], str(m["content"])) for m in body.get("messages", [])]
         if not messages:
             raise ApiError(400, "messages must be a non-empty array")
@@ -112,7 +167,7 @@ class ApiServer:
         if self.scheduler is not None:
             return self._complete_batched(
                 body, messages, temperature, topp, max_tokens, extra_stops, emit,
-                seed=seed, presence=presence, frequency=frequency,
+                seed=seed, presence=presence, frequency=frequency, probe=probe,
             )
 
         with self.lock:
@@ -129,7 +184,7 @@ class ApiServer:
                 presence, frequency)
             content, finish, n_generated = self._run_single(
                 prompt_tokens, budget, sampler,
-                self.stops + list(extra_stops), emit)
+                self.stops + list(extra_stops), emit, probe=probe)
             # cache the full conversation incl. the reply for the next turn
             self.cache.messages = messages + [("assistant", content)]
             self.cache.pos = self.engine.pos
@@ -197,20 +252,29 @@ class ApiServer:
                           presence=presence, frequency=frequency)
         return budget, sampler
 
-    def _run_single(self, prompt_tokens, budget, sampler, stops, emit
-                    ) -> tuple[str, str, int]:
+    def _run_single(self, prompt_tokens, budget, sampler, stops, emit,
+                    probe=None) -> tuple[str, str, int]:
         """Token loop of a single-engine completion (generate + EOS/stop
         detection + held-prefix flush) -> (content, finish_reason, n_tokens).
         Shared by the chat and legacy endpoints — caller holds self.lock and
-        has positioned the engine."""
+        has positioned the engine. `probe` (dead-client check) aborts the
+        generation via ClientDisconnected — on THIS tier a dead request
+        holds the global engine lock, so cancelling it unblocks every other
+        client, not just a slot. The engine is left mid-generation; the next
+        request's reset()/prefix-cache miss rewrites those rows."""
         detector = EosDetector(self.tokenizer.eos_ids, stops,
                                padding_left=2, padding_right=2)
         self.tokenizer.reset_decoder()
         parts: list[str] = []
         n_generated = 0
         finish = "length"
+        probe_at = time.monotonic() + 0.25
         for t in self.engine.generate(prompt_tokens, budget, sampler,
                                       spec=self.spec):
+            if probe is not None and time.monotonic() >= probe_at:
+                probe_at = time.monotonic() + 0.25
+                if probe():
+                    raise ClientDisconnected()
             n_generated += 1
             res = detector.append(t, self.tokenizer.decode(t))
             text = detector.get_delta()
@@ -232,7 +296,7 @@ class ApiServer:
 
     def _complete_batched(self, body, messages, temperature, topp, max_tokens,
                           extra_stops, emit, seed=None, presence=0.0,
-                          frequency=0.0) -> dict:
+                          frequency=0.0, probe=None) -> dict:
         """Continuous-batching completion: submit to the scheduler, stream from
         the per-request queue. Per-request `seed` pins the slot's own PRNG
         stream (reproducible regardless of batch-mates). Prefix reuse lives in
@@ -246,7 +310,7 @@ class ApiServer:
         content, finish, n_generated = self._run_batched(
             prompt_tokens, temperature, topp, max_tokens,
             self.stops + list(extra_stops), emit,
-            seed=seed, presence=presence, frequency=frequency)
+            seed=seed, presence=presence, frequency=frequency, probe=probe)
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:16]}",
             "object": "chat.completion",
@@ -268,7 +332,7 @@ class ApiServer:
 
     def _run_batched(self, prompt_tokens, temperature, topp, max_tokens,
                      stops, emit, seed=None, presence=0.0,
-                     frequency=0.0) -> tuple[str, str, int]:
+                     frequency=0.0, probe=None) -> tuple[str, str, int]:
         """Token-level core of a batched completion: submit, stream-decode
         with EOS/stop detection, return (content, finish_reason, n_tokens).
         Shared by the chat and legacy-completions endpoints — the caller
@@ -295,9 +359,25 @@ class ApiServer:
         )
         parts: list[str] = []
         n_generated = 0
+        probe_at = time.monotonic() + 0.25
+
+        def probe_tick():
+            # runs from tokens() whenever the stream goes quiet (queued,
+            # mid-prefill, stalled device): a dead client cancels even
+            # before its first token exists
+            if probe():
+                raise ClientDisconnected()
+
         try:
             ended_on_eos = False
-            for t in req.tokens():
+            for t in req.tokens(poll=probe_tick if probe is not None else None):
+                if probe is not None and time.monotonic() >= probe_at:
+                    # ...and at 4 Hz while tokens ARE flowing (a select()+
+                    # MSG_PEEK syscall per token would dominate small models;
+                    # this bounds wasted generation to a quarter second)
+                    probe_at = time.monotonic() + 0.25
+                    if probe():
+                        raise ClientDisconnected()
                 n_generated += 1
                 res = detector.append(t, decoder.decode(t))
                 text = detector.get_delta()
@@ -321,7 +401,7 @@ class ApiServer:
         finish = req.finish_reason if req.finish_reason in ("stop", "length") else "stop"
         return "".join(parts), finish, n_generated
 
-    def complete_legacy(self, body: dict, emit=None) -> dict:
+    def complete_legacy(self, body: dict, emit=None, probe=None) -> dict:
         """POST /v1/completions — the pre-chat OpenAI surface some clients
         still speak: a RAW prompt string, no chat template, `text` in the
         choices. Shares the sampling params and generation machinery with
@@ -342,7 +422,8 @@ class ApiServer:
             content, finish, n_generated = self._run_batched(
                 prompt_tokens, temperature, topp, max_tokens,
                 list(extra_stops),  # raw prompt: no chat-template stops
-                emit, seed=seed, presence=presence, frequency=frequency)
+                emit, seed=seed, presence=presence, frequency=frequency,
+                probe=probe)
         else:
             with self.lock:
                 # raw-prompt rows overwrite the chat prefix cache's claim
@@ -353,7 +434,8 @@ class ApiServer:
                     presence, frequency)
                 # legacy endpoint: no chat stop strings, only explicit ones
                 content, finish, n_generated = self._run_single(
-                    prompt_tokens, budget, sampler, list(extra_stops), emit)
+                    prompt_tokens, budget, sampler, list(extra_stops), emit,
+                    probe=probe)
 
         return {
             "id": f"cmpl-{uuid.uuid4().hex[:16]}",
@@ -400,21 +482,49 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         log.info("%s %s", self.address_string(), fmt % args)
 
-    def _send_json(self, status: int, payload: dict) -> None:
+    def _send_json(self, status: int, payload: dict,
+                   headers: dict | None = None) -> None:
         data = json.dumps(payload).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
     def do_GET(self):
         if self.path == "/v1/models":
             self._send_json(200, self.api.models())
-        elif self.path == "/health":
-            self._send_json(200, {"status": "ok"})
+        elif self.path in ("/health", "/health/live", "/health/ready"):
+            # /health: full snapshot, status by liveness (a restart signal);
+            # /health/live and /health/ready: the k8s-style split probes —
+            # ready goes 503 under drain/saturation while live stays 200,
+            # so balancers stop routing without the supervisor killing us
+            h = self.api.health()
+            key = "ready" if self.path.endswith("/ready") else "live"
+            self._send_json(200 if h[key] else 503, h)
         else:
             self._send_json(404, {"error": {"message": "not found"}})
+
+    def _client_gone(self) -> bool:
+        """Disconnect probe for non-streamed completions: a readable socket
+        that MSG_PEEKs zero bytes is a closed peer (we never read mid-
+        completion, so pending bytes can only be a pipelined request — in
+        which case the client is certainly still there).
+
+        Known trade-off: a client that legally HALF-closes its write side
+        after the request body (shutdown(SHUT_WR), then reads) looks
+        identical to a full close at this layer and gets cancelled. That's
+        the same call Starlette/uvicorn make for their disconnect probes;
+        real OpenAI-style clients keep the socket open until the response."""
+        try:
+            r, _, _ = select.select([self.connection], [], [], 0)
+            if not r:
+                return False
+            return self.connection.recv(1, socket.MSG_PEEK) == b""
+        except (OSError, ValueError):
+            return True
 
     def do_POST(self):
         chat = self.path in ("/v1/chat/completions", "/chat/completions")
@@ -429,23 +539,44 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": {"message": "invalid JSON body"}})
             return
         try:
+            if self.api.draining:
+                raise SchedulerDraining("server is draining")
             if body.get("stream"):
                 # cheap validation BEFORE the 200/chunked headers go out — an
                 # ApiError raised mid-stream would write a second status line
-                # into the chunk stream (a protocol violation)
+                # into the chunk stream (a protocol violation). Capacity is
+                # prechecked for the same reason: overload sheds as a clean
+                # 429/503, not a poisoned stream.
                 self.api.prevalidate(body, legacy=legacy)
+                self.api.precheck_capacity()
                 self._stream(body, legacy=legacy)
             elif legacy:
-                self._send_json(200, self.api.complete_legacy(body))
+                self._send_json(200, self.api.complete_legacy(
+                    body, probe=self._client_gone))
             else:
-                self._send_json(200, self.api.complete(body))
+                self._send_json(200, self.api.complete(
+                    body, probe=self._client_gone))
         except ApiError as e:
             self._send_json(e.status, {"error": {"message": e.message}})
-        except BrokenPipeError:
-            log.info("client disconnected mid-stream")
+        except QueueFull as e:
+            # load shedding: the request never entered the queue; tell the
+            # client when to come back (429 per OpenAI's own rate responses)
+            self._send_json(429, {"error": {"message": str(e)}},
+                            {"Retry-After": str(int(e.retry_after_s))})
+        except SchedulerRejected as e:
+            # draining or unhealthy: 503 so balancers retry elsewhere
+            self._send_json(503, {"error": {"message": str(e)}},
+                            {"Retry-After": str(int(e.retry_after_s))})
+        except ClientDisconnected:
+            log.info("client disconnected; request cancelled")
+        except CLIENT_GONE:
+            log.info("client connection lost mid-response")
         except Exception:
             log.exception("completion failed")
-            self._send_json(500, {"error": {"message": "internal error"}})
+            try:
+                self._send_json(500, {"error": {"message": "internal error"}})
+            except CLIENT_GONE:
+                pass
 
     def _stream(self, body: dict, legacy: bool = False) -> None:
         """SSE chunked streaming (dllama-api.cpp:203-223's role). `legacy`
@@ -482,13 +613,37 @@ class _Handler(BaseHTTPRequestHandler):
             }
             chunk(b"data: " + json.dumps(data).encode() + b"\n\n")
 
-        if legacy:
-            result = self.api.complete_legacy(body, emit=emit_text)
-            emit_text("", finish=result["choices"][0]["finish_reason"])
-        else:
-            emit_chat({"role": "assistant"})
-            result = self.api.complete(body, emit=lambda text: emit_chat({"content": text}))
-            emit_chat({}, finish=result["choices"][0]["finish_reason"])
+        try:
+            # streams get the disconnect probe too: a chunk write into a dead
+            # socket fails on its own once tokens flow, but ONLY the probe
+            # notices a client that vanished while queued / mid-prefill
+            # (no tokens flowing yet)
+            if legacy:
+                result = self.api.complete_legacy(
+                    body, emit=emit_text, probe=self._client_gone)
+                emit_text("", finish=result["choices"][0]["finish_reason"])
+            else:
+                emit_chat({"role": "assistant"})
+                result = self.api.complete(
+                    body, emit=lambda text: emit_chat({"content": text}),
+                    probe=self._client_gone)
+                emit_chat({}, finish=result["choices"][0]["finish_reason"])
+        except (ClientDisconnected, *CLIENT_GONE):
+            raise  # nothing to tell a dead socket; do_POST just logs it
+        except Exception as e:
+            # the 200/chunked headers are out — a second status line would
+            # corrupt the stream. Emit an in-band SSE error event (the OpenAI
+            # streaming error shape) and terminate the stream cleanly so the
+            # client fails fast instead of hanging on a half-open stream.
+            # Client-safe exception types keep their message; anything else
+            # is masked like the non-stream 500 path (no internals leak).
+            log.exception("streamed completion failed mid-stream")
+            msg = (str(e) if isinstance(e, (ApiError, SchedulerRejected))
+                   else "internal error")
+            chunk(b"data: " + json.dumps(
+                {"error": {"message": msg or e.__class__.__name__,
+                           "type": "server_error"}}
+            ).encode() + b"\n\n")
         chunk(b"data: [DONE]\n\n")
         chunk(b"")  # terminating zero-length chunk
 
@@ -508,6 +663,10 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
         log.warning("admission pacing flags (--admit-budget-ms / "
                     "--admit-ttft-deadline-ms) need --slots > 0; the "
                     "single-engine tier has no admission scheduler — ignored")
+    if n_slots <= 0 and any(defaults.get(k) for k in ("max_queue", "stall_deadline_s")):
+        log.warning("--max-queue / --stall-deadline-s need --slots > 0; the "
+                    "single-engine tier has no admission queue or worker "
+                    "thread to watch — ignored")
     if n_slots > 0:
         from dllama_tpu.engine.batch import BatchEngine
         from dllama_tpu.serve.scheduler import Scheduler
@@ -541,6 +700,13 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
             sched_kw["admit_stall_budget_ms"] = float(defaults["admit_stall_budget_ms"])
         if defaults.get("admit_ttft_deadline_ms") is not None:
             sched_kw["admit_ttft_deadline_ms"] = float(defaults["admit_ttft_deadline_ms"])
+        # supervision knobs: bounded admission (--max-queue -> 429 shedding)
+        # and the stall watchdog (--stall-deadline-s -> live=false on a hung
+        # device chunk)
+        if defaults.get("max_queue"):
+            sched_kw["max_queue"] = int(defaults["max_queue"])
+        if defaults.get("stall_deadline_s"):
+            sched_kw["stall_deadline_s"] = float(defaults["stall_deadline_s"])
         scheduler = Scheduler(be, **sched_kw)
     api = ApiServer(
         loaded,
@@ -555,8 +721,57 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
     return httpd, api
 
 
+def graceful_drain(httpd, api, timeout_s: float = 30.0) -> bool:
+    """The deploy-time shutdown sequence (SIGTERM handler body, also callable
+    directly from tests/embedding code):
+
+    1. stop admission — new requests get 503 + Retry-After, /health/ready
+       goes 503 so balancers route away;
+    2. let in-flight requests (and already-queued ones) finish, bounded by
+       `timeout_s`;
+    3. shut down the scheduler and stop the HTTP accept loop.
+
+    Returns True when everything in flight completed inside the timeout."""
+    api.draining = True
+    clean = True
+    if api.scheduler is not None:
+        clean = api.scheduler.drain(timeout_s)
+    else:
+        # single-engine tier: the global lock serializes requests; waiting
+        # for it (with the same deadline) means the in-flight one finished
+        clean = api.lock.acquire(timeout=max(0.0, timeout_s))
+        if clean:
+            api.lock.release()
+    httpd.shutdown()
+    return clean
+
+
+def install_sigterm_drain(httpd, api, timeout_s: float = 30.0) -> bool:
+    """SIGTERM -> graceful_drain in a helper thread (the handler itself must
+    return fast; serve_forever keeps running until httpd.shutdown()). Returns
+    False when not on the main thread, where signal.signal raises."""
+    fired = threading.Event()
+
+    def _term(signum, frame):
+        if fired.is_set():
+            return
+        fired.set()
+        log.info("SIGTERM: draining (timeout %.0fs) — new requests get 503",
+                 timeout_s)
+        threading.Thread(target=graceful_drain, args=(httpd, api, timeout_s),
+                         name="dllama-drain", daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _term)
+        return True
+    except ValueError:  # not the main thread (embedded/test usage)
+        return False
+
+
 def run_server(loaded, host="127.0.0.1", port=9990, n_slots: int = 0, **defaults) -> int:
     httpd, api = make_server(loaded, host, port, n_slots=n_slots, **defaults)
+    drain_timeout_s = float(defaults.get("drain_timeout_s") or 30.0)
+    install_sigterm_drain(httpd, api, drain_timeout_s)
     mode = f"continuous batching, {n_slots} slots" if n_slots else "single-request + prefix cache"
     log.info("serving on http://%s:%d (%s)", host, httpd.server_address[1], mode)
     print(f"🚀 http://{host}:{httpd.server_address[1]}/v1/chat/completions ({mode})")

@@ -33,10 +33,36 @@ import jax
 import numpy as np
 
 from dllama_tpu.engine.batch import BatchEngine
+from dllama_tpu.utils import faults
 
 log = logging.getLogger("dllama_tpu.serve")
 
 _END = object()  # sentinel on the token queue; payload = finish reason
+
+
+class SchedulerRejected(RuntimeError):
+    """Base of the admission-control rejections: the request never entered
+    the queue and running generations are unperturbed. `retry_after_s` is the
+    client hint the API tier forwards as a Retry-After header."""
+
+    retry_after_s: float = 1.0
+
+
+class QueueFull(SchedulerRejected):
+    """Shed under load: pending depth reached --max-queue (HTTP 429)."""
+
+
+class SchedulerDraining(SchedulerRejected):
+    """Admission stopped for a graceful shutdown (HTTP 503)."""
+
+    retry_after_s = 5.0
+
+
+class SchedulerUnhealthy(SchedulerRejected):
+    """The worker thread crashed or is gone; nothing can serve this
+    request (HTTP 503 — readiness is down too, so balancers drain us)."""
+
+    retry_after_s = 10.0
 
 
 @dataclass
@@ -74,10 +100,22 @@ class Request:
             return None
         return (self.finished_at - self.first_token_at) * 1000.0 / (self.produced - 1)
 
-    def tokens(self):
-        """Blocking iterator over generated tokens (ends on EOS/budget/cancel)."""
+    def tokens(self, poll=None, poll_s: float = 0.25):
+        """Blocking iterator over generated tokens (ends on EOS/budget/cancel).
+
+        `poll` (optional zero-arg callable) runs every `poll_s` seconds of
+        WAITING — i.e. also while no tokens are flowing at all (queued behind
+        a full batch, mid-prefill, stalled device), which is exactly when a
+        disconnect probe matters most. Whatever it raises propagates."""
         while True:
-            item = self.out.get()
+            if poll is None:
+                item = self.out.get()
+            else:
+                try:
+                    item = self.out.get(timeout=poll_s)
+                except queue.Empty:
+                    poll()
+                    continue
             if item is _END or isinstance(item, Exception):
                 if isinstance(item, Exception):
                     raise item
@@ -89,10 +127,16 @@ class Scheduler:
     def __init__(self, engine: BatchEngine, chunk: int = 4, admit_timeout: float = 0.05,
                  admit_interleave: bool = True,
                  admit_stall_budget_ms: float = 250.0,
-                 admit_ttft_deadline_ms: float | None = None):
+                 admit_ttft_deadline_ms: float | None = None,
+                 max_queue: int = 0,
+                 stall_deadline_s: float = 0.0):
         self.engine = engine
         self.chunk = chunk
         self.admit_timeout = admit_timeout
+        # bounded admission (load shedding): submit() raises QueueFull once
+        # the pending queue holds this many requests — the API tier turns it
+        # into 429 + Retry-After. 0 = unbounded (the pre-supervision behavior).
+        self.max_queue = int(max_queue)
         # interleaved admission (VERDICT r3 weak #5): pump prefill chunks of a
         # joining prompt BETWEEN decode chunks instead of running the whole
         # chunked prefill synchronously — a 2 Ki-token admission no longer
@@ -131,20 +175,135 @@ class Scheduler:
         self._metrics_lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = threading.Event()
+        # ---- supervision state (all read by health(), written by the worker
+        # or watchdog; plain attribute stores are atomic under the GIL)
+        self.crashed: BaseException | None = None  # worker died with this
+        self.join_failed = False  # shutdown() could not join the worker
+        self._draining = threading.Event()  # admission stopped for drain
+        self.stalled = False  # watchdog verdict: a chunk blew the deadline
+        self.stall_count = 0  # total watchdog trips (stalled may recover)
+        # worker heartbeat: stamped once per loop iteration. A device call
+        # that hangs stops the heartbeat while work exists — which is exactly
+        # the condition the watchdog turns into "stalled".
+        self._heartbeat = time.monotonic()
         self._thread = threading.Thread(target=self._run, name="dllama-scheduler", daemon=True)
         self._thread.start()
+        # stall watchdog: marks the server unhealthy when the worker goes
+        # silent mid-work for longer than the deadline (a hung device chunk,
+        # a wedged collective). Detection only — there is no safe preemption
+        # of a dispatched XLA computation; the operator (or the pod
+        # supervisor watching /health) owns the restart.
+        self.stall_deadline_s = float(stall_deadline_s)
+        self._watchdog = None
+        if self.stall_deadline_s > 0:
+            self._watchdog = threading.Thread(
+                target=self._watch, name="dllama-watchdog", daemon=True)
+            self._watchdog.start()
 
     # ------------------------------------------------------------------- api
 
     def submit(self, prompt, temperature, topp, max_tokens, eos_ids,
                seed: int | None = None, presence: float = 0.0,
                frequency: float = 0.0) -> Request:
+        self.check_admission()
         req = Request(list(prompt), float(temperature), float(topp), int(max_tokens),
                       frozenset(eos_ids), seed=seed, presence=float(presence),
                       frequency=float(frequency), submitted_at=time.monotonic())
         self.pending.put(req)
+        if self.crashed is not None or not self._thread.is_alive():
+            # lost the race with a worker crash: _fail_all may already have
+            # drained the queue, so this request could sit there forever —
+            # raise instead of handing back a Request nobody will serve
+            raise SchedulerUnhealthy(
+                f"scheduler worker died during submit ({self.crashed!r})")
         self._wake.set()
         return req
+
+    def check_admission(self) -> None:
+        """Admission control, cheapest check first; raises a
+        SchedulerRejected subclass when this scheduler must not take new
+        work. Rejected requests never touch the queue, so running
+        generations see no perturbation at all. Also used by the API tier
+        to shed STREAM requests before their response headers go out."""
+        if self.crashed is not None or not self._thread.is_alive():
+            raise SchedulerUnhealthy(
+                f"scheduler worker is dead ({self.crashed!r}); refusing work")
+        if self.stalled:
+            # the watchdog says the worker is wedged mid-chunk: queueing more
+            # work would strand more clients. The flag clears if heartbeats
+            # resume, and 503+Retry-After tells callers to come back then.
+            raise SchedulerUnhealthy(
+                "scheduler worker is stalled (device chunk past "
+                "--stall-deadline-s); refusing work")
+        if self._draining.is_set():
+            raise SchedulerDraining("scheduler is draining; no new requests")
+        if self.max_queue and self.pending.qsize() >= self.max_queue:
+            raise QueueFull(
+                f"admission queue full ({self.pending.qsize()} >= "
+                f"--max-queue {self.max_queue})")
+        try:
+            faults.fire("scheduler.queue")
+        except faults.InjectedFault as e:
+            raise QueueFull(str(e)) from e
+
+    def _busy(self) -> bool:
+        """Whether the worker owes anyone progress (watchdog gating: an idle
+        worker parked on its wake event must never read as stalled)."""
+        return bool(self.slots) or bool(self._inflight) or not self.pending.empty()
+
+    def health(self) -> dict:
+        """Liveness + readiness snapshot for the API tier's /health.
+
+        `live`   — the worker thread can still make progress (alive, not
+                   crashed, not known-wedged): false means restart me.
+        `ready`  — admit new work here: false while draining, saturated, or
+                   not live (balancers should route away, not kill).
+        The rest is the observability payload: queue depth, busy slots, and
+        the age of the worker's last heartbeat."""
+        qdepth = self.pending.qsize()
+        live = (self._thread.is_alive() and self.crashed is None
+                and not self.join_failed and not self.stalled)
+        saturated = bool(self.max_queue) and qdepth >= self.max_queue
+        return {
+            "live": live,
+            "ready": live and not self._draining.is_set() and not saturated,
+            "queue_depth": qdepth,
+            "max_queue": self.max_queue,
+            "busy_slots": int(np.asarray(self.engine.active).sum()),
+            "n_slots": self.engine.n_slots,
+            "in_flight_admissions": len(self._inflight),
+            "last_step_age_s": round(time.monotonic() - self._heartbeat, 3),
+            "stall_deadline_s": self.stall_deadline_s,
+            "stalled": self.stalled,
+            "stall_count": self.stall_count,
+            "draining": self._draining.is_set(),
+            "crashed": repr(self.crashed) if self.crashed is not None else None,
+            "join_failed": self.join_failed,
+        }
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful drain: stop admission (submit raises SchedulerDraining),
+        let in-flight and already-queued requests finish, then shut down.
+        Returns True when everything completed inside the timeout; False
+        means stragglers were cut off by shutdown."""
+        self._draining.set()
+        self._wake.set()
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        clean = False
+        while time.monotonic() < deadline:
+            if not self._busy():
+                clean = True
+                break
+            if self.crashed is not None or not self._thread.is_alive():
+                break  # nothing will ever finish; stop waiting
+            time.sleep(0.02)
+        if not clean:
+            log.warning("drain timeout (%.1fs): %d slots / %d admissions / "
+                        "%d queued still in flight — shutting down anyway",
+                        timeout_s, len(self.slots), len(self._inflight),
+                        self.pending.qsize())
+        self.shutdown()
+        return clean
 
     def latency_summary(self) -> dict:
         """Aggregate TTFT / inter-token latency over completed requests, plus
@@ -181,10 +340,26 @@ class Scheduler:
         req.cancelled.set()
         self._wake.set()
 
+    #: how long shutdown() waits for the worker before declaring it wedged
+    #: (attribute, not constant: fault drills shrink it instead of sleeping)
+    join_timeout_s: float = 10.0
+
     def shutdown(self) -> None:
         self._stop.set()
         self._wake.set()
-        self._thread.join(timeout=10)
+        self._thread.join(timeout=self.join_timeout_s)
+        if self._thread.is_alive():
+            # a worker that won't die is almost certainly wedged inside a
+            # device call; it is daemonic so the process can still exit, but
+            # the engine must be considered unusable — say so loudly and let
+            # /health report it instead of silently returning
+            self.join_failed = True
+            log.warning(
+                "scheduler worker failed to join within %.1fs (thread %r, "
+                "alive=%s, %d slots / %d admissions still held) — engine "
+                "state is unrecoverable; /health reports live=false",
+                self.join_timeout_s, self._thread.name,
+                self._thread.is_alive(), len(self.slots), len(self._inflight))
 
     # ------------------------------------------------------------------ loop
 
@@ -389,11 +564,79 @@ class Scheduler:
             return worked
         return worked
 
+    def _fail_req(self, req: Request, exc: BaseException) -> None:
+        """Crash-path finish: mark the request failed and unblock its
+        consumer WITHOUT touching the engine (whose state is unknown after a
+        worker crash — release()/donated buffers may be invalid)."""
+        req.finish_reason = "error"
+        req.finished_at = time.monotonic()
+        with self._metrics_lock:
+            self._completed.append(req)
+            del self._completed[:-256]
+        req.out.put(exc)
+        req.out.put(_END)
+
+    def _fail_all(self, exc: BaseException) -> None:
+        """Fail every queue a client could be blocked on: in-flight
+        admissions, decoding slots, and the pending queue. The whole point
+        of supervision — nobody hangs forever on a dead worker."""
+        for req, _adm, _ in self._inflight:
+            self._fail_req(req, exc)
+        self._inflight.clear()
+        for req in list(self.slots.values()):
+            self._fail_req(req, exc)
+        self.slots.clear()
+        while True:
+            try:
+                req = self.pending.get_nowait()
+            except queue.Empty:
+                break
+            self._fail_req(req, exc)
+
+    def _watch(self) -> None:
+        """Stall watchdog body: flag `stalled` when the worker has owed
+        progress for longer than the deadline without a heartbeat. Recovers
+        (clears the flag) if heartbeats resume — stall_count keeps the
+        incident record either way."""
+        poll = max(0.01, min(0.25, self.stall_deadline_s / 4.0))
+        while not self._stop.is_set():
+            time.sleep(poll)
+            if self.crashed is not None:
+                return  # crash supervision already owns the health verdict
+            age = time.monotonic() - self._heartbeat
+            if self._busy() and age > self.stall_deadline_s:
+                if not self.stalled:
+                    self.stalled = True
+                    self.stall_count += 1
+                    log.error(
+                        "watchdog: scheduler worker silent for %.2fs with "
+                        "work in flight (deadline %.2fs) — device chunk "
+                        "presumed hung; /health reports live=false",
+                        age, self.stall_deadline_s)
+            elif self.stalled and age <= self.stall_deadline_s:
+                self.stalled = False
+                log.warning("watchdog: worker heartbeat resumed; clearing "
+                            "stall flag (%d total stalls)", self.stall_count)
+
     def _run(self) -> None:
+        """Supervised worker entry: any escape from the serving loop fails
+        every in-flight request (finish_reason='error', queues unblocked)
+        and flips the health flag instead of silently stranding clients."""
+        try:
+            self._loop()
+        except BaseException as e:  # noqa: BLE001 — supervision must be total
+            self.crashed = e
+            log.exception("scheduler worker crashed; failing all in-flight "
+                          "requests and marking /health unhealthy")
+            self._fail_all(e)
+
+    def _loop(self) -> None:
         # end of the previous decode chunk (stall metric); instance attribute
         # so reset_latency_stats can rewind it from the caller's thread
         self._t_dec_end = None
         while not self._stop.is_set():
+            self._heartbeat = time.monotonic()
+            faults.fire("scheduler.loop")
             self._admit_starts()
             admitted = self._pump_admissions()
             for slot, req in list(self.slots.items()):
@@ -432,17 +675,16 @@ class Scheduler:
                 if use_spec and not all(elig[s] for s in self.slots):
                     self._spec_tick = not self._spec_tick
                     use_spec = self._spec_tick
-            try:
-                if use_spec:
-                    emit_toks, adv = self.engine.spec_step()
-                else:
-                    toks = self.engine.decode(self.chunk)
-            except Exception as e:
-                log.exception("decode failed; failing all in-flight requests")
-                for req in list(self.slots.values()):
-                    req.out.put(e)
-                    self._finish(req, "error")
-                continue
+            # a decode failure is NOT a per-request problem: the jitted step
+            # donates the KV cache, so an exception mid-chunk leaves the
+            # engine's buffers in an indeterminate state. Escalate to the
+            # supervision wrapper — every in-flight request fails fast with
+            # finish_reason='error' and /health goes unhealthy (the process
+            # supervisor owns the restart).
+            if use_spec:
+                emit_toks, adv = self.engine.spec_step()
+            else:
+                toks = self.engine.decode(self.chunk)
             self._t_dec_end = time.monotonic()
             for slot, req in list(self.slots.items()):
                 n = int(adv[slot]) if use_spec else toks.shape[0]
@@ -451,7 +693,24 @@ class Scheduler:
                     tok = emit_toks[slot, i] if use_spec else toks[i, slot]
                     if self._emit(req, tok, start_rows[slot] + i + 1):
                         break
+        # shutdown with work still in flight (drain timeout, hard stop): the
+        # cut-off requests must surface as FAILURES to their clients — a bare
+        # _END would read as a clean, complete generation (HTTP 200 with
+        # silently truncated content). One path for all three places a client
+        # can be parked: mid-admission, decoding, still queued.
+        def cut(req: Request) -> None:
+            req.out.put(SchedulerDraining(
+                "server shut down before this request completed"))
+            self._finish(req, "shutdown")  # metrics ring + _END + slot release
+
         for req, adm, _ in self._inflight:
-            self._abort_admission(req, adm, "shutdown")
+            self.slot_tokens[adm.slot] = []  # rows are mid-overwrite
+            cut(req)
+        self._inflight.clear()
         for req in list(self.slots.values()):
-            self._finish(req, "shutdown")
+            cut(req)
+        while True:
+            try:
+                cut(self.pending.get_nowait())
+            except queue.Empty:
+                break

@@ -1,0 +1,190 @@
+"""Deterministic fault injection for the serving stack.
+
+The reference server has no failure-path testing at all (a hung request just
+stalls its one blocking client, dllama-api.cpp:522-533); our continuous-
+batching tier multiplexes every client over ONE worker thread and ONE device,
+so "what happens when a decode chunk dies" must be testable on demand.  This
+module gives every interesting failure a NAME, and lets tests (or an operator
+reproducing an incident) arm it deterministically — no monkeypatching into
+jitted internals, no sleeps-and-hope.
+
+Injection points (armed sites call :func:`fire` with their point name):
+
+======================  =====================================================
+``engine.decode``       before the fused decode-chunk dispatch
+                        (BatchEngine.decode / spec_step)
+``engine.prefill``      before an admission prefill chunk (BatchEngine.add_step)
+``loader.read``         before the .m header read (models/formats.read_header)
+``scheduler.queue``     admission-queue overflow: Scheduler.submit sheds the
+                        request as if --max-queue were exceeded
+``scheduler.loop``      top of the scheduler worker loop (worker-crash drill)
+======================  =====================================================
+
+Actions: ``raise`` (throw :class:`InjectedFault`) and ``delay`` (sleep
+``ms``, e.g. to trip the stall watchdog).  Options: ``after=N`` skips the
+first N hits, ``times=N`` fires at most N times (default: forever).
+
+Configuration is a comma-separated spec string, via the ``DLLAMA_FAULTS``
+env var or the ``--faults`` CLI flag::
+
+    DLLAMA_FAULTS="engine.decode:raise:after=2"
+    --faults "engine.decode:delay:ms=400:times=1,scheduler.queue:raise"
+
+Tests use the programmatic API (:func:`install` / :func:`clear`); both paths
+share the same plan table.  ``fire`` is a dict-lookup no-op when nothing is
+armed — production cost is one ``if``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+log = logging.getLogger("dllama_tpu.faults")
+
+ENV_VAR = "DLLAMA_FAULTS"
+
+#: every site that calls fire(); configure() rejects unknown names so a typo
+#: in a fault spec fails at startup, not by silently never firing
+POINTS = frozenset({
+    "engine.decode",
+    "engine.prefill",
+    "loader.read",
+    "scheduler.queue",
+    "scheduler.loop",
+})
+
+ACTIONS = frozenset({"raise", "delay"})
+
+
+class InjectedFault(RuntimeError):
+    """The error thrown by an armed ``raise`` fault (never raised by real
+    failures — tests can assert on the type to prove the drill fired)."""
+
+    def __init__(self, point: str):
+        super().__init__(f"injected fault at {point!r}")
+        self.point = point
+
+
+@dataclass
+class _Fault:
+    point: str
+    action: str  # 'raise' | 'delay'
+    ms: float = 0.0  # delay duration
+    after: int = 0  # skip the first N hits
+    times: int | None = None  # fire at most N times (None = forever)
+    hits: int = 0  # total fire() visits (fired or not)
+    fired: int = 0
+    lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def visit(self) -> str | None:
+        """Count one arrival at the point; return the action to apply (or
+        None when the window says skip). Thread-safe: concurrent request
+        threads hit scheduler.queue simultaneously."""
+        with self.lock:
+            n = self.hits
+            self.hits += 1
+            if n < self.after:
+                return None
+            if self.times is not None and self.fired >= self.times:
+                return None
+            self.fired += 1
+            return self.action
+
+
+_plan: dict[str, _Fault] = {}
+_plan_lock = threading.Lock()
+
+
+def parse(spec: str) -> list[_Fault]:
+    """Parse a spec string into fault entries (validating names eagerly)."""
+    out: list[_Fault] = []
+    for clause in filter(None, (c.strip() for c in spec.split(","))):
+        parts = clause.split(":")
+        if len(parts) < 2:
+            raise ValueError(
+                f"fault clause {clause!r}: want point:action[:k=v...]")
+        point, action, opts = parts[0], parts[1], parts[2:]
+        if point not in POINTS:
+            raise ValueError(
+                f"unknown fault point {point!r}; known: {sorted(POINTS)}")
+        if action not in ACTIONS:
+            raise ValueError(
+                f"unknown fault action {action!r}; known: {sorted(ACTIONS)}")
+        f = _Fault(point, action)
+        for opt in opts:
+            k, _, v = opt.partition("=")
+            if k == "ms":
+                f.ms = float(v)
+            elif k == "after":
+                f.after = int(v)
+            elif k == "times":
+                f.times = int(v)
+            else:
+                raise ValueError(f"unknown fault option {opt!r} in {clause!r}")
+        out.append(f)
+    return out
+
+
+def configure(spec: str | None) -> None:
+    """Replace the active plan from a spec string ('' / None clears)."""
+    faults = parse(spec) if spec else []
+    with _plan_lock:
+        _plan.clear()
+        for f in faults:
+            _plan[f.point] = f
+    if faults:
+        log.warning("fault injection ARMED: %s",
+                    ", ".join(f"{f.point}:{f.action}" for f in faults))
+
+
+def configure_from_env() -> None:
+    """Arm faults from $DLLAMA_FAULTS if set (CLI startup calls this)."""
+    spec = os.environ.get(ENV_VAR)
+    if spec:
+        configure(spec)
+
+
+def install(point: str, action: str = "raise", *, ms: float = 0.0,
+            after: int = 0, times: int | None = None) -> None:
+    """Arm one point programmatically (tests). Replaces any prior fault at
+    the same point; other points are untouched."""
+    if point not in POINTS:
+        raise ValueError(f"unknown fault point {point!r}; known: {sorted(POINTS)}")
+    if action not in ACTIONS:
+        raise ValueError(f"unknown fault action {action!r}; known: {sorted(ACTIONS)}")
+    with _plan_lock:
+        _plan[point] = _Fault(point, action, ms=ms, after=after, times=times)
+
+
+def clear(point: str | None = None) -> None:
+    """Disarm one point, or everything (tests' teardown)."""
+    with _plan_lock:
+        if point is None:
+            _plan.clear()
+        else:
+            _plan.pop(point, None)
+
+
+def active(point: str) -> bool:
+    return point in _plan
+
+
+def fire(point: str) -> None:
+    """The armed-site hook: no-op unless a fault is installed at `point`.
+    Raises InjectedFault for 'raise', sleeps for 'delay'."""
+    f = _plan.get(point)
+    if f is None:
+        return
+    action = f.visit()
+    if action is None:
+        return
+    if action == "delay":
+        log.warning("injected delay at %r: %.0f ms", point, f.ms)
+        time.sleep(f.ms / 1000.0)
+    else:
+        log.warning("injected fault at %r", point)
+        raise InjectedFault(point)
