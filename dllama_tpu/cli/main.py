@@ -18,7 +18,6 @@ on every host via jax.distributed.
 from __future__ import annotations
 
 import argparse
-import logging
 import sys
 import time
 
@@ -115,6 +114,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--coordinator", default=None, help="host:port rendezvous (omit on TPU pods)")
     p.add_argument("--num-processes", type=int, default=None)
     p.add_argument("--process-id", type=int, default=None)
+    p.add_argument("--log-format", choices=["text", "json"], default="text",
+                   help="log line format: text (human) or json (one structured "
+                        "object per line — request_id and other context as "
+                        "fields; see dllama_tpu/utils/logs.py for the schema)")
     p.add_argument("--trace", metavar="DIR", help="write a jax.profiler trace (XProf/TensorBoard)")
     p.add_argument("--report", action="store_true",
                    help="print memory + per-token latency + collective-payload report")
@@ -338,10 +341,11 @@ def cmd_serve(args) -> int:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    logging.basicConfig(
-        level=logging.DEBUG if args.verbose else logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
+    from dllama_tpu.utils.logs import setup_logging
+
+    # shared logger setup (utils/logs.py): --log-format json switches every
+    # line to one structured object with request_id/fault_point/... fields
+    setup_logging(fmt=args.log_format, verbose=args.verbose)
     from dllama_tpu.utils import faults
 
     # $DLLAMA_FAULTS first, --faults wins when both are set; a bad spec

@@ -25,6 +25,7 @@ import numpy as np
 from dllama_tpu.engine.sampling import Sampler
 from dllama_tpu.models.config import LlamaConfig
 from dllama_tpu.models.llama import KVCache, forward
+from dllama_tpu.obs import instruments as ins
 from dllama_tpu.ops.layers import build_rope_cache
 
 
@@ -535,6 +536,11 @@ class InferenceEngine:
         if stats is not None:
             stats.prefill_tokens += len(prompt_tokens)
             stats.prefill_s += t1 - t0
+        # registry mirror of the stats marks (one sample for the whole
+        # chunked prefill — the block_until_ready above makes it device-real)
+        ins.PREFILL_CHUNK_SECONDS.observe(t1 - t0)
+        ins.PREFILL_TOKENS.inc(len(prompt_tokens))
+        ins.TOKENS_GENERATED.inc()  # the prefill-sampled first token
 
         fed = list(prompt_tokens) if use_spec else None
         produced = 0
@@ -571,8 +577,14 @@ class InferenceEngine:
             if stats is not None:
                 stats.decode_tokens += c
                 stats.decode_s += time.perf_counter() - t2
+            ins.DECODE_CHUNK_SECONDS.observe(time.perf_counter() - t2)
             for i in range(c):
                 token = int(toks[i, 0])
+                # counted at hand-off (the next() that returns this token):
+                # after the yield it would never run for the final token of a
+                # stop-terminated iteration, whose consumer breaks and leaves
+                # the generator suspended
+                ins.TOKENS_GENERATED.inc()
                 yield token
                 produced += 1
                 stopped = stop_fn is not None and stop_fn(token)

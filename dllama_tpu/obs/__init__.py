@@ -1,0 +1,34 @@
+"""Serving telemetry: metrics registry + Prometheus exposition + request-id
+minting. Strictly stdlib (no jax, no third-party) so every layer — engine,
+scheduler, API tier, fault injection — can import it without cycles or
+optional-dependency gates.
+
+* :mod:`dllama_tpu.obs.metrics` — the registry core and text exposition.
+* :mod:`dllama_tpu.obs.instruments` — the dllama_* metrics catalog.
+* :func:`new_request_id` — per-request ids (``req_...``) minted at HTTP
+  admission and propagated api -> scheduler -> engine; every response
+  carries the id in ``X-Request-Id`` and every request-scoped log line
+  carries it as the ``request_id`` field.
+"""
+
+from __future__ import annotations
+
+import re
+import uuid
+
+from dllama_tpu.obs import metrics
+from dllama_tpu.obs.metrics import REGISTRY
+
+_REQ_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,128}$")
+
+
+def new_request_id(client_supplied: str | None = None) -> str:
+    """Mint a ``req_<hex>`` id — or adopt a well-formed client-supplied
+    ``X-Request-Id`` verbatim so upstream traces correlate end to end (a
+    malformed one is replaced, never echoed into headers/logs)."""
+    if client_supplied and _REQ_ID_RE.match(client_supplied):
+        return client_supplied
+    return "req_" + uuid.uuid4().hex[:24]
+
+
+__all__ = ["metrics", "REGISTRY", "new_request_id"]

@@ -1,0 +1,117 @@
+"""The serving-stack metrics catalog — every dllama_* series in one place.
+
+One definition site so the README table, the scrape output, and the
+instrumented code can't drift apart. Import the module and touch the
+instruments directly::
+
+    from dllama_tpu.obs import instruments as ins
+    ins.TOKENS_GENERATED.inc(4)
+    ins.REQUESTS_SHED.labels(reason="queue_full").inc()
+
+Everything lives in the global :data:`dllama_tpu.obs.metrics.REGISTRY`
+(what `GET /metrics` renders). Conventions: durations in SECONDS with a
+``_seconds`` suffix (Prometheus idiom — the host code's ms values are
+converted at the observation site), monotonic counts end in ``_total``.
+"""
+
+from __future__ import annotations
+
+from dllama_tpu.obs import metrics
+
+# ------------------------------------------------------------ request flow
+
+REQUESTS_ADMITTED = metrics.counter(
+    "dllama_requests_admitted_total",
+    "Requests accepted into the scheduler admission queue")
+REQUESTS_SHED = metrics.counter(
+    "dllama_requests_shed_total",
+    "Requests rejected at admission, by reason "
+    "(queue_full=429, draining/unhealthy=503)",
+    ("reason",))
+REQUESTS_FINISHED = metrics.counter(
+    "dllama_requests_finished_total",
+    "Requests that reached a terminal state, by finish_reason "
+    "(stop/length = success; cancelled, error, shutdown = not)",
+    ("reason",))
+HTTP_RESPONSES = metrics.counter(
+    "dllama_http_responses_total",
+    "HTTP responses sent, by normalized endpoint and status code "
+    "(covers both serving tiers; streams count at header time)",
+    ("endpoint", "code"))
+
+# ------------------------------------------------------------------ tokens
+
+TOKENS_GENERATED = metrics.counter(
+    "dllama_tokens_generated_total",
+    "Completion tokens emitted to clients (both serving tiers)")
+PREFILL_TOKENS = metrics.counter(
+    "dllama_prefill_tokens_total",
+    "Prompt tokens whose KV rows were computed (cache reuse excluded)")
+REUSED_PREFIX_TOKENS = metrics.counter(
+    "dllama_reused_prefix_tokens_total",
+    "Prompt tokens served from a cached KV prefix instead of prefill")
+
+# ----------------------------------------------------------------- gauges
+
+QUEUE_DEPTH = metrics.gauge(
+    "dllama_queue_depth", "Requests waiting in the admission queue")
+BUSY_SLOTS = metrics.gauge(
+    "dllama_busy_slots", "Cache slots actively decoding")
+SLOTS_TOTAL = metrics.gauge(
+    "dllama_slots_total", "Configured continuous-batching cache slots")
+MODEL_PARAMS_BYTES = metrics.gauge(
+    "dllama_model_params_bytes", "Model parameter bytes resident in HBM")
+KV_CACHE_BYTES = metrics.gauge(
+    "dllama_kv_cache_bytes", "KV-cache bytes resident in HBM")
+
+# ------------------------------------------------------------- histograms
+
+TTFT_SECONDS = metrics.histogram(
+    "dllama_ttft_seconds",
+    "Time to first token, queueing + prefill included (per request)",
+    buckets=metrics.LATENCY_BUCKETS_S)
+ITL_SECONDS = metrics.histogram(
+    "dllama_itl_seconds",
+    "Mean inter-token latency after the first token (per request)",
+    buckets=metrics.CHUNK_BUCKETS_S)
+E2E_SECONDS = metrics.histogram(
+    "dllama_e2e_latency_seconds",
+    "Submit-to-finish request latency (per request)",
+    buckets=metrics.LATENCY_BUCKETS_S)
+PREFILL_CHUNK_SECONDS = metrics.histogram(
+    "dllama_prefill_chunk_seconds",
+    "Host wall time of one prefill chunk (dispatch only unless the caller "
+    "syncs; the scheduler syncs whenever decoders would stall)",
+    buckets=metrics.CHUNK_BUCKETS_S)
+DECODE_CHUNK_SECONDS = metrics.histogram(
+    "dllama_decode_chunk_seconds",
+    "Host wall time of one fused decode chunk (device-synced: the chunk's "
+    "tokens are materialized before the clock stops)",
+    buckets=metrics.CHUNK_BUCKETS_S)
+BATCH_OCCUPANCY = metrics.histogram(
+    "dllama_batch_occupancy",
+    "Active slots per fused decode chunk (mean = _sum/_count)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+ADMISSION_STALL_SECONDS = metrics.histogram(
+    "dllama_admission_stall_seconds",
+    "Decode-to-decode gap inserted by admission work between fused chunks "
+    "(what batch-mates' ITL degrades by during a join)",
+    buckets=metrics.CHUNK_BUCKETS_S)
+TOKEN_LATENCY_SECONDS = metrics.histogram(
+    "dllama_token_latency_seconds",
+    "Per-token host latency recorded by utils.profiling.TokenTimer "
+    "(single-engine inference loop)",
+    buckets=metrics.CHUNK_BUCKETS_S)
+
+# ------------------------------------------------------------ supervision
+
+FAULT_FIRES = metrics.counter(
+    "dllama_fault_fires_total",
+    "Armed fault-injection activations (utils/faults.py), by point/action",
+    ("point", "action"))
+WATCHDOG_STALLS = metrics.counter(
+    "dllama_watchdog_stalls_total",
+    "Watchdog verdicts: worker silent past --stall-deadline-s with work owed")
+WATCHDOG_RECOVERIES = metrics.counter(
+    "dllama_watchdog_recoveries_total",
+    "Watchdog stall flags cleared after heartbeats resumed")

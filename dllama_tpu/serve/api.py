@@ -2,7 +2,13 @@
 (dllama-api.cpp:509-581).
 
 Routes: POST /v1/chat/completions and the legacy POST /v1/completions (both
-stream + non-stream), GET /v1/models, GET /health. Request params override
+stream + non-stream), GET /v1/models, GET /health (+ /health/live,
+/health/ready), GET /metrics (Prometheus text exposition of the process
+registry — dllama_tpu/obs). Every POST mints (or adopts from an inbound
+X-Request-Id) a per-request id `req_...`, propagated api -> scheduler ->
+engine, returned on EVERY response (success, 4xx/5xx, SSE) as the
+X-Request-Id header and attached to the request's log lines as the
+structured `request_id` field. Request params override
 the CLI defaults the way the reference's params do (dllama-api.cpp:455-484):
 temperature, top_p, presence/frequency_penalty, seed, max_tokens, stop,
 stream.
@@ -34,6 +40,8 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from dllama_tpu.engine.sampling import Sampler
+from dllama_tpu.obs import metrics, new_request_id
+from dllama_tpu.obs import instruments as ins
 from dllama_tpu.serve.scheduler import (
     QueueFull,
     SchedulerDraining,
@@ -111,6 +119,14 @@ class ApiServer:
         # in-flight ones finish (single-engine tier included — the scheduler
         # has its own draining flag for its admission queue)
         self.draining = False
+        # startup HBM gauges (model_params_bytes / kv_cache_bytes): account
+        # the engine that actually serves — the BatchEngine owns the slot
+        # cache on the continuous tier, loaded.engine on the single tier
+        from dllama_tpu.utils.profiling import set_memory_gauges
+
+        eng = scheduler.engine if scheduler is not None else self.engine
+        self.model_params_bytes, self.kv_cache_bytes = set_memory_gauges(
+            eng.params, eng.cache)
 
     # ---------------------------------------------------------------- health
 
@@ -129,6 +145,10 @@ class ApiServer:
             h["draining"] = True
         h["status"] = "ok" if h["live"] else "unhealthy"
         h["mode"] = "continuous" if self.scheduler is not None else "single"
+        # HBM accounting rides the ready payload (and /metrics as gauges) so
+        # capacity questions don't need a restart with --report
+        h["model_params_bytes"] = self.model_params_bytes
+        h["kv_cache_bytes"] = self.kv_cache_bytes
         return h
 
     def precheck_capacity(self) -> None:
@@ -137,19 +157,22 @@ class ApiServer:
         200/chunked headers go out, so an overloaded/draining server sheds
         stream requests with a clean 429/503 instead of a corrupted stream."""
         if self.draining:
+            ins.REQUESTS_SHED.labels(reason="draining").inc()
             raise SchedulerDraining("server is draining")
         if self.scheduler is not None:
             self.scheduler.check_admission()
 
     # ------------------------------------------------------------------ core
 
-    def complete(self, body: dict, emit=None, probe=None) -> dict:
+    def complete(self, body: dict, emit=None, probe=None, req_id: str = "") -> dict:
         """Run one chat completion. `emit(text)` streams deltas when given.
         `probe()` (optional) returns True when the client socket is gone —
         polled during batched generation so a disconnected non-streaming
         client cancels its scheduler request instead of generating to
-        completion into a dead socket. Returns the non-streaming response
-        dict (also computed when streaming, for the final usage accounting)."""
+        completion into a dead socket. `req_id` tags the scheduler request
+        (and thus the admission/finish log lines) with the HTTP request id.
+        Returns the non-streaming response dict (also computed when
+        streaming, for the final usage accounting)."""
         messages = [(m["role"], str(m["content"])) for m in body.get("messages", [])]
         if not messages:
             raise ApiError(400, "messages must be a non-empty array")
@@ -168,6 +191,7 @@ class ApiServer:
             return self._complete_batched(
                 body, messages, temperature, topp, max_tokens, extra_stops, emit,
                 seed=seed, presence=presence, frequency=frequency, probe=probe,
+                req_id=req_id,
             )
 
         with self.lock:
@@ -296,7 +320,7 @@ class ApiServer:
 
     def _complete_batched(self, body, messages, temperature, topp, max_tokens,
                           extra_stops, emit, seed=None, presence=0.0,
-                          frequency=0.0, probe=None) -> dict:
+                          frequency=0.0, probe=None, req_id: str = "") -> dict:
         """Continuous-batching completion: submit to the scheduler, stream from
         the per-request queue. Per-request `seed` pins the slot's own PRNG
         stream (reproducible regardless of batch-mates). Prefix reuse lives in
@@ -310,7 +334,8 @@ class ApiServer:
         content, finish, n_generated = self._run_batched(
             prompt_tokens, temperature, topp, max_tokens,
             self.stops + list(extra_stops), emit,
-            seed=seed, presence=presence, frequency=frequency, probe=probe)
+            seed=seed, presence=presence, frequency=frequency, probe=probe,
+            req_id=req_id)
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:16]}",
             "object": "chat.completion",
@@ -332,7 +357,7 @@ class ApiServer:
 
     def _run_batched(self, prompt_tokens, temperature, topp, max_tokens,
                      stops, emit, seed=None, presence=0.0,
-                     frequency=0.0, probe=None) -> tuple[str, str, int]:
+                     frequency=0.0, probe=None, req_id: str = "") -> tuple[str, str, int]:
         """Token-level core of a batched completion: submit, stream-decode
         with EOS/stop detection, return (content, finish_reason, n_tokens).
         Shared by the chat and legacy-completions endpoints — the caller
@@ -356,6 +381,7 @@ class ApiServer:
             prompt_tokens, temperature, topp, budget, self.tokenizer.eos_ids,
             presence=presence, frequency=frequency,
             seed=int(seed) if seed is not None else None,
+            req_id=req_id,
         )
         parts: list[str] = []
         n_generated = 0
@@ -368,8 +394,8 @@ class ApiServer:
             if probe():
                 raise ClientDisconnected()
 
+        ended_on_eos = False
         try:
-            ended_on_eos = False
             for t in req.tokens(poll=probe_tick if probe is not None else None):
                 if probe is not None and time.monotonic() >= probe_at:
                     # ...and at 4 Hz while tokens ARE flowing (a select()+
@@ -395,13 +421,18 @@ class ApiServer:
                     if emit is not None:
                         emit(text)
         finally:
-            self.scheduler.cancel(req)
+            # a release after the detector saw a string stop-sequence is a
+            # SUCCESSFUL stop, not a client cancellation — label it so the
+            # finished{reason} metric matches what the client is told below
+            self.scheduler.cancel(
+                req, reason="stop" if ended_on_eos else "cancelled")
         # scheduler reasons: stop/length pass through; a cancel here means the
         # stream ended on a string stop-sequence -> "stop"
         finish = req.finish_reason if req.finish_reason in ("stop", "length") else "stop"
         return "".join(parts), finish, n_generated
 
-    def complete_legacy(self, body: dict, emit=None, probe=None) -> dict:
+    def complete_legacy(self, body: dict, emit=None, probe=None,
+                        req_id: str = "") -> dict:
         """POST /v1/completions — the pre-chat OpenAI surface some clients
         still speak: a RAW prompt string, no chat template, `text` in the
         choices. Shares the sampling params and generation machinery with
@@ -423,7 +454,7 @@ class ApiServer:
                 prompt_tokens, temperature, topp, max_tokens,
                 list(extra_stops),  # raw prompt: no chat-template stops
                 emit, seed=seed, presence=presence, frequency=frequency,
-                probe=probe)
+                probe=probe, req_id=req_id)
         else:
             with self.lock:
                 # raw-prompt rows overwrite the chat prefix cache's claim
@@ -474,28 +505,74 @@ class ApiError(Exception):
         self.message = message
 
 
+#: path -> bounded-cardinality endpoint label for the HTTP response counter
+_KNOWN_PATHS = {
+    "/v1/chat/completions": "/v1/chat/completions",
+    "/chat/completions": "/v1/chat/completions",
+    "/v1/completions": "/v1/completions",
+    "/completions": "/v1/completions",
+    "/v1/models": "/v1/models",
+    "/health": "/health",
+    "/health/live": "/health/live",
+    "/health/ready": "/health/ready",
+    "/metrics": "/metrics",
+}
+
+
+def _endpoint(path: str) -> str:
+    """Label-safe endpoint name (unknown paths collapse to 'other' so a
+    scanner can't explode the label cardinality)."""
+    return _KNOWN_PATHS.get(path, "other")
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "dllama-tpu"
     protocol_version = "HTTP/1.1"
     api: ApiServer  # set by make_handler
+    _req_id: str | None = None  # minted per POST in do_POST
 
     def log_message(self, fmt, *args):
         log.info("%s %s", self.address_string(), fmt % args)
 
     def _send_json(self, status: int, payload: dict,
                    headers: dict | None = None) -> None:
+        rid = self._req_id
+        if rid and isinstance(payload.get("error"), dict):
+            # error bodies carry the id too (429/503/500 included) so a
+            # client-side report alone is enough to find the server logs
+            payload["error"].setdefault("request_id", rid)
         data = json.dumps(payload).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        if rid:
+            self.send_header("X-Request-Id", rid)
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
+        # counted before the body write: once the client has read the
+        # response, the counter has already moved (no scrape-after-response
+        # race for tests or tight operators)
+        ins.HTTP_RESPONSES.labels(endpoint=_endpoint(self.path),
+                                  code=str(status)).inc()
         self.wfile.write(data)
 
     def do_GET(self):
+        self._req_id = None
         if self.path == "/v1/models":
             self._send_json(200, self.api.models())
+        elif self.path == "/metrics":
+            # Prometheus text exposition of the process-global registry —
+            # served from this (threaded) handler, so scrapes proceed while
+            # completions run
+            body = metrics.REGISTRY.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            ins.HTTP_RESPONSES.labels(endpoint="/metrics", code="200").inc()
         elif self.path in ("/health", "/health/live", "/health/ready"):
             # /health: full snapshot, status by liveness (a restart signal);
             # /health/live and /health/ready: the k8s-style split probes —
@@ -526,20 +603,39 @@ class _Handler(BaseHTTPRequestHandler):
         except (OSError, ValueError):
             return True
 
+    def _log_done(self, rid: str, result: dict) -> None:
+        u = result.get("usage", {})
+        log.info("completion %s done: %d prompt + %d completion tokens",
+                 rid, u.get("prompt_tokens", 0), u.get("completion_tokens", 0),
+                 extra={"request_id": rid})
+
     def do_POST(self):
+        # the request id is minted at ADMISSION — before any outcome is
+        # known — so even a request shed with 429/503 has a correlatable id
+        # in its response headers and in the shed log line below
+        rid = self._req_id = new_request_id(self.headers.get("X-Request-Id"))
         chat = self.path in ("/v1/chat/completions", "/chat/completions")
         legacy = self.path in ("/v1/completions", "/completions")
+        # the body is consumed BEFORE any early-return response: on this
+        # keep-alive (HTTP/1.1) server, unread body bytes would be parsed as
+        # the NEXT request line — a 404'd POST must not poison its connection
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(length)
+        except (ValueError, OSError):
+            self._send_json(400, {"error": {"message": "invalid request"}})
+            return
         if not (chat or legacy):
             self._send_json(404, {"error": {"message": "not found"}})
             return
         try:
-            length = int(self.headers.get("Content-Length", 0))
-            body = json.loads(self.rfile.read(length) or b"{}")
+            body = json.loads(raw or b"{}")
         except (ValueError, json.JSONDecodeError):
             self._send_json(400, {"error": {"message": "invalid JSON body"}})
             return
         try:
             if self.api.draining:
+                ins.REQUESTS_SHED.labels(reason="draining").inc()
                 raise SchedulerDraining("server is draining")
             if body.get("stream"):
                 # cheap validation BEFORE the 200/chunked headers go out — an
@@ -551,28 +647,45 @@ class _Handler(BaseHTTPRequestHandler):
                 self.api.precheck_capacity()
                 self._stream(body, legacy=legacy)
             elif legacy:
-                self._send_json(200, self.api.complete_legacy(
-                    body, probe=self._client_gone))
+                result = self.api.complete_legacy(
+                    body, probe=self._client_gone, req_id=rid)
+                result["request_id"] = rid
+                self._log_done(rid, result)  # logged before the body goes out
+                self._send_json(200, result)
             else:
-                self._send_json(200, self.api.complete(
-                    body, probe=self._client_gone))
+                result = self.api.complete(
+                    body, probe=self._client_gone, req_id=rid)
+                result["request_id"] = rid
+                self._log_done(rid, result)
+                self._send_json(200, result)
         except ApiError as e:
+            log.info("request %s rejected: %s", rid, e.message,
+                     extra={"request_id": rid})
             self._send_json(e.status, {"error": {"message": e.message}})
         except QueueFull as e:
             # load shedding: the request never entered the queue; tell the
-            # client when to come back (429 per OpenAI's own rate responses)
+            # client when to come back (429 per OpenAI's own rate responses).
+            # The would-have-been id makes shed traffic correlatable: the
+            # client got it in X-Request-Id, this line carries it too.
+            log.warning("request %s shed (queue full): %s", rid, e,
+                        extra={"request_id": rid})
             self._send_json(429, {"error": {"message": str(e)}},
                             {"Retry-After": str(int(e.retry_after_s))})
         except SchedulerRejected as e:
             # draining or unhealthy: 503 so balancers retry elsewhere
+            log.warning("request %s shed (%s): %s", rid,
+                        e.__class__.__name__, e, extra={"request_id": rid})
             self._send_json(503, {"error": {"message": str(e)}},
                             {"Retry-After": str(int(e.retry_after_s))})
         except ClientDisconnected:
-            log.info("client disconnected; request cancelled")
+            log.info("client disconnected; request %s cancelled", rid,
+                     extra={"request_id": rid})
         except CLIENT_GONE:
-            log.info("client connection lost mid-response")
+            log.info("client connection lost mid-response (request %s)", rid,
+                     extra={"request_id": rid})
         except Exception:
-            log.exception("completion failed")
+            log.exception("completion %s failed", rid,
+                          extra={"request_id": rid})
             try:
                 self._send_json(500, {"error": {"message": "internal error"}})
             except CLIENT_GONE:
@@ -581,11 +694,16 @@ class _Handler(BaseHTTPRequestHandler):
     def _stream(self, body: dict, legacy: bool = False) -> None:
         """SSE chunked streaming (dllama-api.cpp:203-223's role). `legacy`
         streams `text_completion` chunks (text field) instead of chat deltas."""
+        rid = self._req_id
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
         self.send_header("Transfer-Encoding", "chunked")
+        if rid:
+            self.send_header("X-Request-Id", rid)
         self.end_headers()
+        ins.HTTP_RESPONSES.labels(endpoint=_endpoint(self.path),
+                                  code="200").inc()
         cid = f"{'cmpl' if legacy else 'chatcmpl'}-{uuid.uuid4().hex[:16]}"
         created = int(time.time())
 
@@ -620,14 +738,15 @@ class _Handler(BaseHTTPRequestHandler):
             # (no tokens flowing yet)
             if legacy:
                 result = self.api.complete_legacy(
-                    body, emit=emit_text, probe=self._client_gone)
+                    body, emit=emit_text, probe=self._client_gone, req_id=rid)
                 emit_text("", finish=result["choices"][0]["finish_reason"])
             else:
                 emit_chat({"role": "assistant"})
                 result = self.api.complete(
                     body, emit=lambda text: emit_chat({"content": text}),
-                    probe=self._client_gone)
+                    probe=self._client_gone, req_id=rid)
                 emit_chat({}, finish=result["choices"][0]["finish_reason"])
+            self._log_done(rid or "-", result)
         except (ClientDisconnected, *CLIENT_GONE):
             raise  # nothing to tell a dead socket; do_POST just logs it
         except Exception as e:
@@ -637,13 +756,15 @@ class _Handler(BaseHTTPRequestHandler):
             # client fails fast instead of hanging on a half-open stream.
             # Client-safe exception types keep their message; anything else
             # is masked like the non-stream 500 path (no internals leak).
-            log.exception("streamed completion failed mid-stream")
+            log.exception("streamed completion %s failed mid-stream", rid,
+                          extra={"request_id": rid})
             msg = (str(e) if isinstance(e, (ApiError, SchedulerRejected))
                    else "internal error")
-            chunk(b"data: " + json.dumps(
-                {"error": {"message": msg or e.__class__.__name__,
-                           "type": "server_error"}}
-            ).encode() + b"\n\n")
+            err = {"message": msg or e.__class__.__name__,
+                   "type": "server_error"}
+            if rid:
+                err["request_id"] = rid  # SSE errors are correlatable too
+            chunk(b"data: " + json.dumps({"error": err}).encode() + b"\n\n")
         chunk(b"data: [DONE]\n\n")
         chunk(b"")  # terminating zero-length chunk
 
@@ -773,7 +894,9 @@ def run_server(loaded, host="127.0.0.1", port=9990, n_slots: int = 0, **defaults
     drain_timeout_s = float(defaults.get("drain_timeout_s") or 30.0)
     install_sigterm_drain(httpd, api, drain_timeout_s)
     mode = f"continuous batching, {n_slots} slots" if n_slots else "single-request + prefix cache"
-    log.info("serving on http://%s:%d (%s)", host, httpd.server_address[1], mode)
+    log.info("serving on http://%s:%d (%s); telemetry at /metrics, probes "
+             "at /health/live and /health/ready",
+             host, httpd.server_address[1], mode)
     print(f"🚀 http://{host}:{httpd.server_address[1]}/v1/chat/completions ({mode})")
     try:
         httpd.serve_forever()

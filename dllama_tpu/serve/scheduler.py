@@ -33,6 +33,7 @@ import jax
 import numpy as np
 
 from dllama_tpu.engine.batch import BatchEngine
+from dllama_tpu.obs import instruments as ins
 from dllama_tpu.utils import faults
 
 log = logging.getLogger("dllama_tpu.serve")
@@ -79,6 +80,14 @@ class Request:
     produced: int = 0
     slot: int = -1
     finish_reason: str | None = None
+    # serving-tier request id (api -> scheduler -> engine): the correlation
+    # key between X-Request-Id response headers, log lines, and admissions
+    req_id: str = ""
+    # what finish_reason a cancel() should record: the API tier releases a
+    # slot via cancel() BOTH for real client cancellations and for streams
+    # that ended on a string stop-sequence — the latter is a SUCCESS and must
+    # not pollute the finished{reason="cancelled"} counter
+    cancel_reason: str = "cancelled"
     cancelled: threading.Event = field(default_factory=threading.Event)
     # latency marks (time.monotonic): the serving-tier observability the
     # reference's per-token console lines provide (dllama.cpp:82-87)
@@ -173,6 +182,7 @@ class Scheduler:
         self._spec_tick = False
         self._completed: list[Request] = []  # ring of recent requests (metrics)
         self._metrics_lock = threading.Lock()
+        ins.SLOTS_TOTAL.set(engine.n_slots)
         self._wake = threading.Event()
         self._stop = threading.Event()
         # ---- supervision state (all read by health(), written by the worker
@@ -204,12 +214,15 @@ class Scheduler:
 
     def submit(self, prompt, temperature, topp, max_tokens, eos_ids,
                seed: int | None = None, presence: float = 0.0,
-               frequency: float = 0.0) -> Request:
+               frequency: float = 0.0, req_id: str = "") -> Request:
         self.check_admission()
         req = Request(list(prompt), float(temperature), float(topp), int(max_tokens),
                       frozenset(eos_ids), seed=seed, presence=float(presence),
-                      frequency=float(frequency), submitted_at=time.monotonic())
+                      frequency=float(frequency), submitted_at=time.monotonic(),
+                      req_id=req_id)
         self.pending.put(req)
+        ins.REQUESTS_ADMITTED.inc()
+        ins.QUEUE_DEPTH.set(self.pending.qsize())
         if self.crashed is not None or not self._thread.is_alive():
             # lost the race with a worker crash: _fail_all may already have
             # drained the queue, so this request could sit there forever —
@@ -226,24 +239,30 @@ class Scheduler:
         generations see no perturbation at all. Also used by the API tier
         to shed STREAM requests before their response headers go out."""
         if self.crashed is not None or not self._thread.is_alive():
+            ins.REQUESTS_SHED.labels(reason="unhealthy").inc()
             raise SchedulerUnhealthy(
                 f"scheduler worker is dead ({self.crashed!r}); refusing work")
         if self.stalled:
             # the watchdog says the worker is wedged mid-chunk: queueing more
             # work would strand more clients. The flag clears if heartbeats
             # resume, and 503+Retry-After tells callers to come back then.
+            ins.REQUESTS_SHED.labels(reason="unhealthy").inc()
             raise SchedulerUnhealthy(
                 "scheduler worker is stalled (device chunk past "
                 "--stall-deadline-s); refusing work")
         if self._draining.is_set():
+            ins.REQUESTS_SHED.labels(reason="draining").inc()
             raise SchedulerDraining("scheduler is draining; no new requests")
         if self.max_queue and self.pending.qsize() >= self.max_queue:
+            ins.REQUESTS_SHED.labels(reason="queue_full").inc()
             raise QueueFull(
                 f"admission queue full ({self.pending.qsize()} >= "
                 f"--max-queue {self.max_queue})")
         try:
             faults.fire("scheduler.queue")
         except faults.InjectedFault as e:
+            # the drill impersonates overflow, so it counts as overflow
+            ins.REQUESTS_SHED.labels(reason="queue_full").inc()
             raise QueueFull(str(e)) from e
 
     def _busy(self) -> bool:
@@ -309,7 +328,13 @@ class Scheduler:
         """Aggregate TTFT / inter-token latency over completed requests, plus
         the admission-stall record: the max/mean decode-to-decode gap that
         admission work (prefill chunks, commits) inserted between fused decode
-        chunks — what batch-mates' ITL actually degrades by during a join."""
+        chunks — what batch-mates' ITL actually degrades by during a join.
+
+        This is the host-side per-SCHEDULER convenience view; the same marks
+        feed the process-wide metrics registry (`_observe_finish`) that
+        `GET /metrics` exposes as dllama_ttft_seconds / dllama_itl_seconds /
+        dllama_e2e_latency_seconds histograms — one observation point, two
+        read paths."""
         with self._metrics_lock:
             done = list(self._completed)
             gaps = list(self._admit_gaps_ms)
@@ -336,7 +361,13 @@ class Scheduler:
             self._admit_gaps_ms.clear()
         self._t_dec_end = None
 
-    def cancel(self, req: Request) -> None:
+    def cancel(self, req: Request, reason: str = "cancelled") -> None:
+        """Release a request's slot. `reason` becomes the finish_reason when
+        the request is still live — "cancelled" for real client
+        cancellations (the default), "stop" when the API tier is releasing
+        a stream that already ended on a string stop-sequence (a success).
+        A no-op for requests that already finished."""
+        req.cancel_reason = reason
         req.cancelled.set()
         self._wake.set()
 
@@ -363,6 +394,20 @@ class Scheduler:
 
     # ------------------------------------------------------------------ loop
 
+    def _observe_finish(self, req: Request) -> None:
+        """The single registry write point for a terminal request: finish
+        counter + TTFT/ITL/e2e histograms from the request's latency marks —
+        the same marks the `_completed` ring (latency_summary's per-scheduler
+        view) records, so /metrics and the summary cannot disagree."""
+        ins.REQUESTS_FINISHED.labels(reason=req.finish_reason or "unknown").inc()
+        if req.first_token_at is not None:
+            ins.TTFT_SECONDS.observe(req.first_token_at - req.submitted_at)
+        if req.finished_at is not None:
+            ins.E2E_SECONDS.observe(req.finished_at - req.submitted_at)
+        itl = req.itl_ms
+        if itl is not None:
+            ins.ITL_SECONDS.observe(itl / 1000.0)
+
     def _finish(self, req: Request, reason: str, keep_rows: int | None = None) -> None:
         if req.slot >= 0:
             self.engine.release(req.slot, keep_rows)
@@ -379,6 +424,8 @@ class Scheduler:
         with self._metrics_lock:
             self._completed.append(req)
             del self._completed[:-256]  # bound the ring
+        self._observe_finish(req)
+        ins.BUSY_SLOTS.set(len(self.slots))
         req.out.put(_END)
 
     def _emit(self, req: Request, token: int, row_at_emit: int) -> bool:
@@ -387,6 +434,7 @@ class Scheduler:
             req.first_token_at = time.monotonic()
         req.out.put(int(token))
         req.produced += 1
+        ins.TOKENS_GENERATED.inc()
         if req.slot >= 0:
             self.slot_tokens.setdefault(req.slot, []).append(int(token))
         if token in req.eos_ids:
@@ -454,13 +502,18 @@ class Scheduler:
             except queue.Empty:
                 return
             if req.cancelled.is_set():
-                req.finish_reason = "cancelled"
+                req.finish_reason = req.cancel_reason
+                req.finished_at = time.monotonic()
+                self._observe_finish(req)
                 req.out.put(_END)
                 continue
             if len(req.prompt) >= self.engine.seq_len:
                 # reject BEFORE slot search or any donor copy: a hopeless
                 # admission must not evict a slot's cached prefix (nor pay
                 # the per-slot LCP scan)
+                req.finish_reason = "error"
+                req.finished_at = time.monotonic()
+                self._observe_finish(req)
                 req.out.put(ValueError(
                     f"prompt ({len(req.prompt)}) exceeds seq_len {self.engine.seq_len}"
                 ))
@@ -474,9 +527,14 @@ class Scheduler:
                     self.slot_tokens[slot] = list(
                         self.slot_tokens.get(donor, [])[:reuse]
                     )
-                adm = self.engine.add_begin(slot, req.prompt[reuse:], start_pos=reuse)
+                adm = self.engine.add_begin(slot, req.prompt[reuse:],
+                                            start_pos=reuse, req_id=req.req_id)
             except Exception as e:  # bad request (too long, …) — fail just this one
-                log.exception("admission rejected")
+                log.exception("admission rejected",
+                              extra={"request_id": req.req_id})
+                req.finish_reason = "error"
+                req.finished_at = time.monotonic()
+                self._observe_finish(req)
                 req.out.put(e)
                 continue
             req.slot = slot
@@ -530,11 +588,13 @@ class Scheduler:
                                                    frequency=req.frequency)
                     self._inflight.pop(0)
                     self.reused_prefix_tokens += reuse  # rows actually served
+                    ins.REUSED_PREFIX_TOKENS.inc(reuse)
                     self.slot_tokens[adm.slot] = list(req.prompt)
                     self.slots[adm.slot] = req
                     self._emit(req, first, int(self.engine.pos[adm.slot]))
             except Exception as e:
-                log.exception("prefill failed")
+                log.exception("prefill failed",
+                              extra={"request_id": req.req_id})
                 self._inflight.pop(0)
                 self._abort_admission(req, adm, e)
                 continue
@@ -573,6 +633,7 @@ class Scheduler:
         with self._metrics_lock:
             self._completed.append(req)
             del self._completed[:-256]
+        self._observe_finish(req)
         req.out.put(exc)
         req.out.put(_END)
 
@@ -608,6 +669,7 @@ class Scheduler:
                 if not self.stalled:
                     self.stalled = True
                     self.stall_count += 1
+                    ins.WATCHDOG_STALLS.inc()
                     log.error(
                         "watchdog: scheduler worker silent for %.2fs with "
                         "work in flight (deadline %.2fs) — device chunk "
@@ -615,6 +677,7 @@ class Scheduler:
                         age, self.stall_deadline_s)
             elif self.stalled and age <= self.stall_deadline_s:
                 self.stalled = False
+                ins.WATCHDOG_RECOVERIES.inc()
                 log.warning("watchdog: worker heartbeat resumed; clearing "
                             "stall flag (%d total stalls)", self.stall_count)
 
@@ -636,12 +699,18 @@ class Scheduler:
         self._t_dec_end = None
         while not self._stop.is_set():
             self._heartbeat = time.monotonic()
+            # scrape-visible view of the loop's state (set, not callbacks:
+            # a dead scheduler's last values are a tombstone, never a
+            # dangling closure keeping the engine alive)
+            ins.QUEUE_DEPTH.set(self.pending.qsize())
+            ins.BUSY_SLOTS.set(len(self.slots))
             faults.fire("scheduler.loop")
             self._admit_starts()
             admitted = self._pump_admissions()
             for slot, req in list(self.slots.items()):
                 if req.cancelled.is_set():
-                    self._finish(req, "cancelled", keep_rows=int(self.engine.pos[slot]))
+                    self._finish(req, req.cancel_reason,
+                                 keep_rows=int(self.engine.pos[slot]))
                 elif int(self.engine.pos[slot]) >= self.engine.seq_len:
                     self._finish(req, "length")
             if not self.slots:
@@ -656,6 +725,7 @@ class Scheduler:
                 with self._metrics_lock:
                     self._admit_gaps_ms.append(gap_ms)
                     del self._admit_gaps_ms[:-256]
+                ins.ADMISSION_STALL_SECONDS.observe(gap_ms / 1000.0)
             start_rows = {s: int(self.engine.pos[s]) for s in self.slots}
             # speculative cycle when some slot can profit: greedy (sampled
             # slots never accept drafts), K+1 rows of cache room, and no

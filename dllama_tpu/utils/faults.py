@@ -43,6 +43,8 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from dllama_tpu.obs import instruments as ins
+
 log = logging.getLogger("dllama_tpu.faults")
 
 ENV_VAR = "DLLAMA_FAULTS"
@@ -182,9 +184,14 @@ def fire(point: str) -> None:
     action = f.visit()
     if action is None:
         return
+    # every activation is a countable incident: drills and live mishaps
+    # alike show up at /metrics (dllama_fault_fires_total{point,action})
+    ins.FAULT_FIRES.labels(point=point, action=action).inc()
     if action == "delay":
-        log.warning("injected delay at %r: %.0f ms", point, f.ms)
+        log.warning("injected delay at %r: %.0f ms", point, f.ms,
+                    extra={"fault_point": point})
         time.sleep(f.ms / 1000.0)
     else:
-        log.warning("injected fault at %r", point)
+        log.warning("injected fault at %r", point,
+                    extra={"fault_point": point})
         raise InjectedFault(point)

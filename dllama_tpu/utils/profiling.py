@@ -25,6 +25,8 @@ from dataclasses import dataclass, field
 import jax
 import numpy as np
 
+from dllama_tpu.obs import instruments as ins
+
 
 @contextlib.contextmanager
 def trace(log_dir: str | None):
@@ -38,7 +40,11 @@ def trace(log_dir: str | None):
 
 @dataclass
 class TokenTimer:
-    """Per-token wall-clock recorder (dllama.cpp:82-104 report shape)."""
+    """Per-token wall-clock recorder (dllama.cpp:82-104 report shape).
+
+    Every stop() also observes the sample into the metrics registry
+    (dllama_token_latency_seconds), so the console report and a /metrics
+    scrape read the same record — one source of truth."""
 
     ms: list[float] = field(default_factory=list)
     _t0: float = 0.0
@@ -49,6 +55,7 @@ class TokenTimer:
     def stop(self) -> float:
         dt = (time.perf_counter() - self._t0) * 1000.0
         self.ms.append(dt)
+        ins.TOKEN_LATENCY_SECONDS.observe(dt / 1000.0)
         return dt
 
     @contextlib.contextmanager
@@ -61,10 +68,15 @@ class TokenTimer:
         if not self.ms:
             return "no tokens timed"
         a = np.asarray(self.ms)
+        # throughput over TOTAL time, not 1000/mean: the reciprocal-of-mean
+        # form overweights fast tokens (harmonic vs arithmetic) and lies
+        # whenever latency varies; guard the degenerate all-zero-clock case
+        total_s = float(a.sum()) / 1000.0
+        tok_s = len(a) / total_s if total_s > 0 else 0.0
         return (
             f"{len(a)} tokens: avg {a.mean():.2f} ms/token "
             f"(p50 {np.percentile(a, 50):.2f}, p90 {np.percentile(a, 90):.2f}, "
-            f"max {a.max():.2f}), {1000.0 / a.mean():.1f} tok/s"
+            f"max {a.max():.2f}), {tok_s:.1f} tok/s"
         )
 
 
@@ -164,10 +176,26 @@ def params_nbytes(params) -> int:
     )
 
 
+def cache_nbytes(cache) -> int:
+    return (cache.k.size * cache.k.dtype.itemsize
+            + cache.v.size * cache.v.dtype.itemsize)
+
+
+def set_memory_gauges(params, cache) -> tuple[int, int]:
+    """Publish the HBM accounting as startup gauges (model_params_bytes /
+    kv_cache_bytes) so it is queryable at /metrics and in the /health ready
+    payload, not just a one-shot --report print. Returns (params_bytes,
+    cache_bytes) for callers that also embed the numbers in a payload."""
+    pb, cb = params_nbytes(params), cache_nbytes(cache)
+    ins.MODEL_PARAMS_BYTES.set(pb)
+    ins.KV_CACHE_BYTES.set(cb)
+    return pb, cb
+
+
 def memory_report(cfg, params, cache) -> str:
     """HBM accounting (nn-core.cpp:152-166 role)."""
     pb = params_nbytes(params)
-    cb = cache.k.size * cache.k.dtype.itemsize + cache.v.size * cache.v.dtype.itemsize
+    cb = cache_nbytes(cache)
     return (
         f"💿 params {pb / 1e9:.2f} GB, kv-cache {cb / 1e9:.2f} GB "
         f"(seq {cache.seq_len}, batch {cache.k.shape[1]}), total {(pb + cb) / 1e9:.2f} GB"

@@ -1,0 +1,113 @@
+#!/usr/bin/env bash
+# metrics_smoke.sh — end-to-end telemetry smoke target.
+#
+# Boots `python -m dllama_tpu serve` (the real CLI, not an in-process
+# server) on a freshly generated tiny fixture model, waits for
+# /health/ready, scrapes /metrics, runs ONE chat completion, scrapes
+# again, and asserts dllama_tokens_generated_total advanced by exactly the
+# completion's token count — proving the registry, the exposition endpoint,
+# and the scheduler instrumentation agree end to end. Also checks the
+# X-Request-Id response header and finishes with a SIGTERM drain.
+#
+# This is a SMOKE TARGET, not a pytest test: it is exempt from the tier-1
+# `-m 'not slow'` pytest run (it lives outside tests/) and is meant for CI
+# smoke stages or manual runs:
+#
+#     scripts/metrics_smoke.sh
+#
+# CPU-only, no model download, ~1 min (XLA compile dominates). Exit 0 = PASS.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import http.client
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.getcwd())
+from tests.test_serve import make_tiny_files  # the tier-1 fixture model
+
+tmp = tempfile.mkdtemp(prefix="dllama_smoke_")
+mpath, tpath, _cfg = make_tiny_files(__import__("pathlib").Path(tmp))
+
+with socket.socket() as s:  # pick a free port
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+
+proc = subprocess.Popen(
+    [sys.executable, "-m", "dllama_tpu", "serve", "--model", mpath,
+     "--tokenizer", tpath, "--slots", "2", "--port", str(port),
+     "--log-format", "json"],
+    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+)
+
+
+def get(path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read().decode()
+    conn.close()
+    return r.status, body
+
+
+def counter(text, name):
+    m = re.search(rf"^{name} ([0-9.e+-]+)$", text, re.M)
+    return float(m.group(1)) if m else 0.0
+
+
+try:
+    deadline = time.time() + 120  # first-boot XLA compiles on CPU are slow
+    while True:
+        try:
+            if get("/health/ready")[0] == 200:
+                break
+        except OSError:
+            pass
+        if proc.poll() is not None:
+            sys.exit("FAIL: server exited before becoming ready")
+        if time.time() > deadline:
+            sys.exit("FAIL: server never became ready")
+        time.sleep(0.25)
+
+    st, before_text = get("/metrics")
+    assert st == 200, f"/metrics -> {st}"
+    before = counter(before_text, "dllama_tokens_generated_total")
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", "/v1/chat/completions",
+                 json.dumps({"messages": [{"role": "user", "content": "hi"}],
+                             "max_tokens": 8, "temperature": 0.0}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    rid = resp.getheader("X-Request-Id")
+    conn.close()
+    assert resp.status == 200, f"completion -> {resp.status}"
+    assert rid and body.get("request_id") == rid, "X-Request-Id missing/mismatched"
+    done = body["usage"]["completion_tokens"]
+    assert done > 0
+
+    st, after_text = get("/metrics")
+    assert st == 200
+    after = counter(after_text, "dllama_tokens_generated_total")
+    # >= (not ==): the scheduler counts tokens at emit time, so a completion
+    # that ends on a stop string can emit a few past what the client consumed
+    assert after >= before + done, (
+        f"token counter did not advance correctly: {before} -> {after}, "
+        f"completion produced {done}")
+    print(f"PASS: dllama_tokens_generated_total {before:.0f} -> {after:.0f} "
+          f"(+{done} tokens), request {rid}")
+finally:
+    proc.send_signal(signal.SIGTERM)  # exercises the graceful drain path
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+PY

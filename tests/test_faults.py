@@ -215,6 +215,9 @@ def test_watchdog_flags_stall_and_recovers():
     """A decode chunk delayed past the stall deadline flips health to
     unhealthy (stalled=True); when the chunk finally lands, the watchdog
     clears the flag and the request still completes."""
+    from dllama_tpu.obs import metrics
+
+    stalls0 = (metrics.REGISTRY.sample("dllama_watchdog_stalls_total") or 0.0)
     sched = make_sched(n_slots=1, stall_deadline_s=0.15)
     try:
         # warm up: first chunk compiles; only then arm the delay so compile
@@ -255,6 +258,12 @@ def test_watchdog_flags_stall_and_recovers():
         assert h["stalled"] is False and h["live"] is True
         # >= 1: the un-armed warm-up compile may legitimately trip it too
         assert h["stall_count"] >= 1
+        # the stall/recover transitions are exported too: every trip counted,
+        # and this test saw at least one full stall -> recover cycle
+        stalls = metrics.REGISTRY.sample("dllama_watchdog_stalls_total")
+        recoveries = metrics.REGISTRY.sample("dllama_watchdog_recoveries_total")
+        assert stalls >= stalls0 + h["stall_count"]
+        assert recoveries is not None and recoveries >= 1
     finally:
         faults.clear()
         sched.shutdown()

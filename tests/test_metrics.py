@@ -1,0 +1,453 @@
+"""Telemetry-core tests: registry semantics, Prometheus text-format grammar
+(HELP/TYPE lines, label escaping, histogram _bucket/_sum/_count invariants),
+request-id propagation into headers/bodies/logs, and counters moving across
+real request lifecycles — admit -> stream -> finish, shed (queue-full via
+DLLAMA_FAULTS, draining), and the fault-crash path. All CPU-only against the
+tiny fixture model; the HTTP server is module-scoped (load_model dominates)
+and the crash drill runs LAST in this file because it kills its worker
+(tier-1 runs files in order: -p no:randomly)."""
+
+import http.client
+import json
+import logging
+import re
+import threading
+import time
+
+import pytest
+
+from dllama_tpu.obs import metrics, new_request_id
+from dllama_tpu.obs import instruments as ins
+from dllama_tpu.utils import faults
+
+REG = metrics.REGISTRY
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def val(name, labels=None) -> float:
+    """Current value of a series, 0.0 when never touched (delta baselines)."""
+    v = REG.sample(name, labels)
+    if v is None:
+        return 0.0
+    return v["count"] if isinstance(v, dict) else v
+
+
+# ------------------------------------------------------- exposition grammar
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABELS = r'\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\\\|\\"|\\n)*",?)*\}'
+_VALUE = r"(?:-?\d+(?:\.\d+)?(?:e[+-]?\d+)?|\+Inf|-Inf|NaN)"
+SAMPLE_RE = re.compile(rf"^({_NAME})({_LABELS})? ({_VALUE})$")
+
+
+def parse_exposition(text: str):
+    """Line-by-line grammar check. Returns (families: name->kind,
+    samples: (name, labelstr)->value). Any line fitting neither the comment
+    nor the sample grammar is an AssertionError — the scraper's contract."""
+    assert text.endswith("\n")
+    families, samples = {}, {}
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            assert re.match(rf"^# HELP {_NAME} \S.*$", line), line
+        elif line.startswith("# TYPE "):
+            m = re.match(rf"^# TYPE ({_NAME}) (counter|gauge|histogram)$", line)
+            assert m, line
+            families[m.group(1)] = m.group(2)
+        else:
+            m = SAMPLE_RE.match(line)
+            assert m, f"bad sample line: {line!r}"
+            v = m.group(3)
+            samples[(m.group(1), m.group(2) or "")] = float(
+                v.replace("Inf", "inf"))
+    return families, samples
+
+
+def check_histogram(samples: dict, name: str) -> None:
+    """The _bucket/_sum/_count invariants for every label set of `name`:
+    cumulative non-decreasing buckets, an le="+Inf" bucket equal to _count,
+    and a _sum sample present."""
+    by_labels: dict[str, list[tuple[float, float]]] = {}
+    for (n, lbl), v in samples.items():
+        if n != name + "_bucket":
+            continue
+        m = re.search(r'le="([^"]+)"', lbl)
+        assert m, lbl
+        base = re.sub(r',?le="[^"]+"', "", lbl).replace("{}", "")
+        by_labels.setdefault(base, []).append(
+            (float(m.group(1).replace("Inf", "inf")), v))
+    assert by_labels, f"no buckets rendered for {name}"
+    for base, buckets in by_labels.items():
+        buckets.sort()
+        counts = [c for _, c in buckets]
+        assert counts == sorted(counts), f"{name}{base}: non-monotone buckets"
+        assert buckets[-1][0] == float("inf"), f"{name}{base}: no +Inf bucket"
+        count = samples[(name + "_count", base)]
+        assert buckets[-1][1] == count, f"{name}{base}: +Inf != _count"
+        assert (name + "_sum", base) in samples
+
+
+# ----------------------------------------------------------- registry unit
+
+
+def test_counter_gauge_basics():
+    reg = metrics.Registry()
+    c = reg.counter("t_requests_total", "help", ("reason",))
+    c.labels(reason="a").inc()
+    c.labels(reason="a").inc(2)
+    c.labels(reason="b").inc()
+    assert reg.sample("t_requests_total", {"reason": "a"}) == 3
+    assert reg.sample("t_requests_total", {"reason": "b"}) == 1
+    with pytest.raises(ValueError):
+        c.labels(reason="a").inc(-1)  # counters only go up
+    g = reg.gauge("t_depth", "help")
+    g.set(7)
+    g.inc()
+    g.dec(3)
+    assert reg.sample("t_depth") == 5
+    # idempotent re-registration returns the same family; kind conflicts fail
+    assert reg.counter("t_requests_total", "help", ("reason",)) is c
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("t_requests_total", "help", ("reason",))
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("t_requests_total", "help", ("other",))
+
+
+def test_histogram_buckets_and_render_invariants():
+    reg = metrics.Registry()
+    h = reg.histogram("t_lat_seconds", "help", ("op",), buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.01, 0.05, 0.5, 5.0):  # 0.01 lands IN the 0.01 bucket
+        h.labels(op="x").observe(v)
+    families, samples = parse_exposition(reg.render())
+    assert families["t_lat_seconds"] == "histogram"
+    assert samples[("t_lat_seconds_bucket", '{op="x",le="0.01"}')] == 2
+    assert samples[("t_lat_seconds_bucket", '{op="x",le="0.1"}')] == 3
+    assert samples[("t_lat_seconds_bucket", '{op="x",le="1"}')] == 4
+    assert samples[("t_lat_seconds_bucket", '{op="x",le="+Inf"}')] == 5
+    assert samples[("t_lat_seconds_count", '{op="x"}')] == 5
+    assert samples[("t_lat_seconds_sum", '{op="x"}')] == pytest.approx(5.565)
+    check_histogram(samples, "t_lat_seconds")
+
+
+def test_label_escaping():
+    reg = metrics.Registry()
+    c = reg.counter("t_esc_total", "multi\nline \\ help", ("what",))
+    c.labels(what='we"ird\\val\nue').inc()
+    text = reg.render()
+    assert '# HELP t_esc_total multi\\nline \\\\ help' in text
+    assert 't_esc_total{what="we\\"ird\\\\val\\nue"} 1' in text
+    parse_exposition(text)  # escaped line still fits the sample grammar
+
+
+def test_request_id_minting():
+    a, b = new_request_id(), new_request_id()
+    assert a.startswith("req_") and b.startswith("req_") and a != b
+    # well-formed client ids are adopted verbatim; junk is replaced
+    assert new_request_id("trace-41.a_b") == "trace-41.a_b"
+    assert new_request_id("bad id\n!").startswith("req_")
+    assert new_request_id("x" * 200).startswith("req_")
+
+
+def test_token_timer_throughput_is_total_time_based():
+    from dllama_tpu.utils.profiling import TokenTimer
+
+    t = TokenTimer()
+    t.ms.extend([100.0, 300.0])  # mean 200ms -> old (wrong) formula said 5.0
+    # ... which coincides here; make the asymmetry explicit instead:
+    t.ms.append(200.0)  # total 600ms over 3 tokens -> 5.0 tok/s
+    assert "5.0 tok/s" in t.summary() and "3 tokens" in t.summary()
+    one = TokenTimer()
+    one.ms.append(250.0)  # guard: a single token must not crash percentiles
+    assert "1 tokens" in one.summary() and "4.0 tok/s" in one.summary()
+    assert TokenTimer().summary() == "no tokens timed"
+    zero = TokenTimer()
+    zero.ms.extend([0.0, 0.0])  # degenerate clock: no division by zero
+    assert "0.0 tok/s" in zero.summary()
+    # stop() folds the sample onto the registry (one source of truth)
+    before = val("dllama_token_latency_seconds")
+    rec = TokenTimer()
+    rec.start()
+    rec.stop()
+    assert val("dllama_token_latency_seconds") == before + 1
+
+
+def test_json_and_text_log_formatters():
+    from dllama_tpu.utils.logs import JsonFormatter, TextFormatter
+
+    rec = logging.LogRecord("dllama_tpu.serve", logging.INFO, __file__, 1,
+                            "hello %s", ("world",), None)
+    rec.request_id = "req_abc"
+    out = json.loads(JsonFormatter().format(rec))
+    assert out["msg"] == "hello world" and out["request_id"] == "req_abc"
+    assert out["level"] == "INFO" and out["logger"] == "dllama_tpu.serve"
+    assert re.match(r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{3}Z$", out["ts"])
+    assert "request_id=req_abc" in TextFormatter("%(message)s").format(rec)
+
+
+# ------------------------------------------------------- HTTP end-to-end
+
+
+@pytest.fixture(scope="module")
+def mserver(tmp_path_factory):
+    """Continuous-batching server for telemetry drills (module-scoped:
+    load_model dominates). Warm-up completion compiles every step shape so
+    the timed tests below measure telemetry, not XLA."""
+    from dllama_tpu.engine.loader import load_model
+    from dllama_tpu.serve.api import make_server
+    from tests.test_serve import make_tiny_files, post
+
+    tmp_path = tmp_path_factory.mktemp("mserve")
+    mpath, tpath, _cfg = make_tiny_files(tmp_path)
+    loaded = load_model(mpath, tpath, mesh=None)
+    httpd, api = make_server(loaded, host="127.0.0.1", port=0, n_slots=2,
+                             max_queue=4)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    st, _ = post(httpd.server_address[1], "/v1/chat/completions",
+                 {"messages": [{"role": "user", "content": "hi"}],
+                  "max_tokens": 6, "temperature": 0.0})
+    assert st == 200
+    yield httpd.server_address[1], api, httpd
+    api.scheduler.shutdown()
+    httpd.shutdown()
+
+
+def _get_raw(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    data = resp.read()
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, data, headers
+
+
+def _post_raw(port, path, body, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    conn.request("POST", path, json.dumps(body), h)
+    resp = conn.getresponse()
+    data = resp.read()
+    rheaders = dict(resp.getheaders())
+    conn.close()
+    return resp.status, data, rheaders
+
+
+def test_metrics_endpoint_serves_valid_exposition(mserver):
+    port, _api, _ = mserver
+    st, data, headers = _get_raw(port, "/metrics")
+    assert st == 200
+    assert headers["Content-Type"].startswith("text/plain; version=0.0.4")
+    families, samples = parse_exposition(data.decode())
+    for name, kind in [
+        ("dllama_requests_admitted_total", "counter"),
+        ("dllama_requests_finished_total", "counter"),
+        ("dllama_tokens_generated_total", "counter"),
+        ("dllama_queue_depth", "gauge"),
+        ("dllama_busy_slots", "gauge"),
+        ("dllama_slots_total", "gauge"),
+        ("dllama_model_params_bytes", "gauge"),
+        ("dllama_kv_cache_bytes", "gauge"),
+        ("dllama_ttft_seconds", "histogram"),
+        ("dllama_itl_seconds", "histogram"),
+        ("dllama_decode_chunk_seconds", "histogram"),
+        ("dllama_prefill_chunk_seconds", "histogram"),
+    ]:
+        assert families.get(name) == kind, f"{name} missing or mistyped"
+    # the warm-up completion already ran: histograms carry real samples
+    for h in ("dllama_ttft_seconds", "dllama_decode_chunk_seconds",
+              "dllama_prefill_chunk_seconds", "dllama_e2e_latency_seconds",
+              "dllama_batch_occupancy"):
+        check_histogram(samples, h)
+    assert samples[("dllama_slots_total", "")] == 2
+
+
+def test_request_lifecycle_moves_counters(mserver):
+    from tests.test_serve import post
+
+    port, _api, _ = mserver
+    before = {
+        "admitted": val("dllama_requests_admitted_total"),
+        "stop": val("dllama_requests_finished_total", {"reason": "stop"}),
+        "length": val("dllama_requests_finished_total", {"reason": "length"}),
+        "tokens": val("dllama_tokens_generated_total"),
+        "ttft": val("dllama_ttft_seconds"),
+        "e2e": val("dllama_e2e_latency_seconds"),
+        "http": val("dllama_http_responses_total",
+                    {"endpoint": "/v1/chat/completions", "code": "200"}),
+    }
+    st, data = post(port, "/v1/chat/completions",
+                    {"messages": [{"role": "user", "content": "count me"}],
+                     "max_tokens": 8, "temperature": 0.0})
+    assert st == 200
+    done = json.loads(data)["usage"]["completion_tokens"]
+    assert val("dllama_requests_admitted_total") == before["admitted"] + 1
+    finished = (val("dllama_requests_finished_total", {"reason": "stop"})
+                + val("dllama_requests_finished_total", {"reason": "length"}))
+    assert finished == before["stop"] + before["length"] + 1
+    assert val("dllama_tokens_generated_total") >= before["tokens"] + done
+    assert val("dllama_ttft_seconds") == before["ttft"] + 1
+    assert val("dllama_e2e_latency_seconds") == before["e2e"] + 1
+    assert val("dllama_http_responses_total",
+               {"endpoint": "/v1/chat/completions", "code": "200"}) == before["http"] + 1
+
+
+def test_queue_full_shed_counts_and_correlates(mserver, monkeypatch, caplog):
+    """The DLLAMA_FAULTS-armed shed path: 429 carries the would-have-been
+    X-Request-Id, the shed counter moves by reason, and the shed log line
+    carries the same id (structured field + message text)."""
+    port, _api, _ = mserver
+    monkeypatch.setenv(faults.ENV_VAR, "scheduler.queue:raise:times=1")
+    faults.configure_from_env()
+    before = val("dllama_requests_shed_total", {"reason": "queue_full"})
+    before_fires = val("dllama_fault_fires_total",
+                       {"point": "scheduler.queue", "action": "raise"})
+    with caplog.at_level(logging.WARNING, logger="dllama_tpu.serve"):
+        st, data, headers = _post_raw(
+            port, "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "x"}], "max_tokens": 4})
+    assert st == 429
+    rid = headers.get("X-Request-Id")
+    assert rid and rid.startswith("req_")
+    assert json.loads(data)["error"]["request_id"] == rid
+    assert val("dllama_requests_shed_total", {"reason": "queue_full"}) == before + 1
+    assert val("dllama_fault_fires_total",
+               {"point": "scheduler.queue", "action": "raise"}) == before_fires + 1
+    shed_logs = [r for r in caplog.records
+                 if getattr(r, "request_id", None) == rid]
+    assert shed_logs and "shed" in shed_logs[0].getMessage()
+
+
+def test_draining_shed_counts_by_reason(mserver):
+    port, api, _ = mserver
+    before = val("dllama_requests_shed_total", {"reason": "draining"})
+    api.draining = True
+    try:
+        st, data, headers = _post_raw(
+            port, "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "x"}], "max_tokens": 2})
+    finally:
+        api.draining = False
+    assert st == 503
+    assert headers.get("X-Request-Id", "").startswith("req_")
+    assert val("dllama_requests_shed_total", {"reason": "draining"}) == before + 1
+
+
+def test_request_id_propagation_and_logs(mserver, caplog):
+    from tests.test_serve import post
+
+    port, _api, _ = mserver
+    # server-minted id: header + response JSON + completion log line agree
+    with caplog.at_level(logging.INFO, logger="dllama_tpu.serve"):
+        st, data, headers = _post_raw(
+            port, "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "hi"}],
+             "max_tokens": 4, "temperature": 0.0})
+    assert st == 200
+    rid = headers["X-Request-Id"]
+    assert rid.startswith("req_")
+    assert json.loads(data)["request_id"] == rid
+    assert any(getattr(r, "request_id", None) == rid for r in caplog.records)
+    # client-supplied well-formed id is adopted verbatim
+    st2, data2, headers2 = _post_raw(
+        port, "/v1/chat/completions",
+        {"messages": [{"role": "user", "content": "hi"}], "max_tokens": 2},
+        headers={"X-Request-Id": "trace-77.abc"})
+    assert st2 == 200 and headers2["X-Request-Id"] == "trace-77.abc"
+    assert json.loads(data2)["request_id"] == "trace-77.abc"
+    # 400s carry an id too
+    st3, data3, headers3 = _post_raw(port, "/v1/chat/completions",
+                                     {"messages": []})
+    assert st3 == 400 and headers3.get("X-Request-Id", "").startswith("req_")
+    assert json.loads(data3)["error"]["request_id"] == headers3["X-Request-Id"]
+
+
+def test_stream_carries_request_id(mserver):
+    port, _api, _ = mserver
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", "/v1/chat/completions",
+                 json.dumps({"messages": [{"role": "user", "content": "hi"}],
+                             "max_tokens": 4, "temperature": 0.0,
+                             "stream": True}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    raw = resp.read().decode()
+    rid = resp.getheader("X-Request-Id")
+    conn.close()
+    assert resp.status == 200 and rid and rid.startswith("req_")
+    assert "data: [DONE]" in raw
+
+
+def test_health_and_metrics_expose_memory_gauges(mserver):
+    port, api, _ = mserver
+    st, data, _ = _get_raw(port, "/health")
+    body = json.loads(data)
+    assert body["model_params_bytes"] > 0
+    assert body["kv_cache_bytes"] > 0
+    assert val("dllama_model_params_bytes") == body["model_params_bytes"]
+    assert val("dllama_kv_cache_bytes") == body["kv_cache_bytes"]
+    assert body["model_params_bytes"] == api.model_params_bytes
+
+
+def test_metrics_scrape_concurrent_with_generation(mserver):
+    """/metrics must answer (and parse) while a completion is decoding —
+    the scrape path shares no lock with the worker."""
+    from tests.test_serve import post
+
+    port, api, _ = mserver
+    faults.install("engine.decode", "delay", ms=30.0)
+    results = {}
+
+    def run():
+        results["resp"] = post(
+            port, "/v1/chat/completions",
+            {"messages": [{"role": "user", "content": "busy"}],
+             "max_tokens": 24, "temperature": 0.0})
+
+    t = threading.Thread(target=run)
+    t.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while not api.scheduler._busy() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert api.scheduler._busy(), "completion never started"
+        for _ in range(3):  # repeated scrapes while tokens are flowing
+            st, data, _ = _get_raw(port, "/metrics")
+            assert st == 200
+            parse_exposition(data.decode())
+    finally:
+        faults.clear()
+        t.join(timeout=60)
+    assert results["resp"][0] == 200
+
+
+def test_crash_path_marks_error_and_counts_fault_fires(mserver):
+    """Worker-crash telemetry: finished{reason=error} and
+    fault_fires{engine.decode} advance, and /metrics still answers on a dead
+    scheduler. Runs LAST against this server (the crash is terminal)."""
+    port, api, _ = mserver
+    before_err = val("dllama_requests_finished_total", {"reason": "error"})
+    before_fires = val("dllama_fault_fires_total",
+                       {"point": "engine.decode", "action": "raise"})
+    faults.install("engine.decode", "raise")
+    st, data, headers = _post_raw(
+        port, "/v1/chat/completions",
+        {"messages": [{"role": "user", "content": "boom"}], "max_tokens": 8})
+    faults.clear()
+    assert st == 500
+    assert headers.get("X-Request-Id", "").startswith("req_")
+    assert json.loads(data)["error"]["request_id"] == headers["X-Request-Id"]
+    assert val("dllama_requests_finished_total",
+               {"reason": "error"}) >= before_err + 1
+    assert val("dllama_fault_fires_total",
+               {"point": "engine.decode", "action": "raise"}) == before_fires + 1
+    st_h, data_h, _ = _get_raw(port, "/health")
+    assert st_h == 503
+    st_m, data_m, _ = _get_raw(port, "/metrics")  # scrapes outlive the worker
+    assert st_m == 200
+    parse_exposition(data_m.decode())
