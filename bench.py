@@ -35,6 +35,8 @@ Env knobs:
   BENCH_CACHE          bf16 (default) | f8 — KV cache element type; f8
                        halves cache bytes (the batched-sweep bottleneck)
   BENCH_FORCE_CPU      '1': skip the TPU entirely (CI smoke)
+  BENCH_OVERLAP        '0': skip the serving-tier overlap-pipeline A/B
+                       (inter-chunk host gap + agg tok/s, on vs off)
 """
 
 import json
@@ -633,6 +635,61 @@ def bench_admission(cfg, params, n_slots=8, prompt_len=512, chunk=4, pf_chunk=64
     return out
 
 
+def bench_overlap(cfg, params, n_slots=8, chunk=4, steps=48, pf_chunk=64):
+    """Overlap A/B for the serving tier: aggregate decode tok/s and the
+    inter-chunk host gap with the scheduler's overlapped dispatch on vs off
+    (same engine config, prompts, and seeds — token streams are identical by
+    construction, so the delta is pure pipeline efficiency). The host gap is
+    the device-idle window the scheduler's Python work (emit loops, EOS
+    checks, metrics) inserts between fused chunks; overlap hides it behind
+    the in-flight chunk's device compute."""
+    import numpy as np
+
+    from dllama_tpu.engine.batch import BatchEngine
+    from dllama_tpu.serve.scheduler import Scheduler
+
+    mk = lambda base: [int(x) for x in
+                       ((np.arange(3) * 11 + base) % (cfg.vocab_size - 2) + 1)]
+    out = {"slots": n_slots, "chunk": chunk, "steps": steps}
+    for key, ov in (("overlap_on", True), ("overlap_off", False)):
+        sched = None
+        try:
+            eng = BatchEngine(cfg, params, n_slots=n_slots, cache_dtype=_cache_dtype(),
+                              max_prefill_chunk=pf_chunk,
+                              attn_impl=os.environ.get("BENCH_ATTN", "auto"))
+            sched = Scheduler(eng, chunk=chunk, overlap=ov)
+            warm = sched.submit(mk(701), 0.0, 0.9, 2 * chunk, frozenset(), seed=7)
+            list(warm.tokens())
+            sched.reset_latency_stats()  # compile gaps are not host gaps
+            t0 = time.perf_counter()
+            reqs = [sched.submit(mk(1201 + 97 * s), 0.8, 0.9, steps, frozenset(),
+                                 seed=s) for s in range(n_slots)]
+            total = sum(len(list(r.tokens())) for r in reqs)
+            dt = time.perf_counter() - t0
+            s = sched.latency_summary()
+            out[key] = {
+                "agg_tok_s": round(total / dt, 1),
+                "host_gap_ms_mean": round(s["decode_host_gap_ms_mean"], 3)
+                if s["decode_host_gap_ms_mean"] is not None else None,
+                "host_gap_ms_max": round(s["decode_host_gap_ms_max"], 3)
+                if s["decode_host_gap_ms_max"] is not None else None,
+            }
+        except Exception as e:
+            out[key] = {"error": repr(e)[:160]}
+        finally:
+            if sched is not None:
+                sched.shutdown()
+    on, off = out.get("overlap_on", {}), out.get("overlap_off", {})
+    if on.get("host_gap_ms_mean") is not None and off.get("host_gap_ms_mean"):
+        # floor at timer noise: a ~0 overlapped gap should read as a large
+        # finite reduction, not divide-by-zero
+        out["host_gap_reduction_x"] = round(
+            off["host_gap_ms_mean"] / max(on["host_gap_ms_mean"], 0.001), 1)
+    if on.get("agg_tok_s") and off.get("agg_tok_s"):
+        out["tok_s_ratio_on_off"] = round(on["agg_tok_s"] / off["agg_tok_s"], 3)
+    return out
+
+
 def worker():
     # persistent compile cache: repeated bench runs (and the tpu_session
     # stages) reuse executables instead of paying tunnel compiles again
@@ -982,6 +1039,20 @@ def worker():
         except Exception as e:
             admit = {"error": repr(e)[:200]}
 
+    # overlap-pipeline A/B on the same preset: inter-chunk host gap and
+    # aggregate tok/s with overlapped dispatch on vs off (BENCH_OVERLAP=0
+    # skips)
+    overlap_ab = None
+    if (sweep_on and admit_params is not None
+            and os.environ.get("BENCH_OVERLAP") != "0"
+            and time.monotonic() < deadline - 180):
+        try:
+            overlap_ab = bench_overlap(
+                LlamaConfig(**PRESETS[sweep_on]), admit_params,
+                n_slots=min(8, min(s for s in slot_list) if slot_list else 8))
+        except Exception as e:
+            overlap_ab = {"error": repr(e)[:200]}
+
     # bytes/token describes the headline (sweep) config when one ran
     cfg8 = LlamaConfig(**PRESETS[sweep_on or run_presets[-1]])
     n_dev = jax.device_count()
@@ -1020,6 +1091,7 @@ def worker():
         "xla_prefill_m": int(xla_prefill_m) if xla_prefill_m else None,
         "moe": moe,
         "admission": admit,
+        "overlap": overlap_ab,
         "kb_per_token_per_chip": kb_measured if kb_measured is not None else round(kb, 1),
         "kb_per_token_source": "measured_hlo" if kb_measured is not None else "analytic",
     }

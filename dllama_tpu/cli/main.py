@@ -69,6 +69,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host", default="127.0.0.1", help="HTTP bind address (serve mode)")
     p.add_argument("--slots", type=int, default=0,
                    help="serve mode: continuous-batching slots (0 = single-request + prefix cache)")
+    p.add_argument("--overlap", choices=["on", "off"], default="on",
+                   help="serve mode, needs --slots > 0: overlapped decode "
+                        "pipeline — dispatch chunk N+1 off device-resident "
+                        "state before chunk N's tokens are consumed, so host "
+                        "scheduling runs concurrently with device compute "
+                        "(token-level stops lag at most one chunk; overrun "
+                        "tokens are discarded). 'off' restores the lockstep "
+                        "loop for A/B — token streams are identical")
     p.add_argument("--admit-budget-ms", type=float, default=None,
                    help="serve mode, needs --slots > 0: max decode stall (ms) a "
                         "joining prompt's prefill may insert per visit (default "
@@ -336,6 +344,7 @@ def cmd_serve(args) -> int:
         max_queue=args.max_queue,
         stall_deadline_s=args.stall_deadline_s,
         drain_timeout_s=args.drain_timeout_s,
+        overlap=args.overlap == "on",
     )
 
 

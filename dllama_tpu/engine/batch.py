@@ -13,6 +13,13 @@ the scheduling core of continuous batching. Mechanics:
 * sampling params are per-slot vectors (sampling.sample_logits broadcasts),
   and each slot carries its OWN PRNG key — a request's sampled continuation is
   reproducible from its seed regardless of what shares the batch.
+* decode state is DEVICE-RESIDENT: the per-slot vectors above live as JAX
+  arrays threaded chunk-to-chunk (numpy mirrors refresh at admission/commit/
+  release boundaries), so steady-state decode pays zero host->device
+  transfers, and decode_dispatch/decode_consume split a chunk into an async
+  dispatch and a blocking fetch — the serving scheduler overlaps its Python
+  work (emit loops, EOS checks, admission scans) with the in-flight chunk's
+  device compute instead of idling the device between chunks.
 """
 
 from __future__ import annotations
@@ -55,6 +62,26 @@ class Admission:
     off: int = 0
     logits: jax.Array | None = None  # [1, V] slot row from the LAST chunk
     req_id: str = ""  # serving-tier request id, for engine-level log/trace lines
+
+
+@dataclass
+class DecodeChunk:
+    """A dispatched-but-unconsumed fused decode chunk (decode_dispatch).
+
+    `toks` is the device-side [n, B] token array — JAX dispatch is async, so
+    it materializes while the caller does host work; decode_consume blocks on
+    it. The numpy fields are HOST snapshots taken at dispatch time: the
+    scheduler attributes each slot's tokens against the positions/activity
+    the chunk was actually dispatched with, not whatever boundary mutations
+    happened since."""
+
+    toks: jax.Array  # [n, B] i32, materializes asynchronously
+    n: int  # scan length actually dispatched
+    start_pos: np.ndarray  # i32[B] per-slot position at dispatch
+    active: np.ndarray  # bool[B] active mask at dispatch
+    advance: np.ndarray  # i32[B] rows each slot really advances (per-row
+    # freeze at seq_len: min(n, room) for active slots, 0 otherwise)
+    t0: float  # dispatch wall-clock (DECODE_CHUNK_SECONDS stops at consume)
 
 
 class BatchEngine:
@@ -115,10 +142,44 @@ class BatchEngine:
         self.frequency = np.zeros(n_slots, np.float32)
         self._counts: jax.Array | None = None
         # per-slot PRNG keys (threefry uint32[2]); requests without a seed get
-        # a unique key derived from the engine seed + admission counter
+        # a unique key derived from the engine seed + admission counter.
+        # NOTE: `keys` is a commit-time record only — the LIVE keys advance
+        # on-device inside the decode scan (self._keys_dev below) and are
+        # never copied back; each row here is the key its slot's request
+        # STARTED from, overwritten at the next add_commit.
         self.keys = np.tile(np.array(jax.random.PRNGKey(seed)), (n_slots, 1))
         self._base_key = jax.random.PRNGKey(seed)
         self._admissions = 0
+
+        # ---- device-resident decode state. The JAX arrays below are the
+        # authoritative operands of the fused decode step, threaded
+        # chunk-to-chunk so steady-state decode uploads NOTHING (the numpy
+        # arrays above are host mirrors for the scheduler's bookkeeping).
+        # Two regimes:
+        #   * host-authoritative (pos/active/temperature/topp/presence/
+        #     frequency): only admission/commit/release mutate them, and the
+        #     host can track pos exactly (decode advances it
+        #     deterministically) — re-uploaded on `_vec_dirty`, i.e. at
+        #     boundaries only.
+        #   * device-authoritative (last_token, keys): mutated by the scan
+        #     itself with data-dependent values the host cannot reproduce
+        #     (sampled tokens, threefry splits) — never uploaded; commit
+        #     surgically row-writes them, and the host last_token mirror
+        #     refreshes when a chunk's tokens are consumed.
+        self._vec_dirty = True
+        self._last_dev = jnp.zeros(n_slots, jnp.int32)
+        self._keys_dev = jnp.asarray(self.keys.copy())
+        self._pos_dev = None
+        self._active_dev = None
+        self._temps_dev = None
+        self._topp_dev = None
+        self._pres_dev = None
+        self._freq_dev = None
+        # when the previous chunk's tokens materialized (perf_counter): the
+        # DECODE_CHUNK_SECONDS clock for an overlapped chunk starts at the
+        # LATER of its dispatch and this — a chunk dispatched while its
+        # predecessor still runs must not be billed the predecessor's tail
+        self._t_last_consume: float | None = None
 
         from dllama_tpu.parallel.collectives import resolve_sync
 
@@ -216,21 +277,32 @@ class BatchEngine:
     @staticmethod
     def _decode_impl(cfg, attn_fn, col_fn, mm, mm_in, moe_impl, params, cache, tokens,
                      pos_vec, active, keys, temps, topps, n, rope):
+        seq_len = cache.k.shape[3]
+
         def body(carry, _):
             tok, cache, p, keys = carry
-            logits, cache = forward(cfg, params, tok, p, cache, rope, attn_fn,
-                                    active=jnp.asarray(active), col_fn=col_fn, mm=mm,
+            # per-ROW freeze at the cache edge: a slot that fills its last
+            # row mid-chunk stops sampling/advancing while batch-mates keep
+            # their full chunk (the old whole-batch clamp shrank everyone's
+            # chunk to the fullest slot's room). Frozen rows behave exactly
+            # like inactive ones: writes masked, token repeats, key held —
+            # p is clamped only for their rope/cache row indexing.
+            act = jnp.asarray(active) & (p < seq_len)
+            logits, cache = forward(cfg, params, tok, jnp.minimum(p, seq_len - 1),
+                                    cache, rope, attn_fn,
+                                    active=act, col_fn=col_fn, mm=mm,
                                     mm_in=mm_in, moe_impl=moe_impl, last_only=True)
             splits = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
-            keys, subs = splits[:, 0], splits[:, 1]
+            nkeys, subs = splits[:, 0], splits[:, 1]
+            keys = jnp.where(act[:, None], nkeys, keys)
             nxt = _sample_rows(logits[:, -1], subs, temps, topps)[:, None]
-            nxt = jnp.where(active[:, None], nxt, tok)  # frozen slots keep token
-            return (nxt, cache, p + active.astype(jnp.int32), keys), nxt[:, 0]
+            nxt = jnp.where(act[:, None], nxt, tok)  # frozen slots keep token
+            return (nxt, cache, p + act.astype(jnp.int32), keys), nxt[:, 0]
 
-        (_, cache, _, keys), toks = jax.lax.scan(
+        (last, cache, pos2, keys), toks = jax.lax.scan(
             body, (tokens, cache, pos_vec, keys), None, length=n
         )
-        return toks, cache, keys
+        return toks, cache, keys, pos2, last[:, 0]
 
     @staticmethod
     def _decode_penalized_impl(cfg, attn_fn, col_fn, mm, mm_in, moe_impl, params,
@@ -245,25 +317,31 @@ class BatchEngine:
         from dllama_tpu.engine.sampling import apply_penalties
 
         b = tokens.shape[0]
+        seq_len = cache.k.shape[3]
 
         def body(carry, _):
             tok, cache, p, keys, counts = carry
+            # same per-row freeze as _decode_impl: a slot frozen at the cache
+            # edge must not inflate its counts with its repeated last token
+            act = jnp.asarray(active) & (p < seq_len)
             counts = counts.at[jnp.arange(b), tok[:, 0]].add(
-                active.astype(jnp.int32))
-            logits, cache = forward(cfg, params, tok, p, cache, rope, attn_fn,
-                                    active=jnp.asarray(active), col_fn=col_fn, mm=mm,
+                act.astype(jnp.int32))
+            logits, cache = forward(cfg, params, tok, jnp.minimum(p, seq_len - 1),
+                                    cache, rope, attn_fn,
+                                    active=act, col_fn=col_fn, mm=mm,
                                     mm_in=mm_in, moe_impl=moe_impl, last_only=True)
             splits = jax.vmap(jax.random.split)(keys)
-            keys, subs = splits[:, 0], splits[:, 1]
+            nkeys, subs = splits[:, 0], splits[:, 1]
+            keys = jnp.where(act[:, None], nkeys, keys)
             pen = apply_penalties(logits[:, -1], counts, presence, frequency)
             nxt = _sample_rows(pen, subs, temps, topps)[:, None]
-            nxt = jnp.where(active[:, None], nxt, tok)
-            return (nxt, cache, p + active.astype(jnp.int32), keys, counts), nxt[:, 0]
+            nxt = jnp.where(act[:, None], nxt, tok)
+            return (nxt, cache, p + act.astype(jnp.int32), keys, counts), nxt[:, 0]
 
-        (_, cache, _, keys, counts), toks = jax.lax.scan(
+        (last, cache, pos2, keys, counts), toks = jax.lax.scan(
             body, (tokens, cache, pos_vec, keys, counts), None, length=n
         )
-        return toks, cache, keys, counts
+        return toks, cache, keys, pos2, last[:, 0], counts
 
     @staticmethod
     def _spec_step_impl(cfg, attn_fn, col_fn, mm, mm_in, moe_impl, k, ngram,
@@ -315,7 +393,10 @@ class BatchEngine:
         adv = jnp.where(active, a + 1, 0)  # tokens each slot emitted
         nxt = jnp.take_along_axis(emit, a[:, None], axis=1)[:, 0]
         nxt = jnp.where(active, nxt, cur)
-        return emit, adv, nxt, cache, history, keys
+        # pos_vec + adv keeps the device-resident position carry current
+        # without a host round-trip (spec_step threads it chunk-to-chunk
+        # like decode does)
+        return emit, adv, nxt, cache, history, keys, pos_vec + adv
 
     @staticmethod
     def _hist_write_impl(history, slot, pos, toks):
@@ -393,6 +474,7 @@ class BatchEngine:
                 self.history, jnp.int32(src_slot), jnp.int32(dst_slot),
                 jnp.int32(rows))
         self.pos[dst_slot] = rows
+        self._vec_dirty = True
 
     # ------------------------------------------------------------------- api
 
@@ -416,6 +498,7 @@ class BatchEngine:
         if start_pos + n >= self.seq_len:
             raise ValueError(f"prompt ({start_pos}+{n}) exceeds seq_len {self.seq_len}")
         self.pos[slot] = start_pos
+        self._vec_dirty = True
         return Admission(slot=slot, toks=np.asarray(prompt_tokens, np.int32),
                          req_id=req_id)
 
@@ -465,6 +548,7 @@ class BatchEngine:
             adm.logits = logits[slot : slot + 1]
         self.pos[slot] += c
         adm.off += c
+        self._vec_dirty = True
         # JAX dispatch is async: without a sync this is host dispatch time
         # only. The scheduler blocks on adm.logits whenever decoders would
         # stall, so serving-path samples ARE device-real; direct callers see
@@ -497,6 +581,13 @@ class BatchEngine:
         self.topp[slot] = topp
         self.presence[slot] = presence
         self.frequency[slot] = frequency
+        # device carry: the host-auth vectors re-upload at the next dispatch,
+        # but last_token/keys are device-authoritative (the scan mutates them
+        # with values the host can't mirror mid-flight), so the commit writes
+        # just this slot's rows in place — other slots' carries stay intact
+        self._vec_dirty = True
+        self._last_dev = self._last_dev.at[slot].set(first)
+        self._keys_dev = self._keys_dev.at[slot].set(key)
         if presence or frequency:
             if self._counts is None:
                 self._counts = jnp.zeros((self.n_slots, self.cfg.vocab_size),
@@ -542,56 +633,116 @@ class BatchEngine:
         return self.add_commit(adm, temperature, topp, seed,
                                presence=presence, frequency=frequency)
 
-    def decode(self, n: int) -> np.ndarray:
-        """n fused decode steps across all active slots; returns tokens [n, B]
-        (frozen slots repeat their last token — callers track per-slot state)."""
+    def _sync_vectors(self) -> None:
+        """Refresh the device copies of the host-authoritative per-slot
+        vectors. A no-op in steady-state decode: only admission/commit/
+        release/copy mark them dirty, so the old per-chunk six-array upload
+        fan happens at most once per boundary. `.copy()` is load-bearing on
+        every upload: jnp.asarray can zero-copy ALIAS a numpy buffer on CPU,
+        and these host arrays are mutated in place after async dispatches —
+        aliasing would turn that into a read/write race."""
+        if not self._vec_dirty:
+            return
+        self._pos_dev = jnp.asarray(self.pos.copy(), jnp.int32)
+        self._active_dev = jnp.asarray(self.active.copy())
+        self._temps_dev = jnp.asarray(self.temperature.copy())
+        self._topp_dev = jnp.asarray(self.topp.copy())
+        self._pres_dev = jnp.asarray(self.presence.copy())
+        self._freq_dev = jnp.asarray(self.frequency.copy())
+        self._vec_dirty = False
+
+    def decode_dispatch(self, n: int) -> DecodeChunk:
+        """Dispatch one fused n-step decode chunk WITHOUT waiting for its
+        tokens. The jitted scan threads the device-resident carry (cache,
+        last_token, pos, PRNG keys) to itself, so in steady state this
+        uploads no host arrays at all and returns immediately (JAX dispatch
+        is async) — the caller overlaps host scheduling work with the
+        chunk's device compute and blocks only in decode_consume.
+
+        Slots whose cache fills mid-chunk freeze per-row at seq_len (token
+        repeats, no advance) instead of clamping the whole batch's chunk to
+        the fullest slot's room; `DecodeChunk.advance` records each slot's
+        true row count. Raises only when no active slot has any room."""
         faults.fire("engine.decode")
-        t0 = time.perf_counter()
         if not self.active.any():
             raise ValueError("no active slots")
-        room = self.seq_len - int(self.pos[self.active].max())
-        n = min(n, room)
+        room = self.seq_len - self.pos[self.active]
+        n = min(n, int(room.max()))
         if n <= 0:
-            raise ValueError("active slot at seq_len; release it first")
+            raise ValueError("every active slot is at seq_len; release first")
+        self._sync_vectors()
+        pos_before = self._pos_dev
         args = (
             self.params, self.cache,
-            jnp.asarray(self.last_token[:, None].copy()),
-            jnp.asarray(self.pos.copy(), jnp.int32),
-            jnp.asarray(self.active.copy()),
-            jnp.asarray(self.keys.copy()),
-            jnp.asarray(self.temperature.copy()),
-            jnp.asarray(self.topp.copy()),
+            self._last_dev[:, None],
+            self._pos_dev,
+            self._active_dev,
+            self._keys_dev,
+            self._temps_dev,
+            self._topp_dev,
             n,
             self.rope_cache,
         )
+        t0 = time.perf_counter()
         if self._counts is not None and (
             (self.presence[self.active] != 0).any()
             or (self.frequency[self.active] != 0).any()
         ):
-            toks, self.cache, keys, self._counts = self._decode_pen(
-                *args, self._counts,
-                jnp.asarray(self.presence.copy()),
-                jnp.asarray(self.frequency.copy()),
-            )
+            (toks, self.cache, self._keys_dev, self._pos_dev, self._last_dev,
+             self._counts) = self._decode_pen(
+                *args, self._counts, self._pres_dev, self._freq_dev)
         else:
-            toks, self.cache, keys = self._decode(*args)
-        toks = np.asarray(toks)
-        # np.asarray forced the device-to-host transfer, so the clock below
-        # covers the chunk's real device time, not just dispatch
-        ins.DECODE_CHUNK_SECONDS.observe(time.perf_counter() - t0)
-        ins.BATCH_OCCUPANCY.observe(int(self.active.sum()))
-        self.keys = np.array(keys)  # writable copy — add() mutates rows
+            toks, self.cache, self._keys_dev, self._pos_dev, self._last_dev = (
+                self._decode(*args))
+        start_pos = self.pos.copy()
+        active = self.active.copy()
+        advance = np.where(
+            active, np.minimum(n, self.seq_len - start_pos), 0
+        ).astype(np.int32)
         if self.spec_k:
-            # keep the spec history current: decode's tokens land at
-            # pos+1..pos+n per slot (pos still pre-advance here)
+            # history backfill rides the device stream off the
+            # not-yet-materialized tokens (no host round-trip). Rows whose
+            # full chunk would spill past the history row are skipped: their
+            # slot froze mid-chunk at seq_len, where spec_eligible freezes it
+            # anyway — a draft from slightly stale history is only a
+            # proposal, verify rejects it.
+            fits = active & (start_pos + 1 + n <= self.seq_len + 1)
             self.history = self._hist_write_batch(
-                self.history, jnp.asarray(toks.T.copy()),
-                jnp.asarray(self.pos.copy(), jnp.int32),
-                jnp.asarray(self.active.copy()),
-            )
-        self.pos[self.active] += n
-        self.last_token[self.active] = toks[-1, self.active]
+                self.history, toks.T, pos_before, jnp.asarray(fits))
+        # the host pos mirror advances arithmetically — exactly what the scan
+        # computes — so it stays current without waiting for the tokens
+        self.pos += advance
+        return DecodeChunk(toks=toks, n=n, start_pos=start_pos, active=active,
+                           advance=advance, t0=t0)
+
+    def decode_consume(self, chunk: DecodeChunk) -> np.ndarray:
+        """Block until the chunk's tokens are on host; fold them into the
+        host mirrors and the chunk-timing metrics. Returns tokens [n, B]
+        (frozen/mid-chunk-frozen slots repeat their last token — callers use
+        chunk.advance for per-slot counts)."""
+        toks = np.asarray(chunk.toks)
+        # the transfer above is the device sync: observing here (not at
+        # dispatch) keeps DECODE_CHUNK_SECONDS device-real under overlapped
+        # consumption. The clock starts at the later of the chunk's dispatch
+        # and the previous chunk's consumption: an overlapped dispatch lands
+        # while its predecessor still runs, and billing it the predecessor's
+        # tail would read as ~2x chunk time.
+        now = time.perf_counter()
+        start = (chunk.t0 if self._t_last_consume is None
+                 else max(chunk.t0, self._t_last_consume))
+        ins.DECODE_CHUNK_SECONDS.observe(now - start)
+        self._t_last_consume = now
+        ins.BATCH_OCCUPANCY.observe(int(chunk.active.sum()))
+        self.last_token[chunk.active] = toks[-1, chunk.active]
         return toks
+
+    def decode(self, n: int) -> np.ndarray:
+        """n fused decode steps across all active slots; returns tokens
+        [n', B] with n' = min(n, the roomiest active slot's room). Slots
+        that hit seq_len mid-chunk freeze per-row (their trailing tokens
+        repeat) while batch-mates keep the full chunk — callers track
+        per-slot state. Lockstep wrapper over decode_dispatch/consume."""
+        return self.decode_consume(self.decode_dispatch(n))
 
     def spec_eligible(self) -> np.ndarray:
         """bool[B]: slots the next spec_step cycle will serve rather than
@@ -631,28 +782,36 @@ class BatchEngine:
             raise ValueError("no active slot is spec-eligible (needs room for "
                              "K+1 rows and no repetition penalties); use "
                              "decode() or release the full slots")
-        emit, adv, nxt, self.cache, self.history, keys = self._spec_step(
+        self._sync_vectors()
+        # the eligibility mask is the one per-cycle upload left: it encodes
+        # the host-side freeze rule, so it is inherently host-born
+        (emit, adv, nxt, self.cache, self.history, self._keys_dev,
+         self._pos_dev) = self._spec_step(
             self.params, self.cache, self.history,
-            jnp.asarray(self.last_token.copy()),
-            jnp.asarray(self.pos.copy(), jnp.int32),
+            self._last_dev,
+            self._pos_dev,
             jnp.asarray(eff.copy()),
-            jnp.asarray(self.keys.copy()),
-            jnp.asarray(self.temperature.copy()),
-            jnp.asarray(self.topp.copy()),
+            self._keys_dev,
+            self._temps_dev,
+            self._topp_dev,
             self.rope_cache,
         )
+        self._last_dev = nxt
         emit, adv = np.asarray(emit), np.asarray(adv)
         ins.DECODE_CHUNK_SECONDS.observe(time.perf_counter() - t0)
+        self._t_last_consume = time.perf_counter()
         ins.BATCH_OCCUPANCY.observe(int(eff.sum()))
-        self.keys = np.array(keys)
         self.pos += adv
         self.last_token = np.array(nxt)
         return emit, adv
 
     def release(self, slot: int, keep_rows: int | None = None) -> None:
         """Free a slot. keep_rows rewinds pos to the valid prefix (mid-chunk
-        stop), preserving the slot's cache for NaiveCache-style reuse."""
+        stop — including tokens a dispatched-but-unconsumed chunk overran
+        past a stop: the rewound rows are never read, like rejected spec
+        drafts), preserving the slot's cache for NaiveCache-style reuse."""
         self.active[slot] = False
         self.presence[slot] = self.frequency[slot] = 0.0
         if keep_rows is not None:
             self.pos[slot] = keep_rows
+        self._vec_dirty = True

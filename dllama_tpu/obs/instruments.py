@@ -85,8 +85,18 @@ PREFILL_CHUNK_SECONDS = metrics.histogram(
     buckets=metrics.CHUNK_BUCKETS_S)
 DECODE_CHUNK_SECONDS = metrics.histogram(
     "dllama_decode_chunk_seconds",
-    "Host wall time of one fused decode chunk (device-synced: the chunk's "
-    "tokens are materialized before the clock stops)",
+    "Wall time of ONE fused decode chunk, observed when its tokens "
+    "materialize on host (device-real under the overlapped pipeline too: "
+    "the clock starts at the later of the chunk's dispatch and the "
+    "previous chunk's consumption, so a chunk dispatched while its "
+    "predecessor still runs is not billed the predecessor's tail)",
+    buckets=metrics.CHUNK_BUCKETS_S)
+DECODE_HOST_GAP_SECONDS = metrics.histogram(
+    "dllama_decode_host_gap_seconds",
+    "Inter-chunk host gap: wall time from one decode chunk's tokens "
+    "materializing to the next chunk's dispatch — the device-idle window "
+    "host scheduling inserts; ~0 with --overlap on (the successor "
+    "dispatches before the previous chunk is consumed)",
     buckets=metrics.CHUNK_BUCKETS_S)
 BATCH_OCCUPANCY = metrics.histogram(
     "dllama_batch_occupancy",

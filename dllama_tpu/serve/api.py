@@ -828,6 +828,11 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
             sched_kw["max_queue"] = int(defaults["max_queue"])
         if defaults.get("stall_deadline_s"):
             sched_kw["stall_deadline_s"] = float(defaults["stall_deadline_s"])
+        # overlapped decode pipeline (--overlap, default on): chunk N+1
+        # dispatches before chunk N's tokens are consumed; off restores the
+        # lockstep loop for A/B (token streams are identical either way)
+        if defaults.get("overlap") is not None:
+            sched_kw["overlap"] = bool(defaults["overlap"])
         scheduler = Scheduler(be, **sched_kw)
     api = ApiServer(
         loaded,

@@ -9,7 +9,12 @@ only for chunk boundaries.
 
 Token-level stops (EOS ids, budget) are handled here; *string* stop sequences
 need decoded text, so the request handler runs its EosDetector on the stream
-and calls cancel() — generation overruns by at most one chunk.
+and calls cancel() — generation overruns by at most one chunk. With the
+overlapped pipeline (the default: chunk N+1 dispatches off chunk N's
+device-side carry before chunk N's tokens are consumed), token-level stops
+inherit the same one-chunk overrun contract: the in-flight chunk keeps
+decoding a just-finished slot, its tokens are discarded at consumption, and
+release(keep_rows=) rewinds the slot to the truly-emitted prefix.
 
 **Per-slot prefix cache** (the batched-tier NaiveCache, dllama-api.cpp:264-309):
 released slots keep their KV rows and the token history that produced them.
@@ -138,10 +143,23 @@ class Scheduler:
                  admit_stall_budget_ms: float = 250.0,
                  admit_ttft_deadline_ms: float | None = None,
                  max_queue: int = 0,
-                 stall_deadline_s: float = 0.0):
+                 stall_deadline_s: float = 0.0,
+                 overlap: bool = True):
         self.engine = engine
         self.chunk = chunk
         self.admit_timeout = admit_timeout
+        # overlapped decode pipeline (--overlap): dispatch chunk N+1 off
+        # chunk N's device-side carry BEFORE consuming chunk N's tokens, so
+        # the per-chunk Python work (emit loops, EOS/budget checks, metrics)
+        # runs while the device computes. Token-level stops then lag by at
+        # most ONE chunk — the same overrun contract string stops already
+        # have above — with overrun tokens discarded and release(keep_rows=)
+        # rewound to the truly-emitted prefix. False restores the lockstep
+        # loop (dispatch+consume per iteration); token streams are
+        # bit-identical either way. Spec engines always run lockstep: a spec
+        # cycle's emit counts are data-dependent, so there is nothing to
+        # dispatch ahead.
+        self.overlap = bool(overlap) and not getattr(engine, "spec_k", 0)
         # bounded admission (load shedding): submit() raises QueueFull once
         # the pending queue holds this many requests — the API tier turns it
         # into 429 + Retry-After. 0 = unbounded (the pre-supervision behavior).
@@ -176,6 +194,13 @@ class Scheduler:
         # consecutive decode chunks whenever admission work ran in between —
         # the stall decoding slots actually experienced
         self._admit_gaps_ms: list[float] = []
+        # inter-chunk host gap: time from one chunk's tokens materializing to
+        # the next chunk's dispatch — the device-idle window host scheduling
+        # inserts. ~0 under overlap (chunk N+1 dispatches before chunk N is
+        # consumed); the lockstep A/B baseline shows the real gap. Mirrors
+        # the dllama_decode_host_gap_seconds histogram.
+        self._host_gap_ms: list[float] = []
+        self._t_consumed: float | None = None
         # mixed-batch speculation: when some active slot is spec-ineligible
         # (near seq_len or penalized), spec cycles freeze it — alternate spec
         # with plain decode chunks so it still advances (toggle state)
@@ -338,6 +363,7 @@ class Scheduler:
         with self._metrics_lock:
             done = list(self._completed)
             gaps = list(self._admit_gaps_ms)
+            hgaps = list(self._host_gap_ms)
         ttfts = [r.ttft_ms for r in done if r.ttft_ms is not None]
         itls = [r.itl_ms for r in done if r.itl_ms is not None]
         mean = lambda xs: sum(xs) / len(xs) if xs else None
@@ -349,6 +375,9 @@ class Scheduler:
             "admission_gaps": len(gaps),
             "admission_stall_ms_max": max(gaps) if gaps else None,
             "admission_stall_ms_mean": mean(gaps),
+            "decode_host_gaps": len(hgaps),
+            "decode_host_gap_ms_max": max(hgaps) if hgaps else None,
+            "decode_host_gap_ms_mean": mean(hgaps),
         }
 
     def reset_latency_stats(self) -> None:
@@ -359,7 +388,9 @@ class Scheduler:
         with self._metrics_lock:
             self._completed.clear()
             self._admit_gaps_ms.clear()
+            self._host_gap_ms.clear()
         self._t_dec_end = None
+        self._t_consumed = None
 
     def cancel(self, req: Request, reason: str = "cancelled") -> None:
         """Release a request's slot. `reason` becomes the finish_reason when
@@ -462,26 +493,11 @@ class Scheduler:
         if not idle:
             return None, 0, None
 
-        def shared(s: int) -> int:
-            cached = self.slot_tokens.get(s, [])
-            # reusable rows = LONGEST COMMON PREFIX (not all-or-nothing: a
-            # shared system prompt with a divergent tail still reuses the
-            # common part), capped so at least one prompt token remains to
-            # prefill (stale rows past it are masked); an ACTIVE donor's
-            # last emitted token has no KV row yet
-            n = min(len(cached), len(prompt) - 1)
-            if self.engine.active[s]:
-                n = min(n, len(cached) - 1)
-            if n <= 0:
-                return 0
-            neq = np.nonzero(np.asarray(prompt[:n]) != np.asarray(cached[:n]))[0]
-            return int(neq[0]) if neq.size else n
-
         # cross-slot donors need the engine's slot-copy primitive (dp meshes
         # shard the batch axis, where donor search stays within idle slots)
         cross_ok = getattr(self.engine, "supports_cross_slot_copy", False)
         donors = [s for s in range(self.engine.n_slots) if s not in reserved] if cross_ok else idle
-        lcp = {s: shared(s) for s in donors}
+        lcp = self._lcp_lengths(prompt, donors)
         best_idle = max(idle, key=lcp.__getitem__)
         best_any = max(donors, key=lcp.__getitem__)
         if lcp[best_any] > lcp[best_idle]:
@@ -490,6 +506,37 @@ class Scheduler:
         if lcp[best_idle] > 0:
             return best_idle, lcp[best_idle], None
         return min(idle, key=lambda s: len(self.slot_tokens.get(s, []))), 0, None
+
+    def _lcp_lengths(self, prompt: list[int], donors: list[int]) -> dict[int, int]:
+        """Longest-common-prefix length of `prompt` against every donor
+        slot's cached token history, in ONE padded-matrix comparison (the
+        per-slot np.nonzero scan was O(B·len) Python work on the admission
+        path). Reusable rows = LONGEST COMMON PREFIX (not all-or-nothing: a
+        shared system prompt with a divergent tail still reuses the common
+        part), capped so at least one prompt token remains to prefill (stale
+        rows past it are masked); an ACTIVE donor's last emitted token has
+        no KV row yet, hence its extra -1 cap."""
+        caps = {}
+        for s in donors:
+            cached = self.slot_tokens.get(s, [])
+            n = min(len(cached), len(prompt) - 1)
+            if self.engine.active[s]:
+                n = min(n, len(cached) - 1)
+            caps[s] = max(n, 0)
+        width = max(caps.values(), default=0)
+        if width <= 0:
+            return dict.fromkeys(donors, 0)
+        # pad with -1 (never a token id) so rows shorter than the widest cap
+        # mismatch past their own cap by construction
+        mat = np.full((len(donors), width), -1, np.int64)
+        for i, s in enumerate(donors):
+            if caps[s]:
+                mat[i, : caps[s]] = self.slot_tokens[s][: caps[s]]
+        hit = mat == np.asarray(prompt[:width], np.int64)[None, :]
+        # leading run of equalities: cumprod zeroes everything at and past
+        # the first mismatch, so the row sum IS the LCP length
+        lens = np.cumprod(hit, axis=1).sum(axis=1)
+        return {s: int(n) for s, n in zip(donors, lens)}
 
     def _admit_starts(self) -> None:
         """Pop pending requests into in-flight admissions while slots allow."""
@@ -693,10 +740,123 @@ class Scheduler:
                           "requests and marking /health unhealthy")
             self._fail_all(e)
 
+    def _needs_boundary(self, inflight_chunk=None) -> bool:
+        """True when the next chunk must wait for a fully-consumed pipeline:
+        admission work (a prefill must not race the in-flight chunk's
+        donated cache, and commit/release need settled host mirrors), a
+        pending cancel, a slot at the cache edge, spec alternation, or an
+        emptied batch. The overlapped loop then consumes its in-flight chunk
+        WITHOUT dispatching a successor, and the next iteration runs the
+        boundary work on settled state — admission pumps are serialized at
+        chunk consumption points."""
+        if self._stop.is_set() or getattr(self.engine, "spec_k", 0):
+            return True
+        if not self.slots or self._inflight or not self.pending.empty():
+            return True
+        if any(r.cancelled.is_set() for r in self.slots.values()):
+            return True
+        if any(int(self.engine.pos[s]) >= self.engine.seq_len
+               for s in self.slots):
+            return True
+        if inflight_chunk is not None:
+            # budget finishes are host-predictable (unlike EOS): when EVERY
+            # live request exhausts max_tokens within the chunk already in
+            # flight, a successor would be pure discarded overrun — don't
+            # burn a device chunk on it (a fixed-budget batch would pay one
+            # wasted chunk per drain otherwise)
+            return all(
+                req.produced + int(inflight_chunk.advance[slot]) >= req.max_tokens
+                for slot, req in self.slots.items()
+            )
+        return False
+
+    def _observe_host_gap(self, pipeline_empty: bool,
+                          exclude_s: float = 0.0) -> None:
+        """Inter-chunk host gap, stamped at every chunk dispatch: how long
+        the device sat idle on SCHEDULING overhead between chunks. A
+        dispatch into an EMPTY pipeline pays the wall time since the
+        previous chunk's tokens materialized minus `exclude_s` (admission/
+        boundary work — that stall is ADMISSION_STALL_SECONDS's story, and
+        polluting this series with it would drown the per-chunk signal); a
+        dispatch while a chunk is still in flight pays nothing — the device
+        never went idle, which is the overlap win the A/B measures."""
+        if self._t_consumed is None:
+            return
+        gap_s = (max(0.0, time.monotonic() - self._t_consumed - exclude_s)
+                 if pipeline_empty else 0.0)
+        ins.DECODE_HOST_GAP_SECONDS.observe(gap_s)
+        with self._metrics_lock:
+            self._host_gap_ms.append(gap_s * 1000.0)
+            del self._host_gap_ms[:-256]
+
+    def _dispatch_chunk(self, pipeline_empty: bool = True,
+                        exclude_gap_s: float = 0.0):
+        """Start the next device chunk. Returns (chunk, slots snapshot) for
+        an async decode dispatch, or None when a spec cycle ran instead —
+        spec emit counts are data-dependent, so the cycle is dispatched AND
+        consumed in place (nothing to overlap).
+
+        A decode/spec failure here is NOT a per-request problem: the jitted
+        step donates the KV cache, so an exception mid-chunk leaves the
+        engine's buffers in an indeterminate state. It escalates to the
+        supervision wrapper — every in-flight request (including ones whose
+        tokens ride the unconsumed chunk) fails fast with
+        finish_reason='error' and /health goes unhealthy (the process
+        supervisor owns the restart)."""
+        # speculative cycle when some slot can profit: greedy (sampled
+        # slots never accept drafts), K+1 rows of cache room, and no
+        # repetition penalties (spec acceptance compares raw argmax;
+        # penalized sampling rides the counts-carrying decode path).
+        # Ineligible slots are frozen by spec_step, not poisoned — a
+        # mixed batch alternates spec cycles with plain decode chunks so
+        # frozen slots still advance to their finish (no livelock) while
+        # eligible ones keep multi-token acceptance on their cycles.
+        use_spec = False
+        if getattr(self.engine, "spec_k", 0):
+            elig = self.engine.spec_eligible()  # the engine's freeze rule
+            use_spec = any(
+                elig[s] and float(self.engine.temperature[s]) == 0.0
+                for s in self.slots
+            )
+            if use_spec and not all(elig[s] for s in self.slots):
+                self._spec_tick = not self._spec_tick
+                use_spec = self._spec_tick
+        self._observe_host_gap(pipeline_empty, exclude_gap_s)
+        if use_spec:
+            start_rows = {s: int(self.engine.pos[s]) for s in self.slots}
+            emit_toks, adv = self.engine.spec_step()
+            self._t_dec_end = self._t_consumed = time.monotonic()
+            for slot, req in list(self.slots.items()):
+                for i in range(int(adv[slot])):
+                    # row written when sampling token i: start + i (+1 = prefix len)
+                    if self._emit(req, emit_toks[slot, i], start_rows[slot] + i + 1):
+                        break
+            return None
+        return self.engine.decode_dispatch(self.chunk), dict(self.slots)
+
+    def _consume_chunk(self, chunk, snapshot) -> None:
+        """Block on a dispatched chunk's tokens and emit them to the
+        requests captured at dispatch time. A slot whose request finished
+        while the chunk was in flight (EOS/budget found consuming the
+        previous chunk, or a cancel) is skipped: those tokens are the
+        one-chunk stop overrun — discarded, with release(keep_rows=) having
+        rewound the slot to the truly-emitted prefix, so the prefix cache
+        never serves overrun rows."""
+        toks = self.engine.decode_consume(chunk)
+        self._t_dec_end = self._t_consumed = time.monotonic()
+        for slot, req in snapshot.items():
+            if self.slots.get(slot) is not req:
+                continue  # finished mid-flight: overrun tokens discarded
+            for i in range(int(chunk.advance[slot])):
+                # row written when sampling token i: start + i (+1 = prefix len)
+                if self._emit(req, toks[i, slot], int(chunk.start_pos[slot]) + i + 1):
+                    break
+
     def _loop(self) -> None:
         # end of the previous decode chunk (stall metric); instance attribute
         # so reset_latency_stats can rewind it from the caller's thread
         self._t_dec_end = None
+        pending = None  # overlap mode: the dispatched-but-unconsumed chunk
         while not self._stop.is_set():
             self._heartbeat = time.monotonic()
             # scrape-visible view of the loop's state (set, not callbacks:
@@ -705,6 +865,18 @@ class Scheduler:
             ins.QUEUE_DEPTH.set(self.pending.qsize())
             ins.BUSY_SLOTS.set(len(self.slots))
             faults.fire("scheduler.loop")
+            if pending is not None:
+                # a chunk is in flight: keep the device busy by dispatching
+                # its successor off the device-side carry BEFORE consuming —
+                # the emit/EOS Python work below then runs concurrently with
+                # device compute — unless boundary work needs the settled,
+                # fully-consumed state first.
+                nxt = (None if self._needs_boundary(pending[0])
+                       else self._dispatch_chunk(pipeline_empty=False))
+                self._consume_chunk(*pending)
+                pending = nxt
+                continue
+            t_boundary = time.monotonic()
             self._admit_starts()
             admitted = self._pump_admissions()
             for slot, req in list(self.slots.items()):
@@ -726,43 +898,14 @@ class Scheduler:
                     self._admit_gaps_ms.append(gap_ms)
                     del self._admit_gaps_ms[:-256]
                 ins.ADMISSION_STALL_SECONDS.observe(gap_ms / 1000.0)
-            start_rows = {s: int(self.engine.pos[s]) for s in self.slots}
-            # speculative cycle when some slot can profit: greedy (sampled
-            # slots never accept drafts), K+1 rows of cache room, and no
-            # repetition penalties (spec acceptance compares raw argmax;
-            # penalized sampling rides the counts-carrying decode path).
-            # Ineligible slots are frozen by spec_step, not poisoned — a
-            # mixed batch alternates spec cycles with plain decode chunks so
-            # frozen slots still advance to their finish (no livelock) while
-            # eligible ones keep multi-token acceptance on their cycles.
-            use_spec = False
-            if getattr(self.engine, "spec_k", 0):
-                elig = self.engine.spec_eligible()  # the engine's freeze rule
-                use_spec = any(
-                    elig[s] and float(self.engine.temperature[s]) == 0.0
-                    for s in self.slots
-                )
-                if use_spec and not all(elig[s] for s in self.slots):
-                    self._spec_tick = not self._spec_tick
-                    use_spec = self._spec_tick
-            # a decode failure is NOT a per-request problem: the jitted step
-            # donates the KV cache, so an exception mid-chunk leaves the
-            # engine's buffers in an indeterminate state. Escalate to the
-            # supervision wrapper — every in-flight request fails fast with
-            # finish_reason='error' and /health goes unhealthy (the process
-            # supervisor owns the restart).
-            if use_spec:
-                emit_toks, adv = self.engine.spec_step()
+            chunk = self._dispatch_chunk(
+                exclude_gap_s=time.monotonic() - t_boundary)
+            if chunk is None:
+                continue  # spec cycle: already consumed in place
+            if self.overlap:
+                pending = chunk
             else:
-                toks = self.engine.decode(self.chunk)
-            self._t_dec_end = time.monotonic()
-            for slot, req in list(self.slots.items()):
-                n = int(adv[slot]) if use_spec else toks.shape[0]
-                for i in range(n):
-                    # row written when sampling token i: start + i (+1 = prefix len)
-                    tok = emit_toks[slot, i] if use_spec else toks[i, slot]
-                    if self._emit(req, tok, start_rows[slot] + i + 1):
-                        break
+                self._consume_chunk(*chunk)
         # shutdown with work still in flight (drain timeout, hard stop): the
         # cut-off requests must surface as FAILURES to their clients — a bare
         # _END would read as a clean, complete generation (HTTP 200 with

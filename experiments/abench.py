@@ -13,6 +13,11 @@ scheduler's stall budget is spent) — and reports, for each mode:
 * scheduler admission_stall_ms_max/mean — the decode-to-decode gaps the
   scheduler attributed to admission work.
 
+It finishes with the overlap-pipeline A/B (bench.bench_overlap): aggregate
+decode tok/s and the inter-chunk host gap with the scheduler's overlapped
+dispatch on vs off — same prompts/seeds, identical token streams, so the
+delta is pure pipeline efficiency.
+
 The reference has no analog tier (its server is single-request blocking,
 dllama-api.cpp:522-533); this bench exists to prove the non-blocking claim
 with numbers. Window config (TPU): ABENCH_PRESET=8b ABENCH_SLOTS=32
@@ -124,6 +129,24 @@ def main():
             print(r, flush=True)
         except Exception as e:
             print(f"{mode}: FAILED {e!r}"[:300], flush=True)
+
+    # overlap-pipeline A/B (shared with bench.py's `overlap` record):
+    # inter-chunk host gap + aggregate tok/s, overlapped dispatch on vs off
+    from bench import bench_overlap
+
+    try:
+        ov = bench_overlap(cfg, params, n_slots=n_slots, chunk=chunk,
+                           steps=(24 if smoke else 128), pf_chunk=pf_chunk)
+        print({"overlap_ab": ov}, flush=True)
+        on, off = ov.get("overlap_on", {}), ov.get("overlap_off", {})
+        if "agg_tok_s" in on and "agg_tok_s" in off:
+            print(f"overlap host-gap reduction: "
+                  f"{ov.get('host_gap_reduction_x')}x "
+                  f"(mean {off.get('host_gap_ms_mean')}ms -> "
+                  f"{on.get('host_gap_ms_mean')}ms); "
+                  f"agg tok/s on/off: {ov.get('tok_s_ratio_on_off')}", flush=True)
+    except Exception as e:
+        print(f"overlap A/B: FAILED {e!r}"[:300], flush=True)
     if len(rows) == 3 and all(r["client_gap_ms_max"] is not None
                               for r in rows.values()):
         # timer-noise floor: a 0.0 best-case yields a large finite ratio
