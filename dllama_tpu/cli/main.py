@@ -126,7 +126,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="log line format: text (human) or json (one structured "
                         "object per line — request_id and other context as "
                         "fields; see dllama_tpu/utils/logs.py for the schema)")
-    p.add_argument("--trace", metavar="DIR", help="write a jax.profiler trace (XProf/TensorBoard)")
+    p.add_argument("--trace", metavar="DIR", help="write a jax.profiler trace "
+                   "(XProf/TensorBoard; serve mode can instead capture on "
+                   "demand via POST /debug/profile)")
+    p.add_argument("--trace-buffer", type=int, default=2048, metavar="N",
+                   help="request-flow span tracer: ring capacity in events "
+                        "(serve mode exports it at GET /debug/trace — loads "
+                        "in Perfetto — and GET /debug/requests, the "
+                        "per-request flight recorder). 0 disables tracing "
+                        "entirely: a no-op tracer, nothing recorded or "
+                        "allocated (default 2048)")
     p.add_argument("--report", action="store_true",
                    help="print memory + per-token latency + collective-payload report")
     p.add_argument("-v", "--verbose", action="store_true")
@@ -355,6 +364,12 @@ def main(argv=None) -> int:
     # shared logger setup (utils/logs.py): --log-format json switches every
     # line to one structured object with request_id/fault_point/... fields
     setup_logging(fmt=args.log_format, verbose=args.verbose)
+    # request-flow tracing rides every mode (serve exposes it over /debug/*;
+    # inference/chat record into the same in-process ring) — configured
+    # before anything that could emit a span
+    from dllama_tpu.obs import trace
+
+    trace.configure(args.trace_buffer)
     from dllama_tpu.utils import faults
 
     # $DLLAMA_FAULTS first, --faults wins when both are set; a bad spec

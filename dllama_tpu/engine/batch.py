@@ -37,6 +37,7 @@ from dllama_tpu.engine.sampling import sample_logits
 from dllama_tpu.models.config import LlamaConfig
 from dllama_tpu.models.llama import KVCache, forward
 from dllama_tpu.obs import instruments as ins
+from dllama_tpu.obs import trace
 from dllama_tpu.utils import faults
 
 
@@ -82,6 +83,12 @@ class DecodeChunk:
     advance: np.ndarray  # i32[B] rows each slot really advances (per-row
     # freeze at seq_len: min(n, room) for active slots, 0 otherwise)
     t0: float  # dispatch wall-clock (DECODE_CHUNK_SECONDS stops at consume)
+    seq: int = 0  # monotone chunk number (trace correlation key: the
+    # scheduler's dispatch/consume spans and the flight-recorder chunk
+    # lists all cite this id)
+    t_disp: float = 0.0  # dispatch mark on the TRACE clock (time.monotonic;
+    # t0 above is perf_counter) — decode_consume's device-window span runs
+    # from here to token materialization
 
 
 class BatchEngine:
@@ -150,6 +157,7 @@ class BatchEngine:
         self.keys = np.tile(np.array(jax.random.PRNGKey(seed)), (n_slots, 1))
         self._base_key = jax.random.PRNGKey(seed)
         self._admissions = 0
+        self.chunk_seq = 0  # decode/spec chunk counter (DecodeChunk.seq)
 
         # ---- device-resident decode state. The JAX arrays below are the
         # authoritative operands of the fused decode step, threaded
@@ -684,6 +692,7 @@ class BatchEngine:
             self.rope_cache,
         )
         t0 = time.perf_counter()
+        t_disp = time.monotonic()  # trace clock; ~free next to perf_counter
         if self._counts is not None and (
             (self.presence[self.active] != 0).any()
             or (self.frequency[self.active] != 0).any()
@@ -712,8 +721,10 @@ class BatchEngine:
         # the host pos mirror advances arithmetically — exactly what the scan
         # computes — so it stays current without waiting for the tokens
         self.pos += advance
+        self.chunk_seq += 1
         return DecodeChunk(toks=toks, n=n, start_pos=start_pos, active=active,
-                           advance=advance, t0=t0)
+                           advance=advance, t0=t0, seq=self.chunk_seq,
+                           t_disp=t_disp)
 
     def decode_consume(self, chunk: DecodeChunk) -> np.ndarray:
         """Block until the chunk's tokens are on host; fold them into the
@@ -733,6 +744,14 @@ class BatchEngine:
         ins.DECODE_CHUNK_SECONDS.observe(now - start)
         self._t_last_consume = now
         ins.BATCH_OCCUPANCY.observe(int(chunk.active.sum()))
+        tr = trace.TRACER
+        if tr.enabled:
+            # the chunk's device-side window: dispatch -> tokens on host.
+            # Under the overlapped pipeline this span brackets the NEXT
+            # chunk's dispatch span — the overlap, visible in Perfetto.
+            tr.span_at("decode.device", chunk.t_disp, tr.now(),
+                       cat="decode", track="device", chunk=chunk.seq,
+                       n=chunk.n, occupancy=int(chunk.active.sum()))
         self.last_token[chunk.active] = toks[-1, chunk.active]
         return toks
 
@@ -773,6 +792,7 @@ class BatchEngine:
         both lifted to the serving tier at once."""
         faults.fire("engine.decode")  # a spec cycle IS the decode chunk
         t0 = time.perf_counter()
+        t_disp = time.monotonic()  # trace clock for the cycle's device span
         if not self.spec_k:
             raise ValueError("engine built with spec=0")
         if not self.active.any():
@@ -801,6 +821,14 @@ class BatchEngine:
         ins.DECODE_CHUNK_SECONDS.observe(time.perf_counter() - t0)
         self._t_last_consume = time.perf_counter()
         ins.BATCH_OCCUPANCY.observe(int(eff.sum()))
+        self.chunk_seq += 1
+        tr = trace.TRACER
+        if tr.enabled:
+            # a spec cycle is dispatched AND consumed in place (emit counts
+            # are data-dependent), so one span covers its whole device window
+            tr.span_at("decode.spec", t_disp, tr.now(), cat="decode",
+                       track="device", chunk=self.chunk_seq,
+                       occupancy=int(eff.sum()))
         self.pos += adv
         self.last_token = np.array(nxt)
         return emit, adv

@@ -5,10 +5,15 @@ optional-dependency gates.
 
 * :mod:`dllama_tpu.obs.metrics` — the registry core and text exposition.
 * :mod:`dllama_tpu.obs.instruments` — the dllama_* metrics catalog.
+* :mod:`dllama_tpu.obs.trace` — request-flow span tracing: the bounded
+  ring-buffer tracer + per-request flight recorder behind
+  ``GET /debug/trace`` (Perfetto) and ``GET /debug/requests`` (CLI:
+  ``--trace-buffer``).
 * :func:`new_request_id` — per-request ids (``req_...``) minted at HTTP
   admission and propagated api -> scheduler -> engine; every response
-  carries the id in ``X-Request-Id`` and every request-scoped log line
-  carries it as the ``request_id`` field.
+  carries the id in ``X-Request-Id``, every request-scoped log line
+  carries it as the ``request_id`` field, and every trace span carries it
+  in its args — one id correlates all three.
 """
 
 from __future__ import annotations
@@ -16,7 +21,7 @@ from __future__ import annotations
 import re
 import uuid
 
-from dllama_tpu.obs import metrics
+from dllama_tpu.obs import metrics, trace
 from dllama_tpu.obs.metrics import REGISTRY
 
 _REQ_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,128}$")
@@ -31,4 +36,4 @@ def new_request_id(client_supplied: str | None = None) -> str:
     return "req_" + uuid.uuid4().hex[:24]
 
 
-__all__ = ["metrics", "REGISTRY", "new_request_id"]
+__all__ = ["metrics", "trace", "REGISTRY", "new_request_id"]

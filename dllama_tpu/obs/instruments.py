@@ -53,6 +53,12 @@ REUSED_PREFIX_TOKENS = metrics.counter(
 
 # ----------------------------------------------------------------- gauges
 
+BUILD_INFO = metrics.gauge(
+    "dllama_tpu_build_info",
+    "Always 1; the labels carry what is running — package version, jax "
+    "version, jax backend platform, and whether the overlapped decode "
+    "pipeline is active (on/off, or n/a on the single-engine tier)",
+    ("version", "jax", "backend", "overlap"))
 QUEUE_DEPTH = metrics.gauge(
     "dllama_queue_depth", "Requests waiting in the admission queue")
 BUSY_SLOTS = metrics.gauge(

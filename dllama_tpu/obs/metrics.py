@@ -291,6 +291,12 @@ class Registry:
                   buckets=LATENCY_BUCKETS_S) -> Histogram:
         return self._register(Histogram, name, help, labelnames, buckets=buckets)
 
+    def names(self) -> list[str]:
+        """Sorted names of every registered family (catalog drift checks —
+        scripts/checks.sh compares this against the README table)."""
+        with self._lock:
+            return sorted(self._families)
+
     def render(self) -> str:
         """Prometheus text exposition (version 0.0.4) of every family."""
         with self._lock:

@@ -39,8 +39,9 @@ import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from dllama_tpu import __version__
 from dllama_tpu.engine.sampling import Sampler
-from dllama_tpu.obs import metrics, new_request_id
+from dllama_tpu.obs import metrics, new_request_id, trace
 from dllama_tpu.obs import instruments as ins
 from dllama_tpu.serve.scheduler import (
     QueueFull,
@@ -127,6 +128,20 @@ class ApiServer:
         eng = scheduler.engine if scheduler is not None else self.engine
         self.model_params_bytes, self.kv_cache_bytes = set_memory_gauges(
             eng.params, eng.cache)
+        # build-info gauge (value always 1; the labels ARE the payload): what
+        # exactly is serving — package + jax versions, backend platform, and
+        # whether the overlapped pipeline is live. Also embedded in /health
+        # so a probe answers "what is this replica running" without a scrape.
+        import jax
+
+        self.build_info = {
+            "version": __version__,
+            "jax": jax.__version__,
+            "backend": jax.default_backend(),
+            "overlap": ("n/a" if scheduler is None
+                        else ("on" if scheduler.overlap else "off")),
+        }
+        ins.BUILD_INFO.labels(**self.build_info).set(1)
 
     # ---------------------------------------------------------------- health
 
@@ -149,6 +164,7 @@ class ApiServer:
         # capacity questions don't need a restart with --report
         h["model_params_bytes"] = self.model_params_bytes
         h["kv_cache_bytes"] = self.kv_cache_bytes
+        h["build"] = self.build_info
         return h
 
     def precheck_capacity(self) -> None:
@@ -173,6 +189,7 @@ class ApiServer:
         (and thus the admission/finish log lines) with the HTTP request id.
         Returns the non-streaming response dict (also computed when
         streaming, for the final usage accounting)."""
+        t_submit = time.monotonic()
         messages = [(m["role"], str(m["content"])) for m in body.get("messages", [])]
         if not messages:
             raise ApiError(400, "messages must be a non-empty array")
@@ -194,7 +211,9 @@ class ApiServer:
                 req_id=req_id,
             )
 
+        self._trace_single_submit(req_id, t_submit)
         with self.lock:
+            t_admit = time.monotonic()
             delta, start_pos, add_bos = self.cache.resolve(messages)
             if start_pos == 0:
                 self.cache.clear()
@@ -206,15 +225,19 @@ class ApiServer:
             budget, sampler = self._budget_and_sampler(
                 len(prompt_tokens), max_tokens, temperature, topp, seed,
                 presence, frequency)
-            content, finish, n_generated = self._run_single(
+            content, finish, n_generated, t_first = self._run_single(
                 prompt_tokens, budget, sampler,
                 self.stops + list(extra_stops), emit, probe=probe)
             # cache the full conversation incl. the reply for the next turn
             self.cache.messages = messages + [("assistant", content)]
             self.cache.pos = self.engine.pos
             self.cache.bos_sent = True
+        timings = self._single_tier_timings(
+            req_id, t_submit, t_admit, t_first, n_generated,
+            len(prompt_tokens), start_pos, finish)
 
         return {
+            "timings": timings,
             "id": f"chatcmpl-{uuid.uuid4().hex[:16]}",
             "object": "chat.completion",
             "created": int(time.time()),
@@ -276,10 +299,46 @@ class ApiServer:
                           presence=presence, frequency=frequency)
         return budget, sampler
 
+    @staticmethod
+    def _trace_single_submit(req_id: str, t_submit: float) -> None:
+        """Single-engine tier flight-recorder entry: on this tier the
+        'queue' is the global engine lock, so submit is the handler entry
+        (the batched tier records through the scheduler instead)."""
+        tr = trace.TRACER
+        if tr.enabled and req_id:
+            tr.req_submit(req_id, t=t_submit)
+
+    @staticmethod
+    def _single_tier_timings(req_id, t_submit, t_admit, t_first, n_generated,
+                             prompt_len, reused, finish) -> dict:
+        """Build the response `timings` object for a single-engine completion
+        and close out its flight-recorder record (lock wait plays the role
+        of queue wait; prefill has no separate mark on this tier — TTFT
+        covers it)."""
+        t_done = time.monotonic()
+        timings = {
+            "queue_wait_ms": round((t_admit - t_submit) * 1000.0, 3),
+            "ttft_ms": (None if t_first is None
+                        else round((t_first - t_submit) * 1000.0, 3)),
+            "e2e_ms": round((t_done - t_submit) * 1000.0, 3),
+            "decode_tokens": n_generated,
+        }
+        tr = trace.TRACER
+        if tr.enabled and req_id:
+            tr.req_admitted(req_id, t=t_admit)
+            tr.req_mark(req_id, prompt_tokens=prompt_len,
+                        reused_tokens=reused)
+            if t_first is not None:
+                tr.req_first_token(req_id, t=t_first)
+            tr.req_end(req_id, finish, t=t_done, **timings)
+        return timings
+
     def _run_single(self, prompt_tokens, budget, sampler, stops, emit,
-                    probe=None) -> tuple[str, str, int]:
+                    probe=None) -> tuple[str, str, int, float | None]:
         """Token loop of a single-engine completion (generate + EOS/stop
-        detection + held-prefix flush) -> (content, finish_reason, n_tokens).
+        detection + held-prefix flush) -> (content, finish_reason, n_tokens,
+        first_token_monotonic_or_None — the TTFT mark of the `timings`
+        response object).
         Shared by the chat and legacy endpoints — caller holds self.lock and
         has positioned the engine. `probe` (dead-client check) aborts the
         generation via ClientDisconnected — on THIS tier a dead request
@@ -292,9 +351,12 @@ class ApiServer:
         parts: list[str] = []
         n_generated = 0
         finish = "length"
+        t_first = None
         probe_at = time.monotonic() + 0.25
         for t in self.engine.generate(prompt_tokens, budget, sampler,
                                       spec=self.spec):
+            if t_first is None:
+                t_first = time.monotonic()
             if probe is not None and time.monotonic() >= probe_at:
                 probe_at = time.monotonic() + 0.25
                 if probe():
@@ -316,7 +378,7 @@ class ApiServer:
                 parts.append(text)
                 if emit is not None:
                     emit(text)
-        return "".join(parts), finish, n_generated
+        return "".join(parts), finish, n_generated, t_first
 
     def _complete_batched(self, body, messages, temperature, topp, max_tokens,
                           extra_stops, emit, seed=None, presence=0.0,
@@ -331,12 +393,13 @@ class ApiServer:
             [ChatItem(r, c) for r, c in messages], append_generation_prompt=True
         )
         prompt_tokens = self.tokenizer.encode(generated.content, add_bos=True)
-        content, finish, n_generated = self._run_batched(
+        content, finish, n_generated, timings = self._run_batched(
             prompt_tokens, temperature, topp, max_tokens,
             self.stops + list(extra_stops), emit,
             seed=seed, presence=presence, frequency=frequency, probe=probe,
             req_id=req_id)
         return {
+            "timings": timings,
             "id": f"chatcmpl-{uuid.uuid4().hex[:16]}",
             "object": "chat.completion",
             "created": int(time.time()),
@@ -357,9 +420,11 @@ class ApiServer:
 
     def _run_batched(self, prompt_tokens, temperature, topp, max_tokens,
                      stops, emit, seed=None, presence=0.0,
-                     frequency=0.0, probe=None, req_id: str = "") -> tuple[str, str, int]:
+                     frequency=0.0, probe=None, req_id: str = "") -> tuple[str, str, int, dict]:
         """Token-level core of a batched completion: submit, stream-decode
-        with EOS/stop detection, return (content, finish_reason, n_tokens).
+        with EOS/stop detection, return (content, finish_reason, n_tokens,
+        timings) — `timings` is the request's span-sourced latency object
+        (queue wait / TTFT / e2e / token count) for the response body.
         Shared by the chat and legacy-completions endpoints — the caller
         decides the stop-string set (chat adds the template stops, the
         legacy raw-prompt endpoint uses only explicit ones, matching its
@@ -429,7 +494,16 @@ class ApiServer:
         # scheduler reasons: stop/length pass through; a cancel here means the
         # stream ended on a string stop-sequence -> "stop"
         finish = req.finish_reason if req.finish_reason in ("stop", "length") else "stop"
-        return "".join(parts), finish, n_generated
+        timings = req.timings()
+        if timings["e2e_ms"] is None:
+            # a stop-string release is finalized asynchronously by the worker;
+            # from the client's seat the request is over NOW
+            timings["e2e_ms"] = round(
+                (time.monotonic() - req.submitted_at) * 1000.0, 3)
+        # what the CLIENT received — the scheduler's `produced` may include
+        # a stop-string overrun token the stream never surfaced
+        timings["decode_tokens"] = n_generated
+        return "".join(parts), finish, n_generated, timings
 
     def complete_legacy(self, body: dict, emit=None, probe=None,
                         req_id: str = "") -> dict:
@@ -437,6 +511,7 @@ class ApiServer:
         still speak: a RAW prompt string, no chat template, `text` in the
         choices. Shares the sampling params and generation machinery with
         the chat endpoint."""
+        t_submit = time.monotonic()
         prompt = self._normalize_legacy_prompt(body)
         temperature = float(body.get("temperature", self.defaults["temperature"]))
         topp = float(body.get("top_p", self.defaults["topp"]))
@@ -450,13 +525,15 @@ class ApiServer:
         prompt_tokens = self.tokenizer.encode(prompt, add_bos=True)
 
         if self.scheduler is not None:
-            content, finish, n_generated = self._run_batched(
+            content, finish, n_generated, timings = self._run_batched(
                 prompt_tokens, temperature, topp, max_tokens,
                 list(extra_stops),  # raw prompt: no chat-template stops
                 emit, seed=seed, presence=presence, frequency=frequency,
                 probe=probe, req_id=req_id)
         else:
+            self._trace_single_submit(req_id, t_submit)
             with self.lock:
+                t_admit = time.monotonic()
                 # raw-prompt rows overwrite the chat prefix cache's claim
                 self.cache.clear()
                 self.engine.reset(0)
@@ -464,11 +541,15 @@ class ApiServer:
                     len(prompt_tokens), max_tokens, temperature, topp, seed,
                     presence, frequency)
                 # legacy endpoint: no chat stop strings, only explicit ones
-                content, finish, n_generated = self._run_single(
+                content, finish, n_generated, t_first = self._run_single(
                     prompt_tokens, budget, sampler, list(extra_stops), emit,
                     probe=probe)
+            timings = self._single_tier_timings(
+                req_id, t_submit, t_admit, t_first, n_generated,
+                len(prompt_tokens), 0, finish)
 
         return {
+            "timings": timings,
             "id": f"cmpl-{uuid.uuid4().hex[:16]}",
             "object": "text_completion",
             "created": int(time.time()),
@@ -516,12 +597,18 @@ _KNOWN_PATHS = {
     "/health/live": "/health/live",
     "/health/ready": "/health/ready",
     "/metrics": "/metrics",
+    "/debug/trace": "/debug/trace",
+    "/debug/requests": "/debug/requests",
+    "/debug/profile": "/debug/profile",
 }
 
 
 def _endpoint(path: str) -> str:
     """Label-safe endpoint name (unknown paths collapse to 'other' so a
-    scanner can't explode the label cardinality)."""
+    scanner can't explode the label cardinality; per-request flight-recorder
+    lookups collapse their req_id for the same reason)."""
+    if path.startswith("/debug/requests/"):
+        return "/debug/requests/{req_id}"
     return _KNOWN_PATHS.get(path, "other")
 
 
@@ -573,6 +660,12 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             ins.HTTP_RESPONSES.labels(endpoint="/metrics", code="200").inc()
+        elif self.path.startswith("/debug/"):
+            # the /debug family never touches admission (no request id is
+            # minted, no scheduler counter moves) — pure read-side
+            # observability plus the profiler trigger on the POST path
+            self._drain_body()  # same keep-alive discipline as do_POST
+            self._debug_get()
         elif self.path in ("/health", "/health/live", "/health/ready"):
             # /health: full snapshot, status by liveness (a restart signal);
             # /health/live and /health/ready: the k8s-style split probes —
@@ -581,6 +674,48 @@ class _Handler(BaseHTTPRequestHandler):
             h = self.api.health()
             key = "ready" if self.path.endswith("/ready") else "live"
             self._send_json(200 if h[key] else 503, h)
+        else:
+            self._send_json(404, {"error": {"message": "not found"}})
+
+    def _drain_body(self) -> None:
+        """Read and discard any request body. The /debug endpoints answer
+        early errors (404 unknown id, 404 tracing disabled, 409 profiler
+        busy) on this keep-alive server, where unread body bytes would be
+        parsed as the NEXT request line — the do_POST bug class, applied to
+        the debug family (GETs with bodies are legal, if unusual)."""
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            length = 0
+        if length > 0:
+            try:
+                self.rfile.read(length)
+            except OSError:
+                pass
+
+    def _debug_get(self) -> None:
+        """GET /debug/trace (Chrome trace-event JSON for Perfetto),
+        GET /debug/requests (flight-recorder summaries), and
+        GET /debug/requests/{req_id} (one request's full timeline)."""
+        tr = trace.TRACER
+        if not tr.enabled:
+            self._send_json(404, {"error": {
+                "message": "tracing is disabled; restart with "
+                           "--trace-buffer N > 0"}})
+            return
+        if self.path == "/debug/trace":
+            self._send_json(200, tr.export_chrome())
+        elif self.path == "/debug/requests":
+            self._send_json(200, {"requests": tr.requests_summary()})
+        elif self.path.startswith("/debug/requests/"):
+            rid = self.path[len("/debug/requests/"):]
+            rec = tr.request_timeline(rid)
+            if rec is None:
+                self._send_json(404, {"error": {
+                    "message": f"no flight-recorder entry for {rid!r} "
+                               "(never seen, or evicted from the ring)"}})
+            else:
+                self._send_json(200, rec)
         else:
             self._send_json(404, {"error": {"message": "not found"}})
 
@@ -624,6 +759,14 @@ class _Handler(BaseHTTPRequestHandler):
             raw = self.rfile.read(length)
         except (ValueError, OSError):
             self._send_json(400, {"error": {"message": "invalid request"}})
+            return
+        if self.path == "/debug/profile":
+            # not a serving request: no request id, no admission counters,
+            # usable even mid-drain (that is when postmortems happen) — but
+            # the body was drained above like any POST on this keep-alive
+            # server
+            self._req_id = None
+            self._handle_profile(raw)
             return
         if not (chat or legacy):
             self._send_json(404, {"error": {"message": "not found"}})
@@ -691,6 +834,36 @@ class _Handler(BaseHTTPRequestHandler):
             except CLIENT_GONE:
                 pass
 
+    def _handle_profile(self, raw: bytes) -> None:
+        """POST /debug/profile — start a duration-capped jax.profiler
+        capture (utils/profiling.start_profile; the same session the CLI's
+        --trace uses). Body: {"duration_s": float, "dir": str}, both
+        optional. 409 when a capture is already running."""
+        from dllama_tpu.utils import profiling
+
+        try:
+            body = json.loads(raw or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError
+        except (ValueError, json.JSONDecodeError):
+            self._send_json(400, {"error": {"message": "invalid JSON body"}})
+            return
+        try:
+            info = profiling.start_profile(
+                log_dir=body.get("dir"),
+                duration_s=body.get("duration_s", 2.0))
+        except profiling.ProfileBusy as e:
+            self._send_json(409, {"error": {"message": str(e)}},
+                            {"Retry-After": "2"})
+            return
+        except (TypeError, ValueError) as e:
+            self._send_json(400, {"error": {"message": f"bad profile "
+                                                       f"request: {e}"}})
+            return
+        log.info("device profile capture started: %.2fs -> %s",
+                 info["duration_s"], info["dir"])
+        self._send_json(200, {"profiling": info})
+
     def _stream(self, body: dict, legacy: bool = False) -> None:
         """SSE chunked streaming (dllama-api.cpp:203-223's role). `legacy`
         streams `text_completion` chunks (text field) instead of chat deltas."""
@@ -711,7 +884,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(f"{len(payload):x}\r\n".encode() + payload + b"\r\n")
             self.wfile.flush()
 
-        def emit_chat(delta: dict, finish=None) -> None:
+        def emit_chat(delta: dict, finish=None, timings=None) -> None:
             data = {
                 "id": cid,
                 "object": "chat.completion.chunk",
@@ -719,9 +892,13 @@ class _Handler(BaseHTTPRequestHandler):
                 "model": body.get("model", self.api.model_name),
                 "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
             }
+            if timings is not None:
+                # the final (done) event carries the request's span-sourced
+                # latency summary, like the non-stream response body
+                data["timings"] = timings
             chunk(b"data: " + json.dumps(data).encode() + b"\n\n")
 
-        def emit_text(text: str, finish=None) -> None:
+        def emit_text(text: str, finish=None, timings=None) -> None:
             data = {
                 "id": cid,
                 "object": "text_completion",
@@ -729,6 +906,8 @@ class _Handler(BaseHTTPRequestHandler):
                 "model": body.get("model", self.api.model_name),
                 "choices": [{"index": 0, "text": text, "finish_reason": finish}],
             }
+            if timings is not None:
+                data["timings"] = timings
             chunk(b"data: " + json.dumps(data).encode() + b"\n\n")
 
         try:
@@ -739,13 +918,15 @@ class _Handler(BaseHTTPRequestHandler):
             if legacy:
                 result = self.api.complete_legacy(
                     body, emit=emit_text, probe=self._client_gone, req_id=rid)
-                emit_text("", finish=result["choices"][0]["finish_reason"])
+                emit_text("", finish=result["choices"][0]["finish_reason"],
+                          timings=result.get("timings"))
             else:
                 emit_chat({"role": "assistant"})
                 result = self.api.complete(
                     body, emit=lambda text: emit_chat({"content": text}),
                     probe=self._client_gone, req_id=rid)
-                emit_chat({}, finish=result["choices"][0]["finish_reason"])
+                emit_chat({}, finish=result["choices"][0]["finish_reason"],
+                          timings=result.get("timings"))
             self._log_done(rid or "-", result)
         except (ClientDisconnected, *CLIENT_GONE):
             raise  # nothing to tell a dead socket; do_POST just logs it

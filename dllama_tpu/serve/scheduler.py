@@ -39,6 +39,7 @@ import numpy as np
 
 from dllama_tpu.engine.batch import BatchEngine
 from dllama_tpu.obs import instruments as ins
+from dllama_tpu.obs import trace
 from dllama_tpu.utils import faults
 
 log = logging.getLogger("dllama_tpu.serve")
@@ -97,6 +98,7 @@ class Request:
     # latency marks (time.monotonic): the serving-tier observability the
     # reference's per-token console lines provide (dllama.cpp:82-87)
     submitted_at: float = 0.0
+    admitted_at: float | None = None  # popped from the queue for admission
     first_token_at: float | None = None
     finished_at: float | None = None
 
@@ -113,6 +115,21 @@ class Request:
         if self.finished_at is None or self.first_token_at is None or self.produced < 2:
             return None
         return (self.finished_at - self.first_token_at) * 1000.0 / (self.produced - 1)
+
+    def timings(self) -> dict:
+        """The per-request latency summary clients get back (the `timings`
+        object of non-stream responses and the final SSE event) and the
+        flight recorder records — all from the same marks the /metrics
+        histograms observe, so the three views cannot disagree. Fields not
+        yet known (unadmitted, unfinished) are None."""
+        qw = (None if self.admitted_at is None
+              else round((self.admitted_at - self.submitted_at) * 1000.0, 3))
+        ttft = self.ttft_ms
+        e2e = (None if self.finished_at is None
+               else round((self.finished_at - self.submitted_at) * 1000.0, 3))
+        return {"queue_wait_ms": qw,
+                "ttft_ms": None if ttft is None else round(ttft, 3),
+                "e2e_ms": e2e, "decode_tokens": self.produced}
 
     def tokens(self, poll=None, poll_s: float = 0.25):
         """Blocking iterator over generated tokens (ends on EOS/budget/cancel).
@@ -201,6 +218,7 @@ class Scheduler:
         # the dllama_decode_host_gap_seconds histogram.
         self._host_gap_ms: list[float] = []
         self._t_consumed: float | None = None
+        self._last_gap_ms: float | None = None  # latest host gap (trace arg)
         # mixed-batch speculation: when some active slot is spec-ineligible
         # (near seq_len or penalized), spec cycles freeze it — alternate spec
         # with plain decode chunks so it still advances (toggle state)
@@ -245,6 +263,10 @@ class Scheduler:
                       frozenset(eos_ids), seed=seed, presence=float(presence),
                       frequency=float(frequency), submitted_at=time.monotonic(),
                       req_id=req_id)
+        # flight-recorder record BEFORE the queue put: the worker may pop and
+        # admit the request before this thread runs again
+        trace.TRACER.req_submit(req.req_id, prompt_tokens=len(req.prompt),
+                                t=req.submitted_at)
         self.pending.put(req)
         ins.REQUESTS_ADMITTED.inc()
         ins.QUEUE_DEPTH.set(self.pending.qsize())
@@ -332,6 +354,8 @@ class Scheduler:
         means stragglers were cut off by shutdown."""
         self._draining.set()
         self._wake.set()
+        trace.TRACER.event("drain.begin", cat="lifecycle", track="scheduler",
+                           timeout_s=float(timeout_s))
         deadline = time.monotonic() + max(0.0, timeout_s)
         clean = False
         while time.monotonic() < deadline:
@@ -346,6 +370,8 @@ class Scheduler:
                         "%d queued still in flight — shutting down anyway",
                         timeout_s, len(self.slots), len(self._inflight),
                         self.pending.qsize())
+        trace.TRACER.event("drain.end", cat="lifecycle", track="scheduler",
+                           clean=clean)
         self.shutdown()
         return clean
 
@@ -429,8 +455,12 @@ class Scheduler:
         """The single registry write point for a terminal request: finish
         counter + TTFT/ITL/e2e histograms from the request's latency marks —
         the same marks the `_completed` ring (latency_summary's per-scheduler
-        view) records, so /metrics and the summary cannot disagree."""
+        view) records, so /metrics and the summary cannot disagree. Also the
+        single flight-recorder finish point: every terminal path (normal,
+        cancel, crash, shutdown, admission reject) flows through here."""
         ins.REQUESTS_FINISHED.labels(reason=req.finish_reason or "unknown").inc()
+        trace.TRACER.req_end(req.req_id, req.finish_reason or "unknown",
+                             t=req.finished_at, **req.timings())
         if req.first_token_at is not None:
             ins.TTFT_SECONDS.observe(req.first_token_at - req.submitted_at)
         if req.finished_at is not None:
@@ -463,6 +493,7 @@ class Scheduler:
         """Queue one token; returns True when the request just finished."""
         if req.first_token_at is None:
             req.first_token_at = time.monotonic()
+            trace.TRACER.req_first_token(req.req_id, t=req.first_token_at)
         req.out.put(int(token))
         req.produced += 1
         ins.TOKENS_GENERATED.inc()
@@ -585,6 +616,9 @@ class Scheduler:
                 req.out.put(e)
                 continue
             req.slot = slot
+            req.admitted_at = time.monotonic()
+            trace.TRACER.req_admitted(req.req_id, slot=slot,
+                                      reused_tokens=reuse, t=req.admitted_at)
             self._inflight.append((req, adm, reuse))
             reserved += 1
 
@@ -613,6 +647,8 @@ class Scheduler:
                 self._abort_admission(req, adm, "cancelled")
                 continue
             try:
+                tr = trace.TRACER
+                t_ch = tr.now() if tr.enabled else 0.0
                 done = self.engine.add_step(adm)
                 if self.slots and adm.logits is not None:
                     # sync whenever decoders could stall: JAX dispatch is
@@ -627,6 +663,11 @@ class Scheduler:
                     # device stream). With no decoders there is no stall to
                     # attribute and dispatch stays pipelined.
                     jax.block_until_ready(adm.logits)
+                if tr.enabled:
+                    tr.span_at("prefill.chunk", t_ch, tr.now(), cat="prefill",
+                               track="scheduler", req_id=req.req_id,
+                               slot=adm.slot, off=int(adm.off),
+                               total=len(adm.toks))
                 worked = True
                 if done:
                     first = self.engine.add_commit(adm, req.temperature, req.topp,
@@ -638,6 +679,8 @@ class Scheduler:
                     ins.REUSED_PREFIX_TOKENS.inc(reuse)
                     self.slot_tokens[adm.slot] = list(req.prompt)
                     self.slots[adm.slot] = req
+                    trace.TRACER.req_prefill_done(
+                        req.req_id, tokens=len(req.prompt), reused=reuse)
                     self._emit(req, first, int(self.engine.pos[adm.slot]))
             except Exception as e:
                 log.exception("prefill failed",
@@ -717,6 +760,8 @@ class Scheduler:
                     self.stalled = True
                     self.stall_count += 1
                     ins.WATCHDOG_STALLS.inc()
+                    trace.TRACER.event("watchdog.stall", cat="supervision",
+                                       track="scheduler", age_s=round(age, 3))
                     log.error(
                         "watchdog: scheduler worker silent for %.2fs with "
                         "work in flight (deadline %.2fs) — device chunk "
@@ -725,6 +770,8 @@ class Scheduler:
             elif self.stalled and age <= self.stall_deadline_s:
                 self.stalled = False
                 ins.WATCHDOG_RECOVERIES.inc()
+                trace.TRACER.event("watchdog.recover", cat="supervision",
+                                   track="scheduler")
                 log.warning("watchdog: worker heartbeat resumed; clearing "
                             "stall flag (%d total stalls)", self.stall_count)
 
@@ -781,10 +828,14 @@ class Scheduler:
         dispatch while a chunk is still in flight pays nothing — the device
         never went idle, which is the overlap win the A/B measures."""
         if self._t_consumed is None:
+            self._last_gap_ms = None
             return
         gap_s = (max(0.0, time.monotonic() - self._t_consumed - exclude_s)
                  if pipeline_empty else 0.0)
         ins.DECODE_HOST_GAP_SECONDS.observe(gap_s)
+        # stashed for the decode.dispatch span's host_gap_ms arg — the trace
+        # shows per-chunk what the histogram shows in aggregate
+        self._last_gap_ms = gap_s * 1000.0
         with self._metrics_lock:
             self._host_gap_ms.append(gap_s * 1000.0)
             del self._host_gap_ms[:-256]
@@ -822,16 +873,33 @@ class Scheduler:
                 self._spec_tick = not self._spec_tick
                 use_spec = self._spec_tick
         self._observe_host_gap(pipeline_empty, exclude_gap_s)
+        tr = trace.TRACER
         if use_spec:
             start_rows = {s: int(self.engine.pos[s]) for s in self.slots}
-            emit_toks, adv = self.engine.spec_step()
+            emit_toks, adv = self.engine.spec_step()  # records decode.spec
             self._t_dec_end = self._t_consumed = time.monotonic()
             for slot, req in list(self.slots.items()):
+                if tr.enabled and adv[slot]:
+                    tr.req_chunk(req.req_id, self.engine.chunk_seq,
+                                 int(adv[slot]))
                 for i in range(int(adv[slot])):
                     # row written when sampling token i: start + i (+1 = prefix len)
                     if self._emit(req, emit_toks[slot, i], start_rows[slot] + i + 1):
                         break
             return None
+        if tr.enabled:
+            t0 = tr.now()
+            chunk = self.engine.decode_dispatch(self.chunk)
+            # the dispatch span: pure host work. Under overlap it lands
+            # INSIDE the previous chunk's decode.device span — the
+            # interleaving scripts/trace_smoke.sh asserts on.
+            tr.span_at("decode.dispatch", t0, tr.now(), cat="decode",
+                       track="scheduler", chunk=chunk.seq, n=chunk.n,
+                       occupancy=len(self.slots),
+                       pipelined=not pipeline_empty,
+                       host_gap_ms=(None if self._last_gap_ms is None
+                                    else round(self._last_gap_ms, 3)))
+            return chunk, dict(self.slots)
         return self.engine.decode_dispatch(self.chunk), dict(self.slots)
 
     def _consume_chunk(self, chunk, snapshot) -> None:
@@ -842,15 +910,28 @@ class Scheduler:
         one-chunk stop overrun — discarded, with release(keep_rows=) having
         rewound the slot to the truly-emitted prefix, so the prefix cache
         never serves overrun rows."""
-        toks = self.engine.decode_consume(chunk)
+        tr = trace.TRACER
+        t0 = tr.now() if tr.enabled else 0.0
+        toks = self.engine.decode_consume(chunk)  # records decode.device
         self._t_dec_end = self._t_consumed = time.monotonic()
+        if tr.enabled:
+            tr.span_at("decode.consume", t0, tr.now(), cat="decode",
+                       track="scheduler", chunk=chunk.seq, n=chunk.n)
+            t_emit = tr.now()
         for slot, req in snapshot.items():
             if self.slots.get(slot) is not req:
                 continue  # finished mid-flight: overrun tokens discarded
+            if tr.enabled and chunk.advance[slot]:
+                # flight-recorder chunk entry BEFORE the tokens reach the
+                # client queue: a response never races its own record
+                tr.req_chunk(req.req_id, chunk.seq, int(chunk.advance[slot]))
             for i in range(int(chunk.advance[slot])):
                 # row written when sampling token i: start + i (+1 = prefix len)
                 if self._emit(req, toks[i, slot], int(chunk.start_pos[slot]) + i + 1):
                     break
+        if tr.enabled:
+            tr.span_at("emit.scan", t_emit, tr.now(), cat="decode",
+                       track="scheduler", chunk=chunk.seq)
 
     def _loop(self) -> None:
         # end of the previous decode chunk (stall metric); instance attribute

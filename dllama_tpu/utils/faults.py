@@ -44,6 +44,7 @@ import time
 from dataclasses import dataclass, field
 
 from dllama_tpu.obs import instruments as ins
+from dllama_tpu.obs import trace
 
 log = logging.getLogger("dllama_tpu.faults")
 
@@ -186,7 +187,10 @@ def fire(point: str) -> None:
         return
     # every activation is a countable incident: drills and live mishaps
     # alike show up at /metrics (dllama_fault_fires_total{point,action})
+    # AND on the request-flow trace timeline (/debug/trace)
     ins.FAULT_FIRES.labels(point=point, action=action).inc()
+    trace.TRACER.event("fault.fire", cat="fault", track="scheduler",
+                       point=point, action=action)
     if action == "delay":
         log.warning("injected delay at %r: %.0f ms", point, f.ms,
                     extra={"fault_point": point})

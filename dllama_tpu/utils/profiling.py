@@ -8,6 +8,10 @@ equivalents here:
 
 * :func:`trace` — jax.profiler device traces (view in XProf/TensorBoard); the
   idiomatic replacement for hand-timed executor steps.
+* :func:`start_profile` — the on-demand, duration-capped capture behind
+  ``POST /debug/profile``: same jax.profiler session as :func:`trace`
+  (one lock guards both, so a CLI ``--trace`` run and an HTTP capture can
+  never double-start the profiler), stopped by a timer thread.
 * :class:`TokenTimer` — host-side per-token latency recorder with the
   reference's report shape (avg/p50/p90 ms/token, tok/s).
 * :func:`collective_bytes_per_token` — analytic per-token inter-chip payload
@@ -19,6 +23,8 @@ equivalents here:
 from __future__ import annotations
 
 import contextlib
+import tempfile
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -26,16 +32,86 @@ import jax
 import numpy as np
 
 from dllama_tpu.obs import instruments as ins
+from dllama_tpu.obs import trace as reqtrace
+
+
+class ProfileBusy(RuntimeError):
+    """A jax.profiler capture is already running (there is exactly one
+    profiler session per process); the API tier maps this to HTTP 409."""
+
+
+#: the one-session profiler lock + state shared by trace() (CLI --trace)
+#: and start_profile() (POST /debug/profile)
+_prof_lock = threading.Lock()
+_prof_state = {"active": False, "dir": None, "started_at": 0.0,
+               "duration_s": None}
+
+#: hard cap on an on-demand capture: profiles are heavy (host callbacks +
+#: trace buffers); a forgotten long capture must not degrade serving forever
+MAX_PROFILE_SECONDS = 60.0
+
+
+def _profiler_begin(log_dir: str, duration_s: float | None = None) -> None:
+    with _prof_lock:
+        if _prof_state["active"]:
+            raise ProfileBusy(
+                f"a profiler capture is already running "
+                f"(dir={_prof_state['dir']!r}, started "
+                f"{time.time() - _prof_state['started_at']:.1f}s ago)")
+        jax.profiler.start_trace(log_dir)
+        _prof_state.update(active=True, dir=log_dir, started_at=time.time(),
+                           duration_s=duration_s)
+    reqtrace.TRACER.event("profile.start", cat="profile", track="profiler",
+                          dir=log_dir)
+
+
+def _profiler_end() -> None:
+    with _prof_lock:
+        if not _prof_state["active"]:
+            return
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            _prof_state.update(active=False, duration_s=None)
+    reqtrace.TRACER.event("profile.stop", cat="profile", track="profiler")
+
+
+def profile_status() -> dict:
+    """Snapshot of the profiler session (no secrets: dir + timing only)."""
+    with _prof_lock:
+        return {"active": _prof_state["active"], "dir": _prof_state["dir"],
+                "duration_s": _prof_state["duration_s"]}
+
+
+def start_profile(log_dir: str | None = None, duration_s: float = 2.0) -> dict:
+    """Start an on-demand jax.profiler capture and schedule its stop after
+    `duration_s` (clamped to [0.05, MAX_PROFILE_SECONDS]) on a timer thread.
+    Returns {dir, duration_s}; raises :class:`ProfileBusy` when a capture
+    (this one or a CLI ``--trace`` run) is already in flight — the caller
+    never blocks behind someone else's capture."""
+    duration_s = min(max(float(duration_s), 0.05), MAX_PROFILE_SECONDS)
+    if not log_dir:
+        log_dir = tempfile.mkdtemp(prefix="dllama_profile_")
+    _profiler_begin(str(log_dir), duration_s)
+    t = threading.Timer(duration_s, _profiler_end)
+    t.daemon = True  # a dying process must not hang on the stop timer
+    t.start()
+    return {"dir": str(log_dir), "duration_s": duration_s}
 
 
 @contextlib.contextmanager
 def trace(log_dir: str | None):
-    """jax.profiler.trace wrapper; no-op when log_dir is falsy."""
+    """jax.profiler trace over a with-block; no-op when log_dir is falsy.
+    Shares the process profiler session with :func:`start_profile`, so it
+    raises :class:`ProfileBusy` instead of corrupting a running capture."""
     if not log_dir:
         yield
         return
-    with jax.profiler.trace(log_dir):
+    _profiler_begin(str(log_dir))
+    try:
         yield
+    finally:
+        _profiler_end()
 
 
 @dataclass

@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# checks.sh — static hygiene gate for CI and pre-commit:
+#
+#   1. `python -m compileall` over the package, tests, and bench — syntax
+#      errors fail here in milliseconds instead of mid-suite;
+#   2. observability catalog drift check — every metric registered in
+#      dllama_tpu/obs/instruments.py and every span/event name in
+#      dllama_tpu/obs/trace.{SPAN,EVENT}_CATALOG must appear in README.md's
+#      Observability tables. The catalogs are the single definition sites;
+#      this keeps the docs from silently rotting when an instrument or a
+#      trace point is added.
+#
+# Pure host: imports only dllama_tpu.obs (stdlib-only — no jax, no model),
+# so it runs anywhere in <1s. Exit 0 = PASS.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m compileall -q dllama_tpu tests scripts bench.py
+echo "checks: compileall OK"
+
+python - <<'PY'
+import sys
+
+from dllama_tpu.obs import metrics  # noqa: F401  (registry core)
+from dllama_tpu.obs import instruments  # noqa: F401  (registers every metric)
+from dllama_tpu.obs import trace
+
+with open("README.md", encoding="utf-8") as f:
+    readme = f.read()
+
+missing = []
+for name in metrics.REGISTRY.names():
+    if name not in readme:
+        missing.append(f"metric:{name}")
+for name in sorted(trace.SPAN_CATALOG):
+    if name not in readme:
+        missing.append(f"span:{name}")
+for name in sorted(trace.EVENT_CATALOG):
+    if name not in readme:
+        missing.append(f"event:{name}")
+
+if missing:
+    sys.exit("README observability-catalog drift — document these in the "
+             "README tables: " + ", ".join(missing))
+print(f"checks: catalog drift OK ({len(metrics.REGISTRY.names())} metrics, "
+      f"{len(trace.SPAN_CATALOG)} spans, {len(trace.EVENT_CATALOG)} events "
+      "all documented)")
+PY

@@ -426,6 +426,149 @@ def test_metrics_scrape_concurrent_with_generation(mserver):
     assert results["resp"][0] == 200
 
 
+def test_build_info_gauge_and_health_build(mserver):
+    """dllama_tpu_build_info: value 1, labels carry version/jax/backend/
+    overlap; the same payload rides /health as the `build` object."""
+    port, _api, _ = mserver
+    st, data, _ = _get_raw(port, "/metrics")
+    assert st == 200
+    m = re.search(r'^dllama_tpu_build_info\{([^}]*)\} 1$', data.decode(), re.M)
+    assert m, "dllama_tpu_build_info missing from /metrics"
+    labels = dict(re.findall(r'(\w+)="([^"]*)"', m.group(1)))
+    assert labels["overlap"] == "on"  # mserver runs the default pipeline
+    assert labels["backend"] == "cpu" and labels["version"] and labels["jax"]
+    st, data, _ = _get_raw(port, "/health")
+    build = json.loads(data)["build"]
+    assert build == labels
+
+
+def test_timings_object_and_flight_recorder(mserver):
+    """Non-stream responses carry a span-sourced `timings` object; the same
+    request is replayable from GET /debug/requests/{req_id} with prefill
+    and per-chunk detail (the flight recorder)."""
+    port, _api, _ = mserver
+    st, data, _ = _post_raw(
+        port, "/v1/chat/completions",
+        {"messages": [{"role": "user", "content": "hello there"}],
+         "max_tokens": 9, "temperature": 0.0})
+    assert st == 200
+    body = json.loads(data)
+    rid = body["request_id"]
+    t = body["timings"]
+    assert set(t) == {"queue_wait_ms", "ttft_ms", "e2e_ms", "decode_tokens"}
+    assert t["decode_tokens"] == body["usage"]["completion_tokens"]
+    assert t["e2e_ms"] >= t["ttft_ms"] >= t["queue_wait_ms"] >= 0
+
+    st, data, _ = _get_raw(port, f"/debug/requests/{rid}")
+    assert st == 200
+    rec = json.loads(data)
+    assert rec["state"] == "finished"
+    assert rec["finish_reason"] in ("stop", "length")
+    assert rec["prompt_tokens"] > 0
+    assert rec["prefill"]["tokens"] == rec["prompt_tokens"]
+    assert len(rec["chunks"]) >= 1  # at least one fused decode chunk
+    assert sum(c["tokens"] for c in rec["chunks"]) >= t["decode_tokens"] - 1
+    assert rec["ttft_ms"] == pytest.approx(t["ttft_ms"], abs=1.0)
+
+    st, data, _ = _get_raw(port, "/debug/requests")
+    ids = [r["req_id"] for r in json.loads(data)["requests"]]
+    assert rid in ids
+
+    st, data, _ = _get_raw(port, "/debug/requests/req_nonexistent")
+    assert st == 404
+
+
+def test_stream_final_event_carries_timings(mserver):
+    """The last SSE data event (finish_reason set) carries the same
+    `timings` object non-stream responses embed."""
+    port, _api, _ = mserver
+    st, data, _ = _post_raw(
+        port, "/v1/chat/completions",
+        {"messages": [{"role": "user", "content": "hi"}],
+         "max_tokens": 6, "temperature": 0.0, "stream": True})
+    assert st == 200
+    payloads = [json.loads(line[len("data: "):])
+                for line in data.decode().splitlines()
+                if line.startswith("data: ") and line != "data: [DONE]"]
+    final = [p for p in payloads
+             if p.get("choices") and p["choices"][0].get("finish_reason")]
+    assert final, "no finish event in the stream"
+    t = final[-1]["timings"]
+    assert set(t) == {"queue_wait_ms", "ttft_ms", "e2e_ms", "decode_tokens"}
+    assert t["decode_tokens"] >= 1
+
+
+def test_debug_trace_exports_chrome_json_and_skips_admission_counters(mserver):
+    """/debug/trace is loadable Chrome trace JSON whose decode spans expose
+    the pipeline; /debug/* GETs never move the request-admission counters
+    (they are observability reads, not requests)."""
+    port, _api, _ = mserver
+    # a fresh completion guarantees recent decode spans in the ring
+    st, _, _ = _post_raw(
+        port, "/v1/chat/completions",
+        {"messages": [{"role": "user", "content": "hi"}],
+         "max_tokens": 8, "temperature": 0.0})
+    assert st == 200
+    admitted = val("dllama_requests_admitted_total")
+    st, data, _ = _get_raw(port, "/debug/trace")
+    assert st == 200
+    doc = json.loads(data)
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"] for e in evs}
+    assert {"decode.dispatch", "decode.consume", "decode.device",
+            "prefill.chunk", "queue.wait", "request"} <= names
+    # non-decreasing ts per track (the Perfetto-load contract)
+    by_tid = {}
+    for e in doc["traceEvents"]:
+        if e.get("ph") in ("X", "i"):
+            by_tid.setdefault(e["tid"], []).append(e["ts"])
+    for tid, ts in by_tid.items():
+        assert ts == sorted(ts)
+    st, _, _ = _get_raw(port, "/debug/requests")
+    assert st == 200
+    assert val("dllama_requests_admitted_total") == admitted
+    # the responses themselves ARE counted (http observability keeps working)
+    assert val("dllama_http_responses_total",
+               {"endpoint": "/debug/trace", "code": "200"}) >= 1
+
+
+def test_debug_profile_starts_and_conflicts_409(mserver, tmp_path, monkeypatch):
+    """POST /debug/profile starts a duration-capped capture; a second POST
+    while one runs is 409 + Retry-After; the slot frees after the timer.
+    The jax profiler itself is stubbed — the HTTP/session contract is what
+    this test pins (the real capture is exercised by the E2E smoke)."""
+    from dllama_tpu.utils import profiling
+
+    monkeypatch.setattr(profiling.jax.profiler, "start_trace",
+                        lambda log_dir: None)
+    monkeypatch.setattr(profiling.jax.profiler, "stop_trace", lambda: None)
+    port, _api, _ = mserver
+    st, data, _ = _post_raw(port, "/debug/profile",
+                            {"duration_s": 0.3, "dir": str(tmp_path / "p")})
+    assert st == 200
+    info = json.loads(data)["profiling"]
+    assert info["duration_s"] == pytest.approx(0.3)
+    assert info["dir"] == str(tmp_path / "p")
+    st, data, headers = _post_raw(port, "/debug/profile", {"duration_s": 0.3})
+    assert st == 409
+    assert "Retry-After" in headers
+    assert "already running" in json.loads(data)["error"]["message"]
+    deadline = time.time() + 10
+    while profiling.profile_status()["active"] and time.time() < deadline:
+        time.sleep(0.02)
+    assert not profiling.profile_status()["active"]
+    # the session is reusable once the timer released it
+    st, data, _ = _post_raw(port, "/debug/profile",
+                            {"duration_s": 0.05, "dir": str(tmp_path / "p2")})
+    assert st == 200
+    deadline = time.time() + 10
+    while profiling.profile_status()["active"] and time.time() < deadline:
+        time.sleep(0.02)
+    # malformed duration is a client error, not a wedged session
+    st, data, _ = _post_raw(port, "/debug/profile", {"duration_s": "soon"})
+    assert st == 400
+
+
 def test_crash_path_marks_error_and_counts_fault_fires(mserver):
     """Worker-crash telemetry: finished{reason=error} and
     fault_fires{engine.decode} advance, and /metrics still answers on a dead

@@ -1,0 +1,272 @@
+"""Request-flow tracer tests (dllama_tpu/obs/trace.py): ring bounding and
+eviction, span nesting + req_id correlation, the Chrome trace-event export
+contract, the disabled no-op fast path, flight-recorder lifecycle, and
+concurrent writers.
+
+Pure host — no engine, no model — so the whole file runs in milliseconds
+(tier-1 is time-budgeted; the HTTP /debug endpoints are covered in
+tests/test_metrics.py on its already-booted server, and end-to-end through
+the real CLI by scripts/trace_smoke.sh)."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from dllama_tpu.obs import trace
+
+
+def events(tr):
+    return tr.export_chrome()["traceEvents"]
+
+
+def spans(tr):
+    return [e for e in events(tr) if e.get("ph") == "X"]
+
+
+def per_track_ts(doc):
+    by_tid = {}
+    for e in doc["traceEvents"]:
+        if e.get("ph") in ("X", "i"):
+            by_tid.setdefault(e["tid"], []).append(e["ts"])
+    return by_tid
+
+
+# ------------------------------------------------------------ ring buffer
+
+
+def test_ring_bounds_and_evicts_oldest():
+    tr = trace.Tracer(8)
+    for i in range(50):
+        tr.event(f"e{i}", track="t")
+    evs = [e for e in events(tr) if e["ph"] == "i"]
+    assert len(evs) == 8
+    # eviction is FIFO: the survivors are exactly the newest 8
+    assert [e["name"] for e in evs] == [f"e{i}" for i in range(42, 50)]
+    assert tr.stats()["dropped"] == 42
+    assert tr.stats()["events"] == 8
+
+
+def test_reset_clears_events_and_requests():
+    tr = trace.Tracer(16)
+    tr.event("e", track="t")
+    tr.req_submit("req_1")
+    tr.reset()
+    assert tr.stats() == {"enabled": True, "capacity": 16, "events": 0,
+                          "dropped": 0, "requests": 0}
+    assert tr.request_timeline("req_1") is None
+
+
+# ------------------------------------------------------- spans and export
+
+
+def test_span_nesting_and_req_id_correlation():
+    tr = trace.Tracer(64)
+    with tr.span("outer", req_id="req_1", track="work"):
+        with tr.span("inner", req_id="req_1", track="work", step=3):
+            pass
+    sp = spans(tr)
+    # the inner span ENDS first (so enters the ring first) but the export is
+    # start-ordered: outer leads, and at equal-ts ties the longer span wins
+    assert [s["name"] for s in sp] == ["outer", "inner"]
+    assert sp[0]["ts"] <= sp[1]["ts"]
+    # nesting: inner is contained in outer
+    assert sp[0]["ts"] + sp[0]["dur"] >= sp[1]["ts"] + sp[1]["dur"]
+    # both carry the req_id in args — the grep key across spans/logs/metrics
+    assert all(s["args"]["req_id"] == "req_1" for s in sp)
+    assert sp[1]["args"]["step"] == 3
+
+
+def test_span_end_merges_extra_args():
+    tr = trace.Tracer(8)
+    s = tr.span("s", track="t", a=1)
+    s.end(b=2)
+    (sp,) = spans(tr)
+    assert sp["args"]["a"] == 1 and sp["args"]["b"] == 2
+
+
+def test_chrome_export_is_valid_json_and_ts_nondecreasing_per_track():
+    tr = trace.Tracer(64)
+    now = tr.now()
+    # recorded OUT of start order on purpose: the export must sort
+    tr.span_at("late", now + 0.020, now + 0.030, track="x")
+    tr.span_at("early", now, now + 0.010, track="x")
+    tr.span_at("other", now + 0.005, now + 0.006, track="y")
+    tr.event("mark", track="y")
+    doc = json.loads(json.dumps(tr.export_chrome()))  # JSON round-trips
+    for tid, ts in per_track_ts(doc).items():
+        assert ts == sorted(ts), f"track {tid} ts not monotone: {ts}"
+    # tracks are named via thread_name metadata (what Perfetto displays)
+    meta = {e["args"]["name"] for e in doc["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert meta == {"x", "y"}
+    # complete events have non-negative durations
+    assert all(e["dur"] >= 0 for e in doc["traceEvents"] if e.get("ph") == "X")
+
+
+def test_args_are_sanitized_to_json_scalars():
+    tr = trace.Tracer(8)
+    tr.event("e", track="t", n=np.int32(4), f=np.float64(0.5),
+             arr=np.arange(2), none=None, ok="s")
+    text = json.dumps(tr.export_chrome())  # must not raise on numpy types
+    (ev,) = [e for e in json.loads(text)["traceEvents"] if e.get("ph") == "i"]
+    assert ev["args"]["n"] == 4 and ev["args"]["f"] == 0.5
+    assert isinstance(ev["args"]["arr"], str)  # exotic types degrade to str
+
+
+# --------------------------------------------------------- disabled mode
+
+
+def test_disabled_mode_emits_nothing_and_allocates_no_spans():
+    prev = trace.TRACER
+    try:
+        tr = trace.configure(0)
+        assert tr is trace.TRACER and not tr.enabled
+        # span() hands back ONE shared null span — no per-call allocation
+        assert tr.span("x", big=1) is tr.span("y")
+        with tr.span("z"):
+            pass
+        tr.span_at("s", 0.0, 1.0, track="t")
+        tr.event("e")
+        tr.req_submit("req_1", prompt_tokens=3)
+        tr.req_admitted("req_1", slot=0)
+        tr.req_prefill_done("req_1", tokens=3)
+        tr.req_first_token("req_1")
+        tr.req_chunk("req_1", 1, 4)
+        tr.req_mark("req_1", state="decoding")
+        tr.req_end("req_1", "stop")
+        assert tr.export_chrome() == {"traceEvents": []}
+        assert tr.requests_summary() == []
+        assert tr.request_timeline("req_1") is None
+        assert tr.stats()["events"] == 0 and tr.stats()["enabled"] is False
+    finally:
+        trace.TRACER = prev
+
+
+def test_configure_swaps_the_global_tracer():
+    prev = trace.TRACER
+    try:
+        tr = trace.configure(16)
+        assert trace.TRACER is tr and tr.enabled and tr.capacity == 16
+        tr0 = trace.configure(0)
+        assert trace.TRACER is tr0 and tr0 is trace.NULL_TRACER
+    finally:
+        trace.TRACER = prev
+
+
+# ------------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_lifecycle_and_derived_timings():
+    tr = trace.Tracer(64)
+    t0 = tr.now()
+    tr.req_submit("req_a", prompt_tokens=7, t=t0)
+    tr.req_admitted("req_a", slot=1, reused_tokens=2, t=t0 + 0.010)
+    tr.req_prefill_done("req_a", tokens=7, reused=2, t=t0 + 0.030)
+    tr.req_first_token("req_a", t=t0 + 0.035)
+    tr.req_chunk("req_a", 5, 4, t=t0 + 0.040)
+    tr.req_chunk("req_a", 6, 4, t=t0 + 0.045)
+    tr.req_end("req_a", "stop", t=t0 + 0.050,
+               queue_wait_ms=10.0, ttft_ms=35.0, e2e_ms=50.0, decode_tokens=9)
+    rec = tr.request_timeline("req_a")
+    assert rec["state"] == "finished" and rec["finish_reason"] == "stop"
+    assert rec["prompt_tokens"] == 7 and rec["slot"] == 1
+    assert rec["reused_tokens"] == 2
+    assert rec["queue_wait_ms"] == pytest.approx(10.0)
+    assert rec["prefill"]["tokens"] == 7
+    assert rec["prefill"]["ms"] == pytest.approx(20.0)
+    assert rec["ttft_ms"] == pytest.approx(35.0)
+    assert rec["e2e_ms"] == pytest.approx(50.0)
+    assert rec["decode_tokens"] == 9
+    assert [c["chunk"] for c in rec["chunks"]] == [5, 6]
+    assert [c["tokens"] for c in rec["chunks"]] == [4, 4]
+    # internal monotonic marks never leak into the JSON payload
+    assert not any(k.startswith("_") for k in rec)
+    # the lifecycle auto-emits the request-track spans
+    names = [s["name"] for s in spans(tr)]
+    assert {"queue.wait", "prefill", "request"} <= set(names)
+    # and the list view summarizes it
+    (summary,) = tr.requests_summary()
+    assert summary["req_id"] == "req_a" and summary["chunks"] == 2
+    assert "slot" not in summary  # detail keys stay in the full record
+
+
+def test_flight_recorder_derives_timings_without_explicit_overrides():
+    tr = trace.Tracer(64)
+    t0 = tr.now()
+    tr.req_submit("req_b", t=t0)
+    tr.req_admitted("req_b", t=t0 + 0.004)
+    tr.req_first_token("req_b", t=t0 + 0.008)
+    tr.req_end("req_b", "length", t=t0 + 0.016)
+    rec = tr.request_timeline("req_b")
+    assert rec["queue_wait_ms"] == pytest.approx(4.0, abs=0.01)
+    assert rec["ttft_ms"] == pytest.approx(8.0, abs=0.01)
+    assert rec["e2e_ms"] == pytest.approx(16.0, abs=0.01)
+
+
+def test_request_ring_bounded_evicts_oldest():
+    tr = trace.Tracer(64, max_requests=4)
+    for i in range(10):
+        tr.req_submit(f"req_{i}")
+        tr.req_end(f"req_{i}", "stop")
+    ids = [r["req_id"] for r in tr.requests_summary()]
+    assert ids == [f"req_{i}" for i in range(6, 10)]
+    assert tr.request_timeline("req_0") is None
+    assert tr.request_timeline("req_9") is not None
+
+
+def test_chunk_list_bounded_keeps_the_tail():
+    tr = trace.Tracer(8, max_chunks_per_request=16)
+    tr.req_submit("req_x")
+    for i in range(50):
+        tr.req_chunk("req_x", i, 4)
+    rec = tr.request_timeline("req_x")
+    assert len(rec["chunks"]) == 16
+    assert rec["chunks_dropped"] == 34
+    # the TAIL survives: a postmortem cares how the request ended
+    assert [c["chunk"] for c in rec["chunks"]] == list(range(34, 50))
+
+
+def test_empty_req_id_records_nothing():
+    tr = trace.Tracer(8)
+    tr.req_submit("", prompt_tokens=3)
+    tr.req_chunk("", 1, 4)
+    tr.req_end("", "stop")
+    assert tr.requests_summary() == []
+    assert tr.stats()["events"] == 0  # no auto-spans either
+
+
+# ------------------------------------------------------------ concurrency
+
+
+def test_concurrent_writers_ring_stays_bounded_and_exports_clean():
+    tr = trace.Tracer(256)
+    errors = []
+
+    def work(k):
+        try:
+            for i in range(120):
+                with tr.span(f"s{k}", track=f"tr{k % 3}", i=i):
+                    pass
+                if i % 7 == 0:
+                    tr.event(f"ev{k}", track=f"tr{k % 3}")
+                rid = f"req_{k}_{i}"
+                tr.req_submit(rid, prompt_tokens=1)
+                tr.req_chunk(rid, i, 1)
+                tr.req_end(rid, "stop")
+        except Exception as e:  # noqa: BLE001 — surfaced via the list
+            errors.append(e)
+
+    threads = [threading.Thread(target=work, args=(k,)) for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    doc = json.loads(json.dumps(tr.export_chrome()))
+    recorded = [e for e in doc["traceEvents"] if e.get("ph") in ("X", "i")]
+    assert len(recorded) == 256  # ring bound honored under contention
+    for tid, ts in per_track_ts(doc).items():
+        assert ts == sorted(ts)
+    assert len(tr.requests_summary()) == tr.max_requests
