@@ -61,6 +61,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="KV cache element type; f8 (e4m3) halves cache HBM "
                         "traffic/footprint — 2x the slots or context per chip "
                         "at a small accuracy cost")
+    p.add_argument("--kv-layout", choices=["dense", "paged"], default="dense",
+                   help="serve mode, needs --slots > 0: KV cache layout. "
+                        "'paged' backs slots with a refcounted page pool + "
+                        "block tables instead of a full per-slot context "
+                        "reservation — bit-exact token streams, prefix reuse "
+                        "shares pages copy-free, and admission becomes "
+                        "capacity-aware (defers when the pool can't cover "
+                        "prompt + one decode page). 'dense' stays default "
+                        "until a TPU window times the paged path")
+    p.add_argument("--page-size", type=int, default=128,
+                   help="paged KV cache: rows per page (must divide the "
+                        "context length; 128 keeps pages flash-tileable)")
+    p.add_argument("--kv-pages", type=int, default=0,
+                   help="paged KV cache: pool size in pages; 0 = full "
+                        "coverage (slots x context / page-size — same "
+                        "capacity as dense). Smaller pools overcommit "
+                        "capacity: more slots than HBM could densely hold, "
+                        "admission-gated by actual page demand")
     p.add_argument("--max-prefill-chunk", type=int, default=256,
                    help="prefill chunk cap (pow-2 chunks; larger = better MXU "
                         "utilization, more HBM for activations)")
@@ -354,6 +372,9 @@ def cmd_serve(args) -> int:
         stall_deadline_s=args.stall_deadline_s,
         drain_timeout_s=args.drain_timeout_s,
         overlap=args.overlap == "on",
+        kv_layout=args.kv_layout,
+        page_size=args.page_size,
+        kv_pages=args.kv_pages,
     )
 
 

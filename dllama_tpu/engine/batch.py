@@ -35,7 +35,7 @@ import numpy as np
 from dllama_tpu.engine.engine import pow2_chunk
 from dllama_tpu.engine.sampling import sample_logits
 from dllama_tpu.models.config import LlamaConfig
-from dllama_tpu.models.llama import KVCache, forward
+from dllama_tpu.models.llama import KVCache, PagedKVCache, forward
 from dllama_tpu.obs import instruments as ins
 from dllama_tpu.obs import trace
 from dllama_tpu.utils import faults
@@ -45,6 +45,203 @@ class AdmissionAborted(RuntimeError):
     """A cooperative abort fired between prefill chunks of add() — the slot
     is released-equivalent (pos unspecified); callers must not reuse its
     cached rows."""
+
+
+class PageExhausted(RuntimeError):
+    """The paged KV pool cannot cover a requested allocation. The serving
+    scheduler never lets this surface (it checks admission_deficit() and
+    defers/evicts first); direct library callers of add() see it when their
+    pool is undersized for the prompt."""
+
+
+class PagePool:
+    """Host-side refcounted page allocator for the paged KV cache layout.
+
+    Owns the per-slot block tables (numpy mirrors of PagedKVCache.tables),
+    the per-page refcounts, and the free list. Pages are the allocation
+    quantum: a slot's logical rows [0, n_blocks*page_size) are backed, one
+    page per block, and a page referenced by several tables (prefix sharing)
+    is freed only when its last reference drops. All methods are host-only
+    and called from the engine under the scheduler worker thread; device
+    copies needed by copy-on-write are performed by the engine-supplied
+    ``copy_fn(src_page, dst_page)`` callback.
+
+    Publishes the dllama_kv_pages_{total,used,shared} gauges after every
+    mutation — the pool is the single owner of those series."""
+
+    def __init__(self, n_pages: int, page_size: int, n_slots: int,
+                 max_blocks: int):
+        if n_pages < 2:
+            raise ValueError(
+                f"kv_pages={n_pages}: the pool needs at least a prompt page "
+                "and a decode page")
+        self.n_pages = n_pages
+        self.page_size = page_size
+        self.max_blocks = max_blocks
+        self.refcount = np.zeros(n_pages, np.int32)
+        self.tables = np.zeros((n_slots, max_blocks), np.int32)
+        self.n_blocks = np.zeros(n_slots, np.int32)
+        self._free: list[int] = list(range(n_pages - 1, -1, -1))
+        self._publish()
+
+    # ----------------------------------------------------------- accounting
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def shared_count(self) -> int:
+        return int(np.count_nonzero(self.refcount > 1))
+
+    def blocks_for(self, rows: int) -> int:
+        return -(-int(rows) // self.page_size)
+
+    def covered_rows(self, slot: int) -> int:
+        """Rows of `slot` with backing pages (its decode row limit)."""
+        return int(self.n_blocks[slot]) * self.page_size
+
+    def stats(self) -> dict:
+        return {"total": self.n_pages, "free": self.free_count,
+                "used": self.n_pages - self.free_count,
+                "shared": self.shared_count, "page_size": self.page_size}
+
+    def _publish(self) -> None:
+        ins.KV_PAGES_TOTAL.set(self.n_pages)
+        ins.KV_PAGES_USED.set(self.n_pages - self.free_count)
+        ins.KV_PAGES_SHARED.set(self.shared_count)
+
+    # ------------------------------------------------------------ primitives
+
+    def _alloc_page(self) -> int:
+        if not self._free:
+            raise PageExhausted(
+                f"page pool exhausted ({self.n_pages} pages of "
+                f"{self.page_size} rows, all referenced)")
+        p = self._free.pop()
+        self.refcount[p] = 1
+        return p
+
+    def _decref(self, p: int) -> None:
+        self.refcount[p] -= 1
+        if self.refcount[p] == 0:
+            self._free.append(p)
+
+    def grow(self, slot: int, rows: int, best_effort: bool = False) -> bool:
+        """Extend `slot`'s table until its pages cover `rows` logical rows.
+        All-or-nothing unless best_effort (then: allocate what the free list
+        holds and stop). Returns True when the table changed."""
+        need = self.blocks_for(rows) - int(self.n_blocks[slot])
+        if need <= 0:
+            return False
+        if not best_effort and need > self.free_count:
+            self._publish()
+            raise PageExhausted(
+                f"slot {slot} needs {need} pages to reach row {rows}; "
+                f"{self.free_count} free of {self.n_pages}")
+        changed = False
+        for _ in range(need):
+            if not self._free:
+                break
+            self.tables[slot, self.n_blocks[slot]] = self._alloc_page()
+            self.n_blocks[slot] += 1
+            changed = True
+        if changed:
+            self._publish()
+        return changed
+
+    def free_tail(self, slot: int, keep_rows: int) -> int:
+        """Drop `slot`'s blocks past the one containing row keep_rows-1
+        (all of them for keep_rows == 0). Returns pages actually returned
+        to the free list (shared pages just lose one reference). keep_rows
+        past the covered range keeps everything — n_blocks must never GROW
+        here (that would fabricate coverage backed by unallocated pages)."""
+        keep = min(self.blocks_for(keep_rows), int(self.n_blocks[slot]))
+        freed = 0
+        for b in range(keep, int(self.n_blocks[slot])):
+            p = int(self.tables[slot, b])
+            before = self.free_count
+            self._decref(p)
+            freed += self.free_count - before
+            self.tables[slot, b] = 0
+        if self.n_blocks[slot] != keep:
+            self.n_blocks[slot] = keep
+            self._publish()
+        return freed
+
+    def ensure_writable(self, slot: int, row: int, copy_fn) -> None:
+        """Copy-on-write: make the page holding `row` exclusively owned by
+        `slot` before it is (partially) rewritten — a shared page's other
+        referents keep the original bytes. copy_fn(src_page, dst_page)
+        performs the device copy."""
+        b = int(row) // self.page_size
+        if b >= int(self.n_blocks[slot]):
+            return
+        old = int(self.tables[slot, b])
+        if self.refcount[old] <= 1:
+            return
+        new = self._alloc_page()
+        copy_fn(old, new)
+        self.refcount[old] -= 1  # > 1 before, so never frees
+        self.tables[slot, b] = new
+        self._publish()
+
+    def share_prefix(self, src: int, dst: int, rows: int, copy_fn) -> None:
+        """Make dst's first `rows` rows alias src's pages: full pages are
+        refcounted (zero copy), a partial boundary page is cloned into a
+        fresh page (its tail will diverge immediately). Drops whatever dst
+        held before."""
+        self.free_tail(dst, 0)
+        full, part = divmod(int(rows), self.page_size)
+        for b in range(full):
+            p = int(self.tables[src, b])
+            self.refcount[p] += 1
+            self.tables[dst, b] = p
+        self.n_blocks[dst] = full
+        if part:
+            new = self._alloc_page()
+            copy_fn(int(self.tables[src, full]), new)
+            self.tables[dst, full] = new
+            self.n_blocks[dst] = full + 1
+        self._publish()
+
+    def prepare_admission(self, slot: int, start: int, end: int, copy_fn) -> None:
+        """Position `slot` for a prefill of rows [start, end): drop the dead
+        tail past start, copy-on-write the boundary page when it is both
+        kept and shared (rows [block_start, start) must survive the
+        overwrite of [start, ...)), then allocate pages through `end`."""
+        self.free_tail(slot, start)
+        if start % self.page_size:
+            self.ensure_writable(slot, start, copy_fn)
+        self.grow(slot, end)
+
+    def admission_deficit(self, slot: int, reuse: int, total_rows: int,
+                          cross: bool) -> int:
+        """How many pages the pool is SHORT for admitting a `total_rows`
+        prompt into `slot` with `reuse` prefix rows already resolved
+        (`cross`: the prefix arrives by share_prefix from another slot) —
+        including one reserve page so the first decode rows after the
+        prompt cannot immediately starve. 0 means the admission fits."""
+        req = self.blocks_for(total_rows) + 1  # +1 decode-page reserve
+        if cross:
+            kept = int(reuse) // self.page_size  # full shared blocks are free
+            avail = self.free_count + self._tail_refund(slot, 0)
+        else:
+            kept = min(int(self.n_blocks[slot]), self.blocks_for(reuse))
+            avail = self.free_count + self._tail_refund(slot, reuse)
+            b = int(reuse) // self.page_size
+            if (reuse % self.page_size and b < int(self.n_blocks[slot])
+                    and self.refcount[int(self.tables[slot, b])] > 1):
+                req += 1  # boundary copy-on-write page
+        return max(0, req - kept - avail)
+
+    def _tail_refund(self, slot: int, keep_rows: int) -> int:
+        """Pages free_tail(slot, keep_rows) would return to the free list."""
+        keep = self.blocks_for(keep_rows)
+        return sum(
+            1 for b in range(keep, int(self.n_blocks[slot]))
+            if self.refcount[int(self.tables[slot, b])] == 1
+        )
 
 
 def _sample_rows(logits, keys, temps, topps):
@@ -113,6 +310,15 @@ class BatchEngine:
         # batch (spec_step); 0 = off. Greedy slots emit 1..K+1 exact-argmax
         # tokens per verify forward; sampled slots advance exactly 1.
         spec_ngram: int = 2,
+        kv_layout: str = "dense",  # 'dense' | 'paged' (--kv-layout): paged
+        # replaces the per-slot [seq_len] reservation with a global page pool
+        # + block tables — bit-exact vs dense, capacity decoupled from slots
+        page_size: int = 128,  # paged: rows per page (must divide seq_len)
+        kv_pages: int = 0,  # paged: pool size in pages; 0 = full coverage
+        # (n_slots * seq_len/page_size — semantically identical to dense).
+        # Smaller pools overcommit: admission becomes capacity-aware in the
+        # serving scheduler, and slots freeze per-row at their allocated
+        # limit when the pool runs dry mid-decode.
     ):
         from dllama_tpu.ops.layers import build_rope_cache
 
@@ -129,7 +335,30 @@ class BatchEngine:
         self.seq_len = min(max_seq_len or cfg.seq_len, cfg.seq_len)
         self.max_prefill_chunk = max_prefill_chunk
         self.rope_cache = build_rope_cache(cfg, self.seq_len)
-        self.cache = KVCache.create(cfg, n_slots, cache_dtype, self.seq_len)
+        if kv_layout not in ("dense", "paged"):
+            raise ValueError(f"kv_layout must be dense|paged, got {kv_layout!r}")
+        self.kv_layout = kv_layout
+        self.page_size = int(page_size)
+        self.pool: PagePool | None = None
+        if kv_layout == "paged":
+            if shardings is not None:
+                raise ValueError(
+                    "paged KV cache requires an unsharded engine (the page "
+                    "pool has no slot axis for a mesh to shard); use "
+                    "kv_layout='dense' on meshes")
+            if self.page_size <= 0 or self.seq_len % self.page_size:
+                raise ValueError(
+                    f"page_size {self.page_size} must divide the context "
+                    f"length {self.seq_len} (paged attention keeps the "
+                    "logical view the same shape as the dense cache, which "
+                    "is what makes it bit-exact)")
+            max_blocks = self.seq_len // self.page_size
+            n_pages = int(kv_pages) or max_blocks * n_slots
+            self.pool = PagePool(n_pages, self.page_size, n_slots, max_blocks)
+            self.cache = PagedKVCache.create(
+                cfg, n_slots, n_pages, self.page_size, cache_dtype, max_blocks)
+        else:
+            self.cache = KVCache.create(cfg, n_slots, cache_dtype, self.seq_len)
         if shardings is not None:
             if shardings.mesh.shape["sp"] > 1 or shardings.mesh.shape["pp"] > 1:
                 # per-slot vector positions don't fit the sp shard_map masks or
@@ -183,6 +412,9 @@ class BatchEngine:
         self._topp_dev = None
         self._pres_dev = None
         self._freq_dev = None
+        self._limit_dev = None  # i32[B] per-slot decode row limit: seq_len
+        # on dense, min(seq_len, allocated pages * page_size) on paged —
+        # the scans freeze rows at it exactly like the old seq_len edge
         # when the previous chunk's tokens materialized (perf_counter): the
         # DECODE_CHUNK_SECONDS clock for an overlapped chunk starts at the
         # LATER of its dispatch and this — a chunk dispatched while its
@@ -201,7 +433,9 @@ class BatchEngine:
         # kernel selection shared with InferenceEngine (engine/kernel_select.py)
         from dllama_tpu.engine.kernel_select import resolve_kernels
 
-        sel = resolve_kernels(cfg, self.seq_len, n_slots, kernels, attn_impl, shardings)
+        sel = resolve_kernels(cfg, self.seq_len, n_slots, kernels, attn_impl,
+                              shardings, paged=self.pool is not None,
+                              page_size=self.page_size)
         mm, mm_in, attn_fn = sel.mm, sel.mm_in, sel.attn_fn
         self.backend = sel.backend
 
@@ -209,15 +443,21 @@ class BatchEngine:
             partial(self._prefill_impl, cfg, attn_fn, self._col_fn, mm, mm_in, moe_impl),
             donate_argnums=(1,),
         )
+        slot_prefill = (self._prefill_slot_paged_impl if self.pool is not None
+                        else self._prefill_slot_impl)
         self._prefill_slot = jax.jit(
-            partial(self._prefill_slot_impl, cfg, attn_fn, self._col_fn, mm, mm_in, moe_impl),
+            partial(slot_prefill, cfg, attn_fn, self._col_fn, mm, mm_in, moe_impl),
             donate_argnums=(1,),
         )
         # admission prefill sliced to one slot runs the forward at B=1 —
         # admission cost independent of n_slots. Needs the batch axis
         # unsharded (a dp mesh shards slots across chips; slicing one slot
         # would cross shards), so dp>1 keeps the masked full-width path.
-        self._use_slot_prefill = shardings is None or shardings.mesh.shape["dp"] == 1
+        # Paged engines are unsharded by construction and ALWAYS use it (the
+        # pool has no slot axis to slice; writes land in the slot's own
+        # pages by table construction).
+        self._use_slot_prefill = (self.pool is not None or shardings is None
+                                  or shardings.mesh.shape["dp"] == 1)
         self._decode = jax.jit(
             partial(self._decode_impl, cfg, attn_fn, self._col_fn, mm, mm_in, moe_impl),
             static_argnums=(8,), donate_argnums=(1,),
@@ -225,9 +465,10 @@ class BatchEngine:
         self._decode_pen = jax.jit(
             partial(self._decode_penalized_impl, cfg, attn_fn, self._col_fn, mm,
                     mm_in, moe_impl),
-            static_argnums=(8,), donate_argnums=(1, 10),
+            static_argnums=(8,), donate_argnums=(1, 11),
         )
         self._copy_rows = jax.jit(self._copy_rows_impl, donate_argnums=(0,))
+        self._copy_page = jax.jit(self._copy_page_impl, donate_argnums=(0,))
 
         # batched speculative decoding (see spec_step): per-slot on-device
         # token history feeds the n-gram proposer; one verify forward per
@@ -283,10 +524,35 @@ class BatchEngine:
         )
 
     @staticmethod
-    def _decode_impl(cfg, attn_fn, col_fn, mm, mm_in, moe_impl, params, cache, tokens,
-                     pos_vec, active, keys, temps, topps, n, rope):
-        seq_len = cache.k.shape[3]
+    def _prefill_slot_paged_impl(cfg, attn_fn, col_fn, mm, mm_in, moe_impl,
+                                 params, cache, tokens, slot, pos, rope):
+        """Paged admission prefill: B=1 over the GLOBAL page pool with the
+        one slot's block-table row. No batch-axis slice/unslice — the writes
+        land in the slot's own pages by table construction, so other slots'
+        pages are untouched exactly like the dense slot slice."""
+        row = jax.lax.dynamic_slice_in_dim(cache.tables, slot, 1, axis=0)
+        sub = PagedKVCache(cache.k, cache.v, row)
+        logits, sub = forward(cfg, params, tokens, pos, sub, rope, attn_fn,
+                              col_fn=col_fn, mm=mm, mm_in=mm_in,
+                              moe_impl=moe_impl, last_only=True)
+        return logits[:, -1], PagedKVCache(sub.k, sub.v, cache.tables)
 
+    @staticmethod
+    def _copy_page_impl(cache, src, dst):
+        """Clone pool page src into dst across all layers (k and v) — the
+        copy-on-write primitive behind partial-page prefix shares and
+        divergence into a shared page. Traced indices: one compile serves
+        every page pair."""
+
+        def one(buf):  # [L, P, H, page, hd]
+            pg = jax.lax.dynamic_index_in_dim(buf, src, axis=1, keepdims=False)
+            return jax.lax.dynamic_update_index_in_dim(buf, pg, dst, axis=1)
+
+        return PagedKVCache(one(cache.k), one(cache.v), cache.tables)
+
+    @staticmethod
+    def _decode_impl(cfg, attn_fn, col_fn, mm, mm_in, moe_impl, params, cache, tokens,
+                     pos_vec, active, keys, temps, topps, n, rope, limit):
         def body(carry, _):
             tok, cache, p, keys = carry
             # per-ROW freeze at the cache edge: a slot that fills its last
@@ -294,9 +560,13 @@ class BatchEngine:
             # their full chunk (the old whole-batch clamp shrank everyone's
             # chunk to the fullest slot's room). Frozen rows behave exactly
             # like inactive ones: writes masked, token repeats, key held —
-            # p is clamped only for their rope/cache row indexing.
-            act = jnp.asarray(active) & (p < seq_len)
-            logits, cache = forward(cfg, params, tok, jnp.minimum(p, seq_len - 1),
+            # p is clamped only for their rope/cache row indexing. `limit`
+            # is seq_len on the dense layout; on paged it is each slot's
+            # allocated-page horizon, so a pool running dry freezes rows
+            # the same way the cache edge always has.
+            act = jnp.asarray(active) & (p < limit)
+            p_clamped = jnp.minimum(p, jnp.maximum(limit - 1, 0))
+            logits, cache = forward(cfg, params, tok, p_clamped,
                                     cache, rope, attn_fn,
                                     active=act, col_fn=col_fn, mm=mm,
                                     mm_in=mm_in, moe_impl=moe_impl, last_only=True)
@@ -315,7 +585,7 @@ class BatchEngine:
     @staticmethod
     def _decode_penalized_impl(cfg, attn_fn, col_fn, mm, mm_in, moe_impl, params,
                                cache, tokens, pos_vec, active, keys, temps, topps,
-                               n, rope, counts, presence, frequency):
+                               n, rope, limit, counts, presence, frequency):
         """The fused multi-slot scan with OpenAI repetition penalties:
         per-slot counts of sampled-this-request tokens ride the carry (the
         fed token is counted before its successor is sampled — active slots
@@ -325,16 +595,16 @@ class BatchEngine:
         from dllama_tpu.engine.sampling import apply_penalties
 
         b = tokens.shape[0]
-        seq_len = cache.k.shape[3]
 
         def body(carry, _):
             tok, cache, p, keys, counts = carry
             # same per-row freeze as _decode_impl: a slot frozen at the cache
             # edge must not inflate its counts with its repeated last token
-            act = jnp.asarray(active) & (p < seq_len)
+            act = jnp.asarray(active) & (p < limit)
             counts = counts.at[jnp.arange(b), tok[:, 0]].add(
                 act.astype(jnp.int32))
-            logits, cache = forward(cfg, params, tok, jnp.minimum(p, seq_len - 1),
+            p_clamped = jnp.minimum(p, jnp.maximum(limit - 1, 0))
+            logits, cache = forward(cfg, params, tok, p_clamped,
                                     cache, rope, attn_fn,
                                     active=act, col_fn=col_fn, mm=mm,
                                     mm_in=mm_in, moe_impl=moe_impl, last_only=True)
@@ -460,20 +730,101 @@ class BatchEngine:
         row copy would gather across shards."""
         return self._use_slot_prefill
 
+    # ------------------------------------------------------- paged-layout api
+
+    def _pool_page_copy(self, src_page: int, dst_page: int) -> None:
+        """PagePool's device-copy callback (copy-on-write page clones)."""
+        self.cache = self._copy_page(
+            self.cache, jnp.int32(src_page), jnp.int32(dst_page))
+
+    def _row_limit(self) -> np.ndarray:
+        """i32[B] per-slot decode row limit: the cache edge (seq_len) on
+        dense; min(seq_len, allocated pages) on paged."""
+        if self.pool is None:
+            return np.full(self.n_slots, self.seq_len, np.int32)
+        return np.minimum(
+            self.seq_len, self.pool.n_blocks.astype(np.int64) * self.page_size
+        ).astype(np.int32)
+
+    def _alloc_decode_rows(self, n: int) -> None:
+        """Paged: best-effort top-up before a decode/spec dispatch — extend
+        each active slot's table to cover n more rows (clamped at seq_len).
+        Slots the pool cannot serve keep their current limit and freeze
+        per-row in the scan; pages freed by later releases un-freeze them."""
+        if self.pool is None:
+            return
+        changed = False
+        for s in np.flatnonzero(self.active):
+            want = min(self.seq_len, int(self.pos[s]) + n)
+            changed |= self.pool.grow(int(s), want, best_effort=True)
+        if changed:
+            self._vec_dirty = True
+
+    def page_starved(self) -> np.ndarray:
+        """bool[B]: active slots whose next decode row has no backing page
+        even after a top-up attempt — frozen by pool exhaustion, not by the
+        context edge. The scheduler uses this to break the all-starved
+        livelock (finish one, its pages feed the rest)."""
+        if self.pool is None:
+            return np.zeros(self.n_slots, bool)
+        self._alloc_decode_rows(1)
+        limit = self._row_limit()
+        return (self.active & (self.pos >= limit) & (self.pos < self.seq_len)
+                & (self.pool.free_count == 0))
+
+    def admission_deficit(self, slot: int, reuse: int, prompt_len: int,
+                          cross: bool) -> int:
+        """Pages SHORT for admitting `prompt_len` rows into `slot` (0 on the
+        dense layout or when the admission fits) — the scheduler's
+        capacity-aware admission check."""
+        if self.pool is None:
+            return 0
+        return self.pool.admission_deficit(slot, reuse, prompt_len, cross)
+
+    def min_pages_for(self, prompt_len: int) -> int:
+        """Pages an admission of `prompt_len` rows needs from an empty pool
+        (incl. the decode reserve) — above the pool total it can NEVER fit."""
+        if self.pool is None:
+            return 0
+        return self.pool.blocks_for(prompt_len) + 1
+
+    def drop_slot_pages(self, slot: int) -> int:
+        """Evict an idle slot's cached pages (prefix-cache reclaim under
+        pool pressure). Returns pages returned to the free list."""
+        assert not self.active[slot], f"slot {slot} is busy"
+        if self.pool is None:
+            return 0
+        freed = self.pool.free_tail(slot, 0)
+        self.pos[slot] = 0
+        self._vec_dirty = True
+        return freed
+
+    def kv_page_stats(self) -> dict | None:
+        """Pool occupancy snapshot for /health and latency_summary(); None
+        on the dense layout."""
+        return None if self.pool is None else self.pool.stats()
+
     def copy_prefix_rows(self, src_slot: int, dst_slot: int, rows: int) -> None:
         """Cross-slot prefix share (the serving tier's RadixAttention-lite):
         make dst_slot's first `rows` KV rows identical to src_slot's, so an
         admission into dst can start_pos=rows off ANOTHER slot's cached
         prefix — e.g. every user of a serving deployment shares the system
-        prompt's KV without recomputing it per slot. One fused on-device
-        copy; no recompiles across prefix lengths."""
+        prompt's KV without recomputing it per slot. Dense: one fused
+        on-device row copy. Paged: no row copy at all — full pages are
+        SHARED by refcount (the dllama_kv_pages_shared gauge counts them)
+        and only a partial boundary page is cloned; divergence later
+        copy-on-writes (add_begin/prepare_admission)."""
         if not self.supports_cross_slot_copy:
             raise ValueError("cross-slot copy crosses dp shards; not supported "
                              "on batch-sharded meshes")
         assert not self.active[dst_slot], f"dst slot {dst_slot} is busy"
-        self.cache = self._copy_rows(
-            self.cache, jnp.int32(src_slot), jnp.int32(dst_slot), jnp.int32(rows)
-        )
+        if self.pool is not None:
+            self.pool.share_prefix(src_slot, dst_slot, rows,
+                                   self._pool_page_copy)
+        else:
+            self.cache = self._copy_rows(
+                self.cache, jnp.int32(src_slot), jnp.int32(dst_slot), jnp.int32(rows)
+            )
         if self.spec_k:
             # the shared prefix's token ids come along so the n-gram
             # proposer can draft from it in the new slot too (masked full-row
@@ -505,6 +856,13 @@ class BatchEngine:
             raise ValueError("prompt must be non-empty")
         if start_pos + n >= self.seq_len:
             raise ValueError(f"prompt ({start_pos}+{n}) exceeds seq_len {self.seq_len}")
+        if self.pool is not None:
+            # paged: drop the dead tail past the reused prefix, copy-on-write
+            # a shared boundary page, and back every prompt row with a page.
+            # Raises PageExhausted when the pool can't cover it — the serving
+            # scheduler pre-checks admission_deficit() so it never gets here.
+            self.pool.prepare_admission(slot, start_pos, start_pos + n,
+                                        self._pool_page_copy)
         self.pos[slot] = start_pos
         self._vec_dirty = True
         return Admission(slot=slot, toks=np.asarray(prompt_tokens, np.int32),
@@ -525,6 +883,10 @@ class BatchEngine:
                 jnp.asarray(adm.toks[off : off + c]),
             )
         if self._use_slot_prefill:
+            if self.pool is not None:
+                # the slot's block table changed at add_begin (page alloc /
+                # COW): refresh the device copy before the chunk reads it
+                self._sync_vectors()
             row, self.cache = self._prefill_slot(
                 self.params, self.cache,
                 jnp.asarray(adm.toks[off : off + c][None]),
@@ -657,6 +1019,14 @@ class BatchEngine:
         self._topp_dev = jnp.asarray(self.topp.copy())
         self._pres_dev = jnp.asarray(self.presence.copy())
         self._freq_dev = jnp.asarray(self.frequency.copy())
+        self._limit_dev = jnp.asarray(self._row_limit())
+        if self.pool is not None:
+            # block tables are host-authoritative like pos/active: refresh the
+            # cache's device copy at the same boundaries (the pool arrays are
+            # the mirrors; .copy() for the same aliasing reason as above)
+            self.cache = PagedKVCache(
+                self.cache.k, self.cache.v,
+                jnp.asarray(self.pool.tables.copy(), jnp.int32))
         self._vec_dirty = False
 
     def decode_dispatch(self, n: int) -> DecodeChunk:
@@ -674,10 +1044,14 @@ class BatchEngine:
         faults.fire("engine.decode")
         if not self.active.any():
             raise ValueError("no active slots")
-        room = self.seq_len - self.pos[self.active]
+        self._alloc_decode_rows(n)
+        limit = self._row_limit()
+        room = limit[self.active] - self.pos[self.active]
         n = min(n, int(room.max()))
         if n <= 0:
-            raise ValueError("every active slot is at seq_len; release first")
+            raise ValueError("every active slot is at its row limit "
+                             "(seq_len, or an exhausted page pool); "
+                             "release first")
         self._sync_vectors()
         pos_before = self._pos_dev
         args = (
@@ -690,6 +1064,7 @@ class BatchEngine:
             self._topp_dev,
             n,
             self.rope_cache,
+            self._limit_dev,
         )
         t0 = time.perf_counter()
         t_disp = time.monotonic()  # trace clock; ~free next to perf_counter
@@ -706,7 +1081,7 @@ class BatchEngine:
         start_pos = self.pos.copy()
         active = self.active.copy()
         advance = np.where(
-            active, np.minimum(n, self.seq_len - start_pos), 0
+            active, np.clip(limit - start_pos, 0, n), 0
         ).astype(np.int32)
         if self.spec_k:
             # history backfill rides the device stream off the
@@ -768,8 +1143,10 @@ class BatchEngine:
         freeze — active, K+1 rows of cache room, no repetition penalties.
         THE freeze rule: spec_step uses this mask verbatim, and the serving
         scheduler keys its spec/decode alternation off it, so a new freeze
-        condition added here reaches both automatically."""
-        room_ok = self.pos + self.spec_k + 1 <= self.seq_len
+        condition added here reaches both automatically. On the paged
+        layout "room" means BACKED rows (spec_step tops the pool up first),
+        so a dry pool freezes a slot here exactly like the context edge."""
+        room_ok = self.pos + self.spec_k + 1 <= self._row_limit()
         pen = (self.presence != 0) | (self.frequency != 0)
         return self.active & room_ok & ~pen
 
@@ -797,6 +1174,7 @@ class BatchEngine:
             raise ValueError("engine built with spec=0")
         if not self.active.any():
             raise ValueError("no active slots")
+        self._alloc_decode_rows(self.spec_k + 1)
         eff = self.spec_eligible()
         if not eff.any():
             raise ValueError("no active slot is spec-eligible (needs room for "
@@ -837,9 +1215,18 @@ class BatchEngine:
         """Free a slot. keep_rows rewinds pos to the valid prefix (mid-chunk
         stop — including tokens a dispatched-but-unconsumed chunk overran
         past a stop: the rewound rows are never read, like rejected spec
-        drafts), preserving the slot's cache for NaiveCache-style reuse."""
+        drafts), preserving the slot's cache for NaiveCache-style reuse.
+        On the paged layout the rewind also RETURNS the tail pages past the
+        kept prefix to the pool (refcount-aware: a page shared with another
+        slot just loses this slot's reference); keep_rows=None means the
+        rows are unspecified — every page goes back."""
         self.active[slot] = False
         self.presence[slot] = self.frequency[slot] = 0.0
         if keep_rows is not None:
             self.pos[slot] = keep_rows
+            if self.pool is not None:
+                self.pool.free_tail(slot, keep_rows)
+        elif self.pool is not None:
+            self.pool.free_tail(slot, 0)
+            self.pos[slot] = 0
         self._vec_dirty = True

@@ -34,6 +34,8 @@ def resolve_kernels(
     kernels: str = "auto",  # 'auto' | 'pallas' | 'xla'
     attn_impl: str = "auto",  # 'auto' | 'jnp' | 'flash'
     shardings=None,
+    paged: bool = False,  # paged KV layout: route the paged attention path
+    page_size: int = 0,
 ) -> KernelSelection:
     """Resolution rules:
 
@@ -62,6 +64,26 @@ def resolve_kernels(
     if sharded_pallas:
         mm, mm_in = shardings.pallas_mms(batch)
         backend = "pallas"
+
+    if paged:
+        # paged KV cache (BatchEngine --kv-layout paged; unsharded only — the
+        # page pool has no slot axis for a dp mesh to shard). attn_fn=None
+        # means models.llama.forward defaults to the jnp gather fallback
+        # (ops.layers.paged_gqa_attention), valid everywhere; the
+        # block-table-indexed flash kernel rides the same gate as dense
+        # flash where the page size is tileable.
+        from dllama_tpu.ops.pallas.flash_attention import (
+            paged_flash_gqa_attention,
+            paged_supported,
+        )
+
+        attn_fn = None
+        if attn_impl != "jnp" and paged_supported(
+            (cfg.n_heads, cfg.head_size), page_size
+        ) and (attn_impl == "flash" or (on_tpu and shardings is None)):
+            attn_fn = partial(paged_flash_gqa_attention, interpret=not on_tpu)
+        return KernelSelection(mm=mm, mm_in=mm_in, attn_fn=attn_fn,
+                               backend=backend)
 
     attn_fn = shardings.attn_fn(batch) if shardings is not None else None
     if attn_fn is None and attn_impl != "jnp":

@@ -52,6 +52,56 @@ class KVCache:
         return self.k.shape[3]
 
 
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class PagedKVCache:
+    """Paged KV layout: one global page pool
+    [n_layers, n_pages + 1, n_kv_heads, page_size, head_size] per tensor plus
+    per-slot block tables [n_slots, max_blocks] (i32 page indices, logical
+    block b of slot s lives in pool page tables[s, b]).
+
+    A slot reserves nothing up front — the engine-side allocator
+    (engine/batch.PagePool) hands out pages as positions advance and
+    refcounts them, so idle context windows cost no HBM and a shared prefix
+    is ONE set of pages referenced by many tables (vLLM's PagedAttention
+    layout, Kwon et al. 2023). The LAST pool page is the trash page: masked
+    writes (inactive slots) scatter there instead of paying a
+    whole-pool ``where``; the allocator never hands it out.
+
+    Unallocated table entries point at page 0: reads through them surface
+    whatever that page holds, which the causal mask zeroes exactly (stale
+    pool values are finite, and softmax assigns masked positions
+    probability 0.0 — so paged attention is bit-exact vs dense)."""
+
+    k: jax.Array
+    v: jax.Array
+    tables: jax.Array  # i32 [n_slots, max_blocks]
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.tables), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @classmethod
+    def create(cls, cfg: LlamaConfig, n_slots: int, n_pages: int,
+               page_size: int, dtype=jnp.bfloat16, max_blocks: int = 0):
+        shape = (cfg.n_layers, n_pages + 1, cfg.n_kv_heads, page_size,
+                 cfg.head_size)
+        tables = jnp.zeros((n_slots, max_blocks or 1), jnp.int32)
+        return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype), tables)
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def n_pages(self) -> int:
+        """Usable pages (the +1 trash page is excluded)."""
+        return self.k.shape[1] - 1
+
+
 def _cache_update(cache, new, pos_base, active):
     """Write [B, H, T, hd] rows at pos (scalar, or [B] per-row scatter); rows
     with active==False keep their old contents (continuous batching: frozen
@@ -68,11 +118,33 @@ def _cache_update(cache, new, pos_base, active):
     return upd
 
 
+def _paged_cache_update(pool, new, tables, pos_base, active):
+    """Write [B, H, T, hd] rows into the page pool at block-table positions.
+
+    pool: one layer's [P, H, page, hd] slice. Row pos+t of slot b lands in
+    pool page tables[b, (pos+t) // page] at offset (pos+t) % page. Rows with
+    active==False are routed to the TRASH page (index P-1, never allocated)
+    — a per-row index swap instead of the dense path's whole-cache where().
+    """
+    new = new.astype(pool.dtype)
+    b, h, t, hd = new.shape
+    page = pool.shape[2]
+    pos = jnp.broadcast_to(jnp.asarray(pos_base, jnp.int32), (b,))
+    rows = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None]  # [B, T]
+    blk = jnp.clip(rows // page, 0, tables.shape[1] - 1)
+    off = rows % page
+    pages = jnp.take_along_axis(tables, blk, axis=1)  # [B, T]
+    if active is not None:
+        pages = jnp.where(active[:, None], pages, pool.shape[0] - 1)
+    return pool.at[pages, :, off, :].set(new.transpose(0, 2, 1, 3))
+
+
 from dllama_tpu.ops.quant import slice_leaf as _slice_layer
 
 
 def _layer(cfg: LlamaConfig, x, layers, li, k_cache, v_cache, rope, pos_base, attn_fn,
-           active=None, col_fn=None, mm=None, mm_in=None, moe_impl="auto"):
+           active=None, col_fn=None, mm=None, mm_in=None, moe_impl="auto",
+           tables=None):
     """One decoder layer. `layers` is the full stacked params dict and `li`
     the traced layer index — quantized weights are NOT sliced here: the matmul
     dispatcher either DMA-indexes the stack (Pallas scalar prefetch) or slices
@@ -107,9 +179,16 @@ def _layer(cfg: LlamaConfig, x, layers, li, k_cache, v_cache, rope, pos_base, at
     v = v.reshape(b, t, cfg.n_kv_heads, cfg.head_size)
     q = apply_rope(q, rope)
     k = apply_rope(k, rope)
-    k_cache = _cache_update(k_cache, k.transpose(0, 2, 1, 3), pos_base, active)
-    v_cache = _cache_update(v_cache, v.transpose(0, 2, 1, 3), pos_base, active)
-    att = attn_fn(q, k_cache, v_cache, pos_base).reshape(b, t, d)
+    if tables is None:
+        k_cache = _cache_update(k_cache, k.transpose(0, 2, 1, 3), pos_base, active)
+        v_cache = _cache_update(v_cache, v.transpose(0, 2, 1, 3), pos_base, active)
+        att = attn_fn(q, k_cache, v_cache, pos_base).reshape(b, t, d)
+    else:  # paged layout: scatter at block-table positions, same math
+        k_cache = _paged_cache_update(k_cache, k.transpose(0, 2, 1, 3),
+                                      tables, pos_base, active)
+        v_cache = _paged_cache_update(v_cache, v.transpose(0, 2, 1, 3),
+                                      tables, pos_base, active)
+        att = attn_fn(q, k_cache, v_cache, tables, pos_base).reshape(b, t, d)
     x = x + colmm(att, layers["wo"], li)
     # --- feed-forward block (reference "ff" segment, llm.cpp:314-385);
     # sparse-MoE variant when the header carries N_EXPERTS (llm.hpp:17-18 —
@@ -188,6 +267,8 @@ def run_layers(
     mm=None,  # quantized-matmul fn (x, w, layer) -> out; default ops.matmul
     mm_in=None,  # matmul for input-dim-sharded weights (see _layer)
     moe_impl: str = "auto",  # MoE compute scheme (ops.layers.moe_ffn)
+    tables: jax.Array | None = None,  # i32 [B, max_blocks] block tables —
+    # presence selects the paged cache layout (k/v are then page pools)
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Scan the decoder layers (any contiguous stack — the full model, or one
     pipeline stage's slice). Returns (x, k_cache, v_cache).
@@ -198,14 +279,20 @@ def run_layers(
 
     `unroll`: passed to lax.scan — trades compile time for cross-layer
     scheduling freedom."""
-    attn_fn = attn_fn or gqa_attention
+    if attn_fn is None:
+        if tables is None:
+            attn_fn = gqa_attention
+        else:
+            from dllama_tpu.ops.layers import paged_gqa_attention
+
+            attn_fn = paged_gqa_attention
     n_layers = k_cache.shape[0]
 
     def scan_fn(carry, xs):
         x = carry
         li, kc, vc = xs
         x, kc, vc = _layer(cfg, x, layer_params, li, kc, vc, rope, pos_base, attn_fn,
-                           active, col_fn, mm, mm_in, moe_impl)
+                           active, col_fn, mm, mm_in, moe_impl, tables)
         return x, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(
@@ -243,7 +330,11 @@ def forward(
     matmul — prefill only needs next-token logits, and XLA cannot DCE rows of
     a dot, so without this a 128-token chunk would pay 128x the lm-head cost
     (the reference has the same shape: logits only materialize for the last
-    token of a batch, dllama.cpp:69-88)."""
+    token of a batch, dllama.cpp:69-88).
+
+    `cache` may be a dense KVCache or a PagedKVCache — the paged layout
+    threads its block tables through the layer scan (scatter writes at
+    table positions, gather/block-indexed attention; identical math)."""
     x = params["embedding"][tokens]  # [B, T, D]
     t = tokens.shape[1]
     pos_base = jnp.asarray(pos_base, jnp.int32)
@@ -252,14 +343,18 @@ def forward(
         rope = rope_cache[jnp.clip(idx, 0, rope_cache.shape[0] - 1)]
     else:
         rope = jax.lax.dynamic_slice_in_dim(rope_cache, pos_base, t, axis=0)
+    paged = isinstance(cache, PagedKVCache)
     x, k_new, v_new = run_layers(
         cfg, params["layers"], x, pos_base, cache.k, cache.v, rope, attn_fn, active,
         unroll=unroll, col_fn=col_fn, mm=mm, mm_in=mm_in, moe_impl=moe_impl,
+        tables=cache.tables if paged else None,
     )
     if last_only:
         x = x[:, -1:]
     x = rms_norm(x, params["final_norm"], cfg.norm_epsilon)
     logits = (mm or matmul)(x, params["wcls"]).astype(jnp.float32)
+    if paged:
+        return logits, PagedKVCache(k_new, v_new, cache.tables)
     return logits, KVCache(k_new, v_new)
 
 

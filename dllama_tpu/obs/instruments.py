@@ -69,6 +69,16 @@ MODEL_PARAMS_BYTES = metrics.gauge(
     "dllama_model_params_bytes", "Model parameter bytes resident in HBM")
 KV_CACHE_BYTES = metrics.gauge(
     "dllama_kv_cache_bytes", "KV-cache bytes resident in HBM")
+KV_PAGES_TOTAL = metrics.gauge(
+    "dllama_kv_pages_total",
+    "Paged KV cache: usable pages in the global pool (0 = dense layout)")
+KV_PAGES_USED = metrics.gauge(
+    "dllama_kv_pages_used",
+    "Paged KV cache: pages currently referenced by at least one slot")
+KV_PAGES_SHARED = metrics.gauge(
+    "dllama_kv_pages_shared",
+    "Paged KV cache: pages referenced by more than one slot "
+    "(copy-on-write prefix sharing)")
 
 # ------------------------------------------------------------- histograms
 

@@ -250,3 +250,31 @@ def gqa_attention(
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgts,bhsd->bthgd", probs, vf)
     return out.reshape(b, t, hq, hd).astype(q.dtype)
+
+
+def paged_view(pool: jax.Array, tables: jax.Array) -> jax.Array:
+    """Gather a [B, Hkv, max_blocks*page, hd] contiguous cache view from a
+    [P, Hkv, page, hd] page pool through [B, max_blocks] block tables —
+    logical row r of slot b reads pool[tables[b, r // page], :, r % page].
+    Rows behind unallocated table entries surface stale page contents; the
+    caller's causal mask assigns them probability exactly 0.0 (pool values
+    are always finite), so a view-based attention is bit-exact vs dense."""
+    b, nb = tables.shape
+    p, hkv, page, hd = pool.shape
+    kv = pool[tables]  # [B, nb, Hkv, page, hd]
+    return kv.transpose(0, 2, 1, 3, 4).reshape(b, hkv, nb * page, hd)
+
+
+def paged_gqa_attention(
+    q: jax.Array,  # [B, T, Hq, hd]
+    k_pool: jax.Array,  # [P, Hkv, page, hd] (one layer's pool slice)
+    v_pool: jax.Array,
+    tables: jax.Array,  # i32 [B, max_blocks]
+    pos_base: jax.Array,  # i32 scalar, or [B] per-sequence positions
+) -> jax.Array:
+    """Causal GQA over the paged KV cache: the jnp reference/fallback path —
+    gather the block-table view, then run the dense attention math unchanged
+    (the flash variant in ops/pallas/flash_attention.py DMA-indexes pages
+    directly instead of materializing the view)."""
+    return gqa_attention(q, paged_view(k_pool, tables),
+                         paged_view(v_pool, tables), pos_base)

@@ -41,7 +41,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from dllama_tpu.ops.pallas.tiling import pick_tile as _pick_tile
+from dllama_tpu.ops.pallas.tiling import COMPILER_PARAMS, pick_tile as _pick_tile
 
 _NEG_INF = -1e30  # large-finite: keeps fully-masked tiles NaN-free
 
@@ -143,7 +143,7 @@ def _flash_folded(q, k, v, pos, *, group: int, hkv: int, interpret: bool,
                           hkv=hkv, group=group, rows_live=rows_live),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((bhkv, rows, hd), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
@@ -241,3 +241,124 @@ def flash_gqa_attention(
 def supported(q_shape: tuple[int, ...], cache_seq_len: int) -> bool:
     """Tileability check for the engine's attention dispatcher."""
     return cache_seq_len % 64 == 0 and q_shape[-1] >= 8
+
+
+# --------------------------------------------------------------- paged cache
+
+
+def _paged_kernel(pos_ref, tables_ref, *args, **kw):
+    """The paged grid prefetches (pos, tables); the flash math itself is
+    identical — masking is by LOGICAL position, which the index maps (not
+    the kernel body) translate to pool pages."""
+    return _kernel(pos_ref, *args, **kw)
+
+
+@functools.partial(jax.jit, static_argnames=("group", "hkv", "interpret",
+                                             "rows_live"))
+def _flash_paged_folded(q, k_pool, v_pool, pos, tables, *, group: int,
+                        hkv: int, interpret: bool, rows_live: int | None = None):
+    """q[BHkv, Tp*group, hd] x pool[P, Hkv, page, hd] -> [BHkv, rows, hd] f32.
+
+    Same folded layout and online-softmax state as _flash_folded; the kv
+    BlockSpecs index the PAGE POOL through the block tables (scalar-prefetch
+    arg #2), so each kv grid step DMAs one page tile — the kernel never sees
+    a materialized contiguous cache. The live-tile clamp carries over: dead
+    logical tiles map to the last live tile's page (repeated block index =>
+    Pallas elides the DMA) and their compute is skipped by the kernel."""
+    bhkv, rows, hd = q.shape
+    page = k_pool.shape[2]
+    nb = tables.shape[1]
+    s = nb * page  # logical cache view length
+    rows_live = rows_live or rows
+    tq = _pick_tile(rows, (128, 64, 32, 16, 8))
+    ts = _pick_tile(page, (512, 256, 128, 64))
+    tiles_per_page = page // ts
+    grid = (bhkv, rows // tq, s // ts)
+
+    def kv_index(h, i, ks, pos, tables):
+        # clamp dead LOGICAL tiles to the last live one (mirrors _flash_folded),
+        # then translate the logical tile to (pool page, tile-within-page)
+        last_row = jnp.minimum(i * tq + tq - 1, rows_live - 1)
+        last_live = (pos[h // hkv] + last_row // group) // ts
+        lk = jnp.minimum(ks, last_live)
+        pg = tables[h // hkv, lk // tiles_per_page]
+        return (pg, h % hkv, lk % tiles_per_page, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # pos: i32[B], tables: i32[B, nb]
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, tq, hd), lambda h, i, ks, pos, tables: (h, i, 0)),
+            pl.BlockSpec((None, None, ts, hd), kv_index),
+            pl.BlockSpec((None, None, ts, hd), kv_index),
+        ],
+        out_specs=pl.BlockSpec((None, tq, hd),
+                               lambda h, i, ks, pos, tables: (h, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((tq, hd), jnp.float32),
+            pltpu.VMEM((tq, 128), jnp.float32),
+            pltpu.VMEM((tq, 128), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_kernel, scale=1.0 / math.sqrt(hd), tq=tq,
+                          ts=ts, hkv=hkv, group=group, rows_live=rows_live),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bhkv, rows, hd), jnp.float32),
+        compiler_params=COMPILER_PARAMS(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bhkv * rows * s * hd,
+            bytes_accessed=(bhkv * rows * hd * 2) * q.dtype.itemsize
+            + 2 * bhkv * s * hd * k_pool.dtype.itemsize,
+            transcendentals=bhkv * rows * s,
+        ),
+        interpret=interpret,
+    )(pos, tables, q, k_pool, v_pool)
+
+
+def paged_flash_gqa_attention(
+    q: jax.Array,  # [B, T, Hq, hd]
+    k_pool: jax.Array,  # [P, Hkv, page, hd] (one layer's pool slice)
+    v_pool: jax.Array,
+    tables: jax.Array,  # i32 [B, max_blocks]
+    pos_base: jax.Array,  # i32 scalar or [B] per-row positions
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Drop-in for ops.layers.paged_gqa_attention (same signature/semantics):
+    block-table-indexed flash attention — the kv sweep walks pool pages via
+    the prefetched tables, so the paged layout pays no gather materialization
+    and keeps the dense kernel's live-tile DMA pruning."""
+    b, t, hq, hd = q.shape
+    hkv = k_pool.shape[1]
+    group = hq // hkv
+    qf = (
+        q.reshape(b, t, hkv, group, hd)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b * hkv, t * group, hd)
+    )
+    rows = t * group
+    pad = (-rows) % 8
+    if pad:
+        qf = jnp.pad(qf, ((0, 0), (0, pad), (0, 0)))
+    pos = jnp.broadcast_to(jnp.atleast_1d(jnp.asarray(pos_base, jnp.int32)), (b,))
+    out = _flash_paged_folded(qf, k_pool, v_pool, pos,
+                              jnp.asarray(tables, jnp.int32),
+                              group=group, hkv=hkv, interpret=interpret,
+                              rows_live=rows)
+    if pad:
+        out = out[:, :rows]
+    return (
+        out.reshape(b, hkv, t, group, hd)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b, t, hq, hd)
+        .astype(q.dtype)
+    )
+
+
+def paged_supported(q_shape: tuple[int, ...], page_size: int) -> bool:
+    """Tileability check for the paged dispatcher: a page must hold a whole
+    number of 64-wide kv tiles (the tile never spans a page boundary)."""
+    return page_size % 64 == 0 and q_shape[-1] >= 8

@@ -12,6 +12,8 @@ the default; Q80 halves the payload at ~1e-2 relative error).
 from __future__ import annotations
 
 import jax
+
+from dllama_tpu.parallel import shard_map as _shard_map
 import jax.numpy as jnp
 
 from dllama_tpu.ops.quant import dequantize_q80_jnp, quantize_q80_jnp
@@ -107,7 +109,7 @@ def make_q80_col_matmul(mesh):
         w_spec = P("tp", None)  # [in, out] with the contraction dim tp-sharded
         if isinstance(w, QTensor):
             w_spec = QTensor(w_spec, w_spec)
-        return jax.shard_map(
+        return _shard_map(
             body,
             mesh=mesh,
             in_specs=(P(None, None, "tp"), w_spec),

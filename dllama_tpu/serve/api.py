@@ -969,6 +969,9 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
         log.warning("--max-queue / --stall-deadline-s need --slots > 0; the "
                     "single-engine tier has no admission queue or worker "
                     "thread to watch — ignored")
+    if n_slots <= 0 and defaults.get("kv_layout") == "paged":
+        log.warning("--kv-layout paged needs --slots > 0; the single-engine "
+                    "tier keeps its dense per-sequence cache — ignored")
     if n_slots > 0:
         from dllama_tpu.engine.batch import BatchEngine
         from dllama_tpu.serve.scheduler import Scheduler
@@ -983,6 +986,10 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
             log.warning("--spec is unavailable on dp>1 meshes; the "
                         "continuous-batching tier decodes without speculation")
             spec_n = 0
+        # paged KV cache (--kv-layout paged): the pool replaces the per-slot
+        # context reservation; unsharded engines only (BatchEngine raises on
+        # meshes — startup is the right place to find that out)
+        kv_layout = defaults.get("kv_layout") or "dense"
         be = BatchEngine(
             loaded.config,
             loaded.engine.params,
@@ -992,6 +999,9 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
             shardings=loaded.shardings,  # multi-chip serving keeps the mesh placement
             sync=getattr(loaded, "sync", "bf16"),
             spec=spec_n,
+            kv_layout=kv_layout,
+            page_size=int(defaults.get("page_size") or 128),
+            kv_pages=int(defaults.get("kv_pages") or 0),
         )
         # admission pacing (serve/scheduler.py): budget bounds the decode
         # stall a joining prefill may insert per visit; the optional TTFT

@@ -199,6 +199,11 @@ class Scheduler:
         # stall rather than the joiner waiting forever behind a slow batch)
         self.admit_ttft_deadline_ms = admit_ttft_deadline_ms
         self.pending: queue.Queue[Request] = queue.Queue()
+        # capacity-aware admission (paged KV layout): the head request the
+        # page pool cannot yet cover, parked here (NOT back in `pending` —
+        # FIFO order is preserved and later requests wait behind it). Retried
+        # every boundary; released pages / evicted idle caches un-defer it.
+        self._deferred: Request | None = None
         self.slots: dict[int, Request] = {}
         # admissions being pumped chunk-by-chunk: [(req, Admission), ...];
         # their slots are reserved (not engine.active) until commit
@@ -269,7 +274,7 @@ class Scheduler:
                                 t=req.submitted_at)
         self.pending.put(req)
         ins.REQUESTS_ADMITTED.inc()
-        ins.QUEUE_DEPTH.set(self.pending.qsize())
+        ins.QUEUE_DEPTH.set(self._queue_depth())
         if self.crashed is not None or not self._thread.is_alive():
             # lost the race with a worker crash: _fail_all may already have
             # drained the queue, so this request could sit there forever —
@@ -300,10 +305,14 @@ class Scheduler:
         if self._draining.is_set():
             ins.REQUESTS_SHED.labels(reason="draining").inc()
             raise SchedulerDraining("scheduler is draining; no new requests")
-        if self.max_queue and self.pending.qsize() >= self.max_queue:
+        # a capacity-deferred head request left the queue but still owes
+        # service: it counts against the shed bound, so a queue backed up
+        # behind pool exhaustion sheds at the same depth as any other backlog
+        depth = self._queue_depth()
+        if self.max_queue and depth >= self.max_queue:
             ins.REQUESTS_SHED.labels(reason="queue_full").inc()
             raise QueueFull(
-                f"admission queue full ({self.pending.qsize()} >= "
+                f"admission queue full ({depth} >= "
                 f"--max-queue {self.max_queue})")
         try:
             faults.fire("scheduler.queue")
@@ -315,7 +324,8 @@ class Scheduler:
     def _busy(self) -> bool:
         """Whether the worker owes anyone progress (watchdog gating: an idle
         worker parked on its wake event must never read as stalled)."""
-        return bool(self.slots) or bool(self._inflight) or not self.pending.empty()
+        return (bool(self.slots) or bool(self._inflight)
+                or self._deferred is not None or not self.pending.empty())
 
     def health(self) -> dict:
         """Liveness + readiness snapshot for the API tier's /health.
@@ -326,7 +336,7 @@ class Scheduler:
                    not live (balancers should route away, not kill).
         The rest is the observability payload: queue depth, busy slots, and
         the age of the worker's last heartbeat."""
-        qdepth = self.pending.qsize()
+        qdepth = self._queue_depth()
         live = (self._thread.is_alive() and self.crashed is None
                 and not self.join_failed and not self.stalled)
         saturated = bool(self.max_queue) and qdepth >= self.max_queue
@@ -338,6 +348,11 @@ class Scheduler:
             "busy_slots": int(np.asarray(self.engine.active).sum()),
             "n_slots": self.engine.n_slots,
             "in_flight_admissions": len(self._inflight),
+            # paged KV pool occupancy (None on the dense layout); a deferred
+            # head request is the capacity-wait signal operators watch
+            "kv_pages": self.engine.kv_page_stats()
+            if hasattr(self.engine, "kv_page_stats") else None,
+            "admission_deferred": self._deferred is not None,
             "last_step_age_s": round(time.monotonic() - self._heartbeat, 3),
             "stall_deadline_s": self.stall_deadline_s,
             "stalled": self.stalled,
@@ -404,6 +419,10 @@ class Scheduler:
             "decode_host_gaps": len(hgaps),
             "decode_host_gap_ms_max": max(hgaps) if hgaps else None,
             "decode_host_gap_ms_mean": mean(hgaps),
+            # paged KV pool occupancy (None on the dense layout) — the same
+            # numbers the dllama_kv_pages_{total,used,shared} gauges export
+            "kv_pages": self.engine.kv_page_stats()
+            if hasattr(self.engine, "kv_page_stats") else None,
         }
 
     def reset_latency_stats(self) -> None:
@@ -569,16 +588,53 @@ class Scheduler:
         lens = np.cumprod(hit, axis=1).sum(axis=1)
         return {s: int(n) for s, n in zip(donors, lens)}
 
+    def _queue_depth(self) -> int:
+        """Requests owed service but not yet admitted: the pending queue
+        plus the capacity-deferred head (one definition for the gauge,
+        /health, and the --max-queue shed bound — they must not disagree)."""
+        return self.pending.qsize() + (1 if self._deferred is not None else 0)
+
+    def _evict_idle_pages(self, needed: int, exclude: set) -> bool:
+        """Paged prefix-cache reclaim: drop idle slots' cached pages
+        (smallest caches first — the cheapest reuse to lose) until `needed`
+        pages came free, then STOP — a one-page shortfall must not wipe
+        every cached prefix. `exclude` protects the chosen destination and
+        donor. Returns True when anything was freed."""
+        reserved = {adm.slot for _, adm, _ in self._inflight}
+        victims = sorted(
+            (s for s in range(self.engine.n_slots)
+             if not self.engine.active[s] and s not in reserved
+             and s not in exclude and self.slot_tokens.get(s)),
+            key=lambda s: len(self.slot_tokens.get(s, [])),
+        )
+        freed = 0
+        for s in victims:
+            if freed >= needed:
+                break
+            freed += self.engine.drop_slot_pages(s)
+            self.slot_tokens[s] = []
+        return freed > 0
+
     def _admit_starts(self) -> None:
-        """Pop pending requests into in-flight admissions while slots allow."""
+        """Pop pending requests into in-flight admissions while slots allow.
+
+        Paged layout: admission capacity is FREE PAGES, not free slots — a
+        request whose prompt (+ one decode page) the pool cannot cover first
+        reclaims idle slots' cached pages, and if still short is parked in
+        `_deferred` (FIFO head; later requests wait behind it) until
+        releases free capacity. Shedding still applies while it waits: the
+        deferred request counts toward --max-queue depth."""
         reserved = len(self._inflight)
-        while not self.pending.empty():
+        while self._deferred is not None or not self.pending.empty():
             if int((~self.engine.active).sum()) - reserved <= 0:
                 return
-            try:
-                req = self.pending.get_nowait()
-            except queue.Empty:
-                return
+            if self._deferred is not None:
+                req, self._deferred = self._deferred, None
+            else:
+                try:
+                    req = self.pending.get_nowait()
+                except queue.Empty:
+                    return
             if req.cancelled.is_set():
                 req.finish_reason = req.cancel_reason
                 req.finished_at = time.monotonic()
@@ -596,9 +652,42 @@ class Scheduler:
                     f"prompt ({len(req.prompt)}) exceeds seq_len {self.engine.seq_len}"
                 ))
                 continue
+            pool = getattr(self.engine, "pool", None)
+            if (pool is not None
+                    and self.engine.min_pages_for(len(req.prompt)) > pool.n_pages):
+                # never-fits reject: the prompt's pages (+ the decode
+                # reserve) must ALL be resident at once, and reused/shared
+                # prefix pages still occupy pool pages — so the bound is
+                # absolute, independent of any cached prefix. Deferring such
+                # a request would deadlock the FIFO head forever; reject it
+                # like the seq_len check.
+                req.finish_reason = "error"
+                req.finished_at = time.monotonic()
+                self._observe_finish(req)
+                req.out.put(ValueError(
+                    f"prompt ({len(req.prompt)}) needs "
+                    f"{self.engine.min_pages_for(len(req.prompt))} KV pages; "
+                    f"the pool holds {pool.n_pages}"))
+                continue
             slot, reuse, donor = self._pick_slot(req.prompt)
+            cross = donor is not None and donor != slot and reuse > 0
+            deficit = self.engine.admission_deficit(slot, reuse,
+                                                    len(req.prompt), cross)
+            if deficit > 0:
+                # pool short: reclaim just enough idle cache (keeping the
+                # destination and donor — their rows are this admission's
+                # reuse), then re-pick (eviction may change the best donor)
+                if self._evict_idle_pages(deficit, {slot, donor}):
+                    slot, reuse, donor = self._pick_slot(req.prompt)
+                    cross = donor is not None and donor != slot and reuse > 0
+                if self.engine.admission_deficit(slot, reuse, len(req.prompt),
+                                                 cross) > 0:
+                    # still short: every missing page is held by RUNNING
+                    # requests — park at the head until releases free them
+                    self._deferred = req
+                    return
             try:
-                if donor is not None and donor != slot and reuse > 0:
+                if cross:
                     # cross-slot share: materialize the donor's prefix rows
                     # in the destination before the delta prefill
                     self.engine.copy_prefix_rows(donor, slot, reuse)
@@ -729,11 +818,15 @@ class Scheduler:
 
     def _fail_all(self, exc: BaseException) -> None:
         """Fail every queue a client could be blocked on: in-flight
-        admissions, decoding slots, and the pending queue. The whole point
-        of supervision — nobody hangs forever on a dead worker."""
+        admissions, decoding slots, the capacity-deferred head, and the
+        pending queue. The whole point of supervision — nobody hangs
+        forever on a dead worker."""
         for req, _adm, _ in self._inflight:
             self._fail_req(req, exc)
         self._inflight.clear()
+        if self._deferred is not None:
+            self._fail_req(self._deferred, exc)
+            self._deferred = None
         for req in list(self.slots.values()):
             self._fail_req(req, exc)
         self.slots.clear()
@@ -798,11 +891,18 @@ class Scheduler:
         chunk consumption points."""
         if self._stop.is_set() or getattr(self.engine, "spec_k", 0):
             return True
-        if not self.slots or self._inflight or not self.pending.empty():
+        if (not self.slots or self._inflight or self._deferred is not None
+                or not self.pending.empty()):
             return True
         if any(r.cancelled.is_set() for r in self.slots.values()):
             return True
-        if any(int(self.engine.pos[s]) >= self.engine.seq_len
+        # row limit = seq_len on dense; on paged also each slot's allocated
+        # pages — a slot AT its limit needs boundary work (finish at the
+        # context edge, or page top-up/starvation handling on the pool)
+        limit = (self.engine._row_limit()
+                 if hasattr(self.engine, "_row_limit") else None)
+        if any(int(self.engine.pos[s]) >= (self.engine.seq_len if limit is None
+                                           else int(limit[s]))
                for s in self.slots):
             return True
         if inflight_chunk is not None:
@@ -943,7 +1043,7 @@ class Scheduler:
             # scrape-visible view of the loop's state (set, not callbacks:
             # a dead scheduler's last values are a tombstone, never a
             # dangling closure keeping the engine alive)
-            ins.QUEUE_DEPTH.set(self.pending.qsize())
+            ins.QUEUE_DEPTH.set(self._queue_depth())
             ins.BUSY_SLOTS.set(len(self.slots))
             faults.fire("scheduler.loop")
             if pending is not None:
@@ -966,6 +1066,36 @@ class Scheduler:
                                  keep_rows=int(self.engine.pos[slot]))
                 elif int(self.engine.pos[slot]) >= self.engine.seq_len:
                     self._finish(req, "length")
+            if self.slots and hasattr(self.engine, "page_starved"):
+                # paged pool exhaustion mid-decode: a starved slot (no page
+                # for its next row, pool dry) waits frozen while batch-mates
+                # run — their releases re-feed it. But when EVERY live slot
+                # is starved nothing will ever free a page: finish the most-
+                # advanced one with 'length' (least budget wasted) so its
+                # pages unfreeze the rest. Admission reserves (+1 decode
+                # page) make this a last resort, not the steady state.
+                # the rescue must run even while an admission is mid-prefill
+                # (_inflight): admissions only ADD page consumers, so waiting
+                # on one can never un-starve the batch — and dispatching a
+                # chunk with every slot at its limit would raise and crash
+                # the worker instead
+                starved = self.engine.page_starved()
+                if starved.any() and all(
+                    starved[s] for s in self.slots
+                    if self.engine.active[s]
+                ):
+                    if self._evict_idle_pages(len(self.slots), set()):
+                        pass  # reclaimed idle caches; next dispatch tops up
+                    else:
+                        victim = max(
+                            (s for s in self.slots if starved[s]),
+                            key=lambda s: int(self.engine.pos[s]))
+                        log.warning(
+                            "kv page pool exhausted with every active slot "
+                            "starved; finishing slot %d "
+                            "(finish_reason=length) to free its pages",
+                            victim)
+                        self._finish(self.slots[victim], "length")
             if not self.slots:
                 self._t_dec_end = None
                 if not self._inflight:
@@ -1003,6 +1133,9 @@ class Scheduler:
         self._inflight.clear()
         for req in list(self.slots.values()):
             cut(req)
+        if self._deferred is not None:
+            cut(self._deferred)
+            self._deferred = None
         while True:
             try:
                 cut(self.pending.get_nowait())

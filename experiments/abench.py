@@ -49,7 +49,17 @@ def main():
     from dllama_tpu.serve.scheduler import Scheduler
 
     if smoke:
-        preset, n_slots, prompt_len, chunk, pf_chunk, bg_steps = "tiny", 4, 96, 2, 16, 48
+        # ONE protocol with bench.bench_admission (bench.ADMISSION_PROTOCOL):
+        # the bench `admission` record and this experiment must be the same
+        # experiment, or their headline ratios drift apart again (the
+        # BENCH_r05 1.1x vs ADMISSION_CPU.md PASS confusion — see the
+        # "Reconciliation (r6)" section there)
+        from bench import ADMISSION_PROTOCOL as _P
+
+        preset = "tiny"
+        n_slots, prompt_len, chunk, pf_chunk, bg_steps = (
+            _P["n_slots"], _P["prompt_len"], _P["chunk"], _P["pf_chunk"],
+            _P["bg_steps"])
     else:
         preset = os.environ.get("ABENCH_PRESET", "8b")
         n_slots = int(os.environ.get("ABENCH_SLOTS", "32"))

@@ -120,12 +120,22 @@ def kernel_stream_bytes(cfg: LlamaConfig, live_frac: float = 1.0,
 
 
 def batched_step_bytes(cfg: LlamaConfig, slots: int, live_frac: float = 1.0,
-                       cache_bytes_per_el: int = 2) -> int:
+                       cache_bytes_per_el: int = 2, paged: bool = False,
+                       page_size: int = 128) -> int:
     """Per-STEP HBM bytes of a `slots`-wide batched decode (BatchEngine):
     the weight stream is read once and serves every slot (the entire point
     of the serving tier), while the KV stream scales with slots — each
     slot's cache rows are its own. Activation rows scale with slots but
-    stay negligible. cache_bytes_per_el=1 models the f8 KV cache."""
+    stay negligible. cache_bytes_per_el=1 models the f8 KV cache.
+
+    paged=True accounts the paged layout's overhead against the SAME
+    DMA-contract discipline as the dense rows: (1) the live KV stream
+    rounds up to whole pages per slot (the kv grid's clamp granularity is
+    the page once tiles can't span page boundaries), and (2) each kernel
+    reads the i32 block tables (slots * seq/page entries, k and v, per
+    layer) as its scalar-prefetch operand. Both are per-step HBM reads the
+    dense layout does not pay — the honest cost of making the 96-slot pool
+    allocatable at all."""
     L, d, h, kv, hd = (cfg.n_layers, cfg.dim, cfg.hidden_dim, cfg.kv_dim,
                        cfg.head_size)
     m = max(8, slots)  # one fused step: all slots are rows of one matmul
@@ -137,10 +147,16 @@ def batched_step_bytes(cfg: LlamaConfig, slots: int, live_frac: float = 1.0,
 
     acts += (mm_act(d, d) * 2 + mm_act(d, kv) * 2
              + mm_act(d, h) * 2 + mm_act(h, d)) * L + mm_act(d, cfg.vocab_size)
-    kv_stream = int(2 * slots * cfg.n_kv_heads * cfg.seq_len * hd
-                    * cache_bytes_per_el * live_frac) * L
+    live_rows = live_frac * cfg.seq_len
+    if paged:
+        # page-granular pruning horizon: live rows round up to whole pages
+        live_rows = -(-int(live_rows) // page_size) * page_size
+    kv_stream = int(2 * slots * cfg.n_kv_heads * live_rows * hd
+                    * cache_bytes_per_el) * L
     kv_write = 2 * slots * kv * cache_bytes_per_el * L
-    return weights + acts + kv_stream + kv_write + slots * d * 2
+    table_read = (4 * slots * (cfg.seq_len // page_size) * 2 * L
+                  if paged else 0)  # i32 block tables, k + v, per layer
+    return weights + acts + kv_stream + kv_write + table_read + slots * d * 2
 
 
 def abstract_model(cfg: LlamaConfig, sharding):
@@ -339,11 +355,20 @@ def main():
     batched = []
     if not smoke:
         cfg = PRESETS["8b"]
-        for slots, cache_el, tag in ((8, 2, "bf16 KV"), (32, 2, "bf16 KV"),
-                                     (48, 2, "bf16 KV"), (48, 1, "f8 KV"),
-                                     (96, 1, "f8 KV")):
+        for slots, cache_el, paged, tag in (
+            (8, 2, False, "bf16 KV"), (32, 2, False, "bf16 KV"),
+            (48, 2, False, "bf16 KV"), (48, 1, False, "f8 KV"),
+            (96, 1, False, "f8 KV"),
+            # paged rows: same DMA-contract accounting + block-table reads
+            # and page-granular pruning — paging's honest per-step overhead.
+            # The dense 96-slot rows above are ROOFLINE-ONLY (the dense
+            # cache cannot be allocated at 96 slots in 16 GB); the paged
+            # rows describe a configuration the engine can actually run.
+            (48, 2, True, "bf16 KV, paged"), (48, 1, True, "f8 KV, paged"),
+            (96, 1, True, "f8 KV, paged"),
+        ):
             by = batched_step_bytes(cfg, slots, live_frac=0.5,
-                                    cache_bytes_per_el=cache_el)
+                                    cache_bytes_per_el=cache_el, paged=paged)
             step_ms = by / V5E_HBM_GBS / 1e6
             agg = slots / step_ms * 1000
             batched.append((f"8b {slots} slots ({tag})", by, step_ms, agg))
@@ -384,7 +409,16 @@ def main():
                 "One fused step reads the weight stream once for ALL slots;\n"
                 "only the KV stream scales with slots. Aggregate tok/s =\n"
                 "slots / step-time. The north star (BASELINE.json,\n"
-                "1000 tok/s/chip serving) is judged on this tier.\n\n"
+                "1000 tok/s/chip serving) is judged on this tier.\n"
+                "'paged' rows add the paged KV layout's per-step overhead\n"
+                "under the same DMA-contract accounting: i32 block-table\n"
+                "reads (k+v, per layer) plus page-granular (128-row)\n"
+                "rounding of the live-KV pruning horizon. The dense\n"
+                "96-slot row is roofline-only — 96 dense slots cannot be\n"
+                "ALLOCATED in 16 GB (96 x 8 Ki-row reservations); the paged\n"
+                "rows describe pools the engine actually allocates\n"
+                "(--kv-layout paged), which is what makes the 96-slot\n"
+                "number reachable.\n\n"
                 "| case | bytes/step | step roofline | aggregate tok/s roofline |\n"
                 "|---|---|---|---|\n")
             for label, by, step_ms, agg in batched:

@@ -32,6 +32,13 @@ missing = []
 for name in metrics.REGISTRY.names():
     if name not in readme:
         missing.append(f"metric:{name}")
+# the paged-KV pool gauges are load-bearing for capacity operations (ISSUE 5
+# acceptance reads dllama_kv_pages_shared): their REMOVAL from the registry
+# must fail here too, not just their absence from the README
+for name in ("dllama_kv_pages_total", "dllama_kv_pages_used",
+             "dllama_kv_pages_shared"):
+    if name not in metrics.REGISTRY.names():
+        missing.append(f"unregistered:{name}")
 for name in sorted(trace.SPAN_CATALOG):
     if name not in readme:
         missing.append(f"span:{name}")

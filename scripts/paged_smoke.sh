@@ -1,0 +1,107 @@
+#!/usr/bin/env bash
+# paged_smoke.sh — end-to-end paged-KV-cache smoke target.
+#
+# Boots `python -m dllama_tpu serve` (the real CLI, not an in-process
+# server) on a freshly generated tiny fixture model with
+# `--kv-layout paged`, waits for /health/ready, runs ONE chat completion,
+# and asserts: the completion succeeds, /health carries the kv_pages
+# occupancy object, and the dllama_kv_pages_{total,used} gauges on /metrics
+# are live (total > 0, used > 0 after the completion's prefix rows were
+# cached) — proving the pool allocator, the paged forward path, the
+# scheduler's capacity accounting, and the observability plumbing agree
+# through the real serving surface. Finishes with a SIGTERM drain.
+#
+# SMOKE TARGET, not a pytest test (lives outside tests/, exempt from the
+# tier-1 run). CPU-only, no model download, ~1 min. Exit 0 = PASS.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import http.client
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.getcwd())
+from tests.test_serve import make_tiny_files  # the tier-1 fixture model
+
+tmp = tempfile.mkdtemp(prefix="dllama_paged_smoke_")
+mpath, tpath, _cfg = make_tiny_files(__import__("pathlib").Path(tmp))
+
+with socket.socket() as s:  # pick a free port
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+
+proc = subprocess.Popen(
+    [sys.executable, "-m", "dllama_tpu", "serve", "--model", mpath,
+     "--tokenizer", tpath, "--slots", "2", "--port", str(port),
+     "--kv-layout", "paged", "--page-size", "8"],
+    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+)
+
+
+def get(path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read().decode()
+    conn.close()
+    return r.status, body
+
+
+def gauge(text, name):
+    m = re.search(rf"^{name} ([0-9.e+-]+)$", text, re.M)
+    return float(m.group(1)) if m else None
+
+
+try:
+    deadline = time.time() + 120  # first-boot XLA compiles on CPU are slow
+    while True:
+        try:
+            if get("/health/ready")[0] == 200:
+                break
+        except OSError:
+            pass
+        if proc.poll() is not None:
+            sys.exit("FAIL: server exited before becoming ready")
+        if time.time() > deadline:
+            sys.exit("FAIL: server never became ready")
+        time.sleep(0.25)
+
+    st, health = get("/health")
+    kv = json.loads(health).get("kv_pages")
+    assert kv and kv["total"] > 0, f"/health kv_pages missing/empty: {kv}"
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", "/v1/chat/completions",
+                 json.dumps({"messages": [{"role": "user", "content": "hi"}],
+                             "max_tokens": 8, "temperature": 0.0}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    conn.close()
+    assert resp.status == 200, f"completion -> {resp.status}"
+    assert body["usage"]["completion_tokens"] > 0
+
+    st, metrics = get("/metrics")
+    assert st == 200
+    total = gauge(metrics, "dllama_kv_pages_total")
+    used = gauge(metrics, "dllama_kv_pages_used")
+    assert total and total > 0, f"dllama_kv_pages_total not live: {total}"
+    # the released slot keeps its prefix rows as reusable cache -> pages
+    # stay referenced after the completion
+    assert used and used > 0, f"dllama_kv_pages_used not live: {used}"
+    print(f"PASS: paged serve OK — kv pages total={total:.0f} "
+          f"used={used:.0f} (health kv_pages={kv})")
+finally:
+    proc.send_signal(signal.SIGTERM)  # exercises the graceful drain path
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+PY

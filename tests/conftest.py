@@ -45,3 +45,35 @@ jax.config.update("jax_default_matmul_precision", "highest")
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+#: The ROADMAP tier-1 verify line is TIME-BUDGETED (870 s — the full suite
+#: does not finish on this box), so order buys coverage: cheapest
+#: tests-per-second first. _RUN_FIRST are the pure-host suites (no model
+#: compile, sub-second tests); the unlisted middle keeps its alphabetical
+#: order; _RUN_LAST are the interpret-mode kernel / virtual-mesh numerics
+#: suites — minutes of pure emulation each, exercising code only a real TPU
+#: runs natively — which spend whatever budget remains. Nothing is skipped
+#: or deselected; an un-budgeted `pytest tests/` still runs everything,
+#: just in this order.
+_RUN_FIRST = (
+    "test_tokenizer.py",
+    "test_trace.py",
+    "test_native.py",
+    "test_converters.py",
+    "test_launch.py",
+)
+_RUN_LAST = (
+    "test_pipeline.py",
+    "test_sharding.py",
+    "test_ring_attention.py",
+    "test_sharded_pallas.py",
+    "test_pallas_kernels.py",
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    first = {name: i - len(_RUN_FIRST) for i, name in enumerate(_RUN_FIRST)}
+    last = {name: i + 1 for i, name in enumerate(_RUN_LAST)}
+    items.sort(key=lambda item: first.get(
+        item.fspath.basename, last.get(item.fspath.basename, 0)))
