@@ -111,6 +111,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve mode, needs --slots > 0: watchdog deadline — a "
                         "device chunk silent for longer flips /health to "
                         "unhealthy (0 = watchdog off)")
+    p.add_argument("--restart-max", type=int, default=0,
+                   help="serve mode, needs --slots > 0: self-healing — on a "
+                        "worker crash, warm-restart the engine in-process "
+                        "(decode state + KV pool rebuilt against resident "
+                        "weights, NO model reload; queued requests survive, "
+                        "in-flight ones resume bit-exact) at most this many "
+                        "times per --restart-window-s, with exponential "
+                        "backoff. 0 = any crash is permanently unhealthy "
+                        "(external supervisor owns the restart)")
+    p.add_argument("--restart-window-s", type=float, default=60.0,
+                   help="serve mode: the sliding window the --restart-max "
+                        "budget counts warm restarts in; budget exhausted "
+                        "within the window = stay down (default 60)")
     p.add_argument("--drain-timeout-s", type=float, default=30.0,
                    help="serve mode: on SIGTERM, stop admission (503) and "
                         "give in-flight requests this long to finish before "
@@ -370,6 +383,8 @@ def cmd_serve(args) -> int:
         admit_ttft_deadline_ms=args.admit_ttft_deadline_ms,
         max_queue=args.max_queue,
         stall_deadline_s=args.stall_deadline_s,
+        restart_max=args.restart_max,
+        restart_window_s=args.restart_window_s,
         drain_timeout_s=args.drain_timeout_s,
         overlap=args.overlap == "on",
         kv_layout=args.kv_layout,

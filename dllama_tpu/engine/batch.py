@@ -24,6 +24,8 @@ the scheduling core of continuous batching. Mechanics:
 
 from __future__ import annotations
 
+import os
+import threading
 import time
 from dataclasses import dataclass
 from functools import partial
@@ -54,6 +56,13 @@ class PageExhausted(RuntimeError):
     pool is undersized for the prompt."""
 
 
+class PoolAuditError(RuntimeError):
+    """A PagePool invariant violation: a double release, a refcount that
+    disagrees with the block tables, or a free-list/live-page overlap.
+    Any raise means the allocator's shared mutable state was corrupt —
+    dllama_kv_audit_failures_total counts every detection."""
+
+
 class PagePool:
     """Host-side refcounted page allocator for the paged KV cache layout.
 
@@ -82,6 +91,16 @@ class PagePool:
         self.tables = np.zeros((n_slots, max_blocks), np.int32)
         self.n_blocks = np.zeros(n_slots, np.int32)
         self._free: list[int] = list(range(n_pages - 1, -1, -1))
+        # reentrant: the scheduler worker is the only mutator, but audit()
+        # is also served from HTTP handler threads (GET /debug/kv) — the
+        # lock keeps a cross-thread audit from reading a half-applied
+        # mutation as corruption
+        self._mu = threading.RLock()
+        # DLLAMA_POOL_AUDIT=1: run the full invariant check after EVERY
+        # release (tests/conftest.py arms it for the whole suite — any page
+        # leak fails at the release that caused it, not at drain)
+        self.audit_on_release = (
+            os.environ.get("DLLAMA_POOL_AUDIT", "") not in ("", "0"))
         self._publish()
 
     # ----------------------------------------------------------- accounting
@@ -102,18 +121,103 @@ class PagePool:
         return int(self.n_blocks[slot]) * self.page_size
 
     def stats(self) -> dict:
-        return {"total": self.n_pages, "free": self.free_count,
-                "used": self.n_pages - self.free_count,
-                "shared": self.shared_count, "page_size": self.page_size}
+        with self._mu:
+            return {"total": self.n_pages, "free": self.free_count,
+                    "used": self.n_pages - self.free_count,
+                    "shared": self.shared_count, "page_size": self.page_size}
+
+    def audit(self, raise_on_fail: bool = True) -> dict:
+        """Invariant checker over the allocator's shared mutable state — the
+        refcounts, block tables, and free list that every admission, COW,
+        prefix share, and release mutate. Run at drain, after warm-restart
+        recovery, on demand via GET /debug/kv, and (under
+        DLLAMA_POOL_AUDIT=1) after every release. Checks:
+
+        * per-page refcount == number of live block-table references;
+        * the free list holds exactly the refcount-0 pages, once each;
+        * no negative refcounts (double releases — also guarded inline);
+        * the published gauges match the recount.
+
+        Returns ``{"ok": bool, "problems": [...], ...stats}``; violations
+        increment dllama_kv_audit_failures_total and (default) raise
+        :class:`PoolAuditError` — corrupt allocator state must never be
+        silently served."""
+        with self._mu:
+            problems: list[str] = []
+            refs = np.zeros(self.n_pages, np.int64)
+            for s in range(self.tables.shape[0]):
+                for b in range(int(self.n_blocks[s])):
+                    p = int(self.tables[s, b])
+                    if 0 <= p < self.n_pages:
+                        refs[p] += 1
+                    else:
+                        problems.append(
+                            f"slot {s} block {b} references page {p} "
+                            f"outside the pool [0, {self.n_pages})")
+            bad = np.flatnonzero(refs != self.refcount)
+            for p in bad[:8]:
+                problems.append(
+                    f"page {int(p)}: refcount {int(self.refcount[p])} but "
+                    f"{int(refs[p])} block-table references")
+            if len(bad) > 8:
+                problems.append(f"... and {len(bad) - 8} more refcount "
+                                "mismatches")
+            neg = np.flatnonzero(self.refcount < 0)
+            if neg.size:
+                problems.append(
+                    f"negative refcounts at pages {neg[:8].tolist()} "
+                    "(double release)")
+            free = set(self._free)
+            if len(free) != len(self._free):
+                problems.append(
+                    f"free list holds duplicates ({len(self._free)} entries, "
+                    f"{len(free)} distinct)")
+            live = {p for p in range(self.n_pages) if self.refcount[p] > 0}
+            overlap = free & live
+            if overlap:
+                problems.append(
+                    f"free list overlaps live pages: {sorted(overlap)[:8]}")
+            orphan = set(range(self.n_pages)) - free - live
+            if orphan:
+                problems.append(
+                    f"leaked pages (refcount 0 but not on the free list): "
+                    f"{sorted(orphan)[:8]}")
+            shared = int(np.count_nonzero(self.refcount > 1))
+            # gauge consistency vs what THIS pool last published (the global
+            # series itself may belong to another pool instance in
+            # multi-engine tests — each _publish overwrites it)
+            if self._published_used != self.n_pages - len(self._free):
+                problems.append(
+                    f"dllama_kv_pages_used published as "
+                    f"{self._published_used} != recount "
+                    f"{self.n_pages - len(self._free)} (a mutation skipped "
+                    "_publish)")
+            if self._published_shared != shared:
+                problems.append(
+                    f"dllama_kv_pages_shared published as "
+                    f"{self._published_shared} != recount {shared}")
+            report = {"ok": not problems, "problems": problems,
+                      "total": self.n_pages, "free": len(self._free),
+                      "used": self.n_pages - len(self._free),
+                      "shared": shared, "page_size": self.page_size}
+        if problems:
+            ins.KV_AUDIT_FAILURES.inc()
+            if raise_on_fail:
+                raise PoolAuditError(
+                    "kv page-pool audit failed: " + "; ".join(problems))
+        return report
 
     def _publish(self) -> None:
+        self._published_used = self.n_pages - self.free_count
+        self._published_shared = self.shared_count
         ins.KV_PAGES_TOTAL.set(self.n_pages)
-        ins.KV_PAGES_USED.set(self.n_pages - self.free_count)
-        ins.KV_PAGES_SHARED.set(self.shared_count)
+        ins.KV_PAGES_USED.set(self._published_used)
+        ins.KV_PAGES_SHARED.set(self._published_shared)
 
     # ------------------------------------------------------------ primitives
 
     def _alloc_page(self) -> int:
+        faults.fire("pool.alloc")
         if not self._free:
             raise PageExhausted(
                 f"page pool exhausted ({self.n_pages} pages of "
@@ -123,6 +227,15 @@ class PagePool:
         return p
 
     def _decref(self, p: int) -> None:
+        if self.refcount[p] <= 0:
+            # double-release guard: decrementing past zero would silently
+            # drive refcounts negative and hand the page to two owners at
+            # once — the worst class of paged-KV corruption. Fail loudly at
+            # the release that caused it.
+            ins.KV_AUDIT_FAILURES.inc()
+            raise PoolAuditError(
+                f"double release of page {p} (refcount already "
+                f"{int(self.refcount[p])})")
         self.refcount[p] -= 1
         if self.refcount[p] == 0:
             self._free.append(p)
@@ -131,24 +244,25 @@ class PagePool:
         """Extend `slot`'s table until its pages cover `rows` logical rows.
         All-or-nothing unless best_effort (then: allocate what the free list
         holds and stop). Returns True when the table changed."""
-        need = self.blocks_for(rows) - int(self.n_blocks[slot])
-        if need <= 0:
-            return False
-        if not best_effort and need > self.free_count:
-            self._publish()
-            raise PageExhausted(
-                f"slot {slot} needs {need} pages to reach row {rows}; "
-                f"{self.free_count} free of {self.n_pages}")
-        changed = False
-        for _ in range(need):
-            if not self._free:
-                break
-            self.tables[slot, self.n_blocks[slot]] = self._alloc_page()
-            self.n_blocks[slot] += 1
-            changed = True
-        if changed:
-            self._publish()
-        return changed
+        with self._mu:
+            need = self.blocks_for(rows) - int(self.n_blocks[slot])
+            if need <= 0:
+                return False
+            if not best_effort and need > self.free_count:
+                self._publish()
+                raise PageExhausted(
+                    f"slot {slot} needs {need} pages to reach row {rows}; "
+                    f"{self.free_count} free of {self.n_pages}")
+            changed = False
+            for _ in range(need):
+                if not self._free:
+                    break
+                self.tables[slot, self.n_blocks[slot]] = self._alloc_page()
+                self.n_blocks[slot] += 1
+                changed = True
+            if changed:
+                self._publish()
+            return changed
 
     def free_tail(self, slot: int, keep_rows: int) -> int:
         """Drop `slot`'s blocks past the one containing row keep_rows-1
@@ -156,64 +270,68 @@ class PagePool:
         to the free list (shared pages just lose one reference). keep_rows
         past the covered range keeps everything — n_blocks must never GROW
         here (that would fabricate coverage backed by unallocated pages)."""
-        keep = min(self.blocks_for(keep_rows), int(self.n_blocks[slot]))
-        freed = 0
-        for b in range(keep, int(self.n_blocks[slot])):
-            p = int(self.tables[slot, b])
-            before = self.free_count
-            self._decref(p)
-            freed += self.free_count - before
-            self.tables[slot, b] = 0
-        if self.n_blocks[slot] != keep:
-            self.n_blocks[slot] = keep
-            self._publish()
-        return freed
+        with self._mu:
+            keep = min(self.blocks_for(keep_rows), int(self.n_blocks[slot]))
+            freed = 0
+            for b in range(keep, int(self.n_blocks[slot])):
+                p = int(self.tables[slot, b])
+                before = self.free_count
+                self._decref(p)
+                freed += self.free_count - before
+                self.tables[slot, b] = 0
+            if self.n_blocks[slot] != keep:
+                self.n_blocks[slot] = keep
+                self._publish()
+            return freed
 
     def ensure_writable(self, slot: int, row: int, copy_fn) -> None:
         """Copy-on-write: make the page holding `row` exclusively owned by
         `slot` before it is (partially) rewritten — a shared page's other
         referents keep the original bytes. copy_fn(src_page, dst_page)
         performs the device copy."""
-        b = int(row) // self.page_size
-        if b >= int(self.n_blocks[slot]):
-            return
-        old = int(self.tables[slot, b])
-        if self.refcount[old] <= 1:
-            return
-        new = self._alloc_page()
-        copy_fn(old, new)
-        self.refcount[old] -= 1  # > 1 before, so never frees
-        self.tables[slot, b] = new
-        self._publish()
+        with self._mu:
+            b = int(row) // self.page_size
+            if b >= int(self.n_blocks[slot]):
+                return
+            old = int(self.tables[slot, b])
+            if self.refcount[old] <= 1:
+                return
+            new = self._alloc_page()
+            copy_fn(old, new)
+            self.refcount[old] -= 1  # > 1 before, so never frees
+            self.tables[slot, b] = new
+            self._publish()
 
     def share_prefix(self, src: int, dst: int, rows: int, copy_fn) -> None:
         """Make dst's first `rows` rows alias src's pages: full pages are
         refcounted (zero copy), a partial boundary page is cloned into a
         fresh page (its tail will diverge immediately). Drops whatever dst
         held before."""
-        self.free_tail(dst, 0)
-        full, part = divmod(int(rows), self.page_size)
-        for b in range(full):
-            p = int(self.tables[src, b])
-            self.refcount[p] += 1
-            self.tables[dst, b] = p
-        self.n_blocks[dst] = full
-        if part:
-            new = self._alloc_page()
-            copy_fn(int(self.tables[src, full]), new)
-            self.tables[dst, full] = new
-            self.n_blocks[dst] = full + 1
-        self._publish()
+        with self._mu:
+            self.free_tail(dst, 0)
+            full, part = divmod(int(rows), self.page_size)
+            for b in range(full):
+                p = int(self.tables[src, b])
+                self.refcount[p] += 1
+                self.tables[dst, b] = p
+            self.n_blocks[dst] = full
+            if part:
+                new = self._alloc_page()
+                copy_fn(int(self.tables[src, full]), new)
+                self.tables[dst, full] = new
+                self.n_blocks[dst] = full + 1
+            self._publish()
 
     def prepare_admission(self, slot: int, start: int, end: int, copy_fn) -> None:
         """Position `slot` for a prefill of rows [start, end): drop the dead
         tail past start, copy-on-write the boundary page when it is both
         kept and shared (rows [block_start, start) must survive the
         overwrite of [start, ...)), then allocate pages through `end`."""
-        self.free_tail(slot, start)
-        if start % self.page_size:
-            self.ensure_writable(slot, start, copy_fn)
-        self.grow(slot, end)
+        with self._mu:
+            self.free_tail(slot, start)
+            if start % self.page_size:
+                self.ensure_writable(slot, start, copy_fn)
+            self.grow(slot, end)
 
     def admission_deficit(self, slot: int, reuse: int, total_rows: int,
                           cross: bool) -> int:
@@ -222,18 +340,19 @@ class PagePool:
         (`cross`: the prefix arrives by share_prefix from another slot) —
         including one reserve page so the first decode rows after the
         prompt cannot immediately starve. 0 means the admission fits."""
-        req = self.blocks_for(total_rows) + 1  # +1 decode-page reserve
-        if cross:
-            kept = int(reuse) // self.page_size  # full shared blocks are free
-            avail = self.free_count + self._tail_refund(slot, 0)
-        else:
-            kept = min(int(self.n_blocks[slot]), self.blocks_for(reuse))
-            avail = self.free_count + self._tail_refund(slot, reuse)
-            b = int(reuse) // self.page_size
-            if (reuse % self.page_size and b < int(self.n_blocks[slot])
-                    and self.refcount[int(self.tables[slot, b])] > 1):
-                req += 1  # boundary copy-on-write page
-        return max(0, req - kept - avail)
+        with self._mu:
+            req = self.blocks_for(total_rows) + 1  # +1 decode-page reserve
+            if cross:
+                kept = int(reuse) // self.page_size  # full shared blocks free
+                avail = self.free_count + self._tail_refund(slot, 0)
+            else:
+                kept = min(int(self.n_blocks[slot]), self.blocks_for(reuse))
+                avail = self.free_count + self._tail_refund(slot, reuse)
+                b = int(reuse) // self.page_size
+                if (reuse % self.page_size and b < int(self.n_blocks[slot])
+                        and self.refcount[int(self.tables[slot, b])] > 1):
+                    req += 1  # boundary copy-on-write page
+            return max(0, req - kept - avail)
 
     def _tail_refund(self, slot: int, keep_rows: int) -> int:
         """Pages free_tail(slot, keep_rows) would return to the free list."""
@@ -286,6 +405,23 @@ class DecodeChunk:
     t_disp: float = 0.0  # dispatch mark on the TRACE clock (time.monotonic;
     # t0 above is perf_counter) — decode_consume's device-window span runs
     # from here to token materialization
+    bad: jax.Array | None = None  # bool[B] rows whose logits went
+    # non-finite inside the scan (the decode NaN guard's device-side half)
+    bad_inject: np.ndarray | None = None  # decode.nan fault overlay
+
+    def nonfinite(self) -> np.ndarray | None:
+        """bool[B] rows whose logits went non-finite during this chunk
+        (real detection from the scan carry, OR'd with any armed
+        ``decode.nan`` injection); None when every row is clean. The
+        scheduler fails flagged rows' REQUESTS (finish_reason='error',
+        rows released unreusable) — a poisoned slot must not crash the
+        engine nor serve garbage tokens."""
+        out = None if self.bad is None else np.asarray(self.bad)
+        if self.bad_inject is not None:
+            out = self.bad_inject if out is None else (out | self.bad_inject)
+        if out is None or not out.any():
+            return None
+        return out
 
 
 class BatchEngine:
@@ -339,6 +475,10 @@ class BatchEngine:
             raise ValueError(f"kv_layout must be dense|paged, got {kv_layout!r}")
         self.kv_layout = kv_layout
         self.page_size = int(page_size)
+        # retained for warm_restart(): a crash-recovery rebuild must recreate
+        # the cache/pool with the exact construction-time parameters
+        self.cache_dtype = cache_dtype
+        self._shardings = shardings
         self.pool: PagePool | None = None
         if kv_layout == "paged":
             if shardings is not None:
@@ -554,7 +694,7 @@ class BatchEngine:
     def _decode_impl(cfg, attn_fn, col_fn, mm, mm_in, moe_impl, params, cache, tokens,
                      pos_vec, active, keys, temps, topps, n, rope, limit):
         def body(carry, _):
-            tok, cache, p, keys = carry
+            tok, cache, p, keys, bad = carry
             # per-ROW freeze at the cache edge: a slot that fills its last
             # row mid-chunk stops sampling/advancing while batch-mates keep
             # their full chunk (the old whole-batch clamp shrank everyone's
@@ -570,17 +710,23 @@ class BatchEngine:
                                     cache, rope, attn_fn,
                                     active=act, col_fn=col_fn, mm=mm,
                                     mm_in=mm_in, moe_impl=moe_impl, last_only=True)
+            # NaN guard, device-side half: a row whose logits went
+            # non-finite is flagged (sticky across the chunk) so the
+            # scheduler can fail THAT request instead of serving garbage —
+            # inactive/frozen rows legitimately compute junk and are masked
+            bad = bad | (act & ~jnp.isfinite(logits[:, -1]).all(axis=-1))
             splits = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
             nkeys, subs = splits[:, 0], splits[:, 1]
             keys = jnp.where(act[:, None], nkeys, keys)
             nxt = _sample_rows(logits[:, -1], subs, temps, topps)[:, None]
             nxt = jnp.where(act[:, None], nxt, tok)  # frozen slots keep token
-            return (nxt, cache, p + act.astype(jnp.int32), keys), nxt[:, 0]
+            return (nxt, cache, p + act.astype(jnp.int32), keys, bad), nxt[:, 0]
 
-        (last, cache, pos2, keys), toks = jax.lax.scan(
-            body, (tokens, cache, pos_vec, keys), None, length=n
+        bad0 = jnp.zeros(tokens.shape[0], bool)
+        (last, cache, pos2, keys, bad), toks = jax.lax.scan(
+            body, (tokens, cache, pos_vec, keys, bad0), None, length=n
         )
-        return toks, cache, keys, pos2, last[:, 0]
+        return toks, cache, keys, pos2, last[:, 0], bad
 
     @staticmethod
     def _decode_penalized_impl(cfg, attn_fn, col_fn, mm, mm_in, moe_impl, params,
@@ -597,7 +743,7 @@ class BatchEngine:
         b = tokens.shape[0]
 
         def body(carry, _):
-            tok, cache, p, keys, counts = carry
+            tok, cache, p, keys, counts, bad = carry
             # same per-row freeze as _decode_impl: a slot frozen at the cache
             # edge must not inflate its counts with its repeated last token
             act = jnp.asarray(active) & (p < limit)
@@ -608,18 +754,23 @@ class BatchEngine:
                                     cache, rope, attn_fn,
                                     active=act, col_fn=col_fn, mm=mm,
                                     mm_in=mm_in, moe_impl=moe_impl, last_only=True)
+            # same sticky non-finite flag as _decode_impl (raw logits,
+            # before penalties — penalties can only subtract finite values)
+            bad = bad | (act & ~jnp.isfinite(logits[:, -1]).all(axis=-1))
             splits = jax.vmap(jax.random.split)(keys)
             nkeys, subs = splits[:, 0], splits[:, 1]
             keys = jnp.where(act[:, None], nkeys, keys)
             pen = apply_penalties(logits[:, -1], counts, presence, frequency)
             nxt = _sample_rows(pen, subs, temps, topps)[:, None]
             nxt = jnp.where(act[:, None], nxt, tok)
-            return (nxt, cache, p + act.astype(jnp.int32), keys, counts), nxt[:, 0]
+            return (nxt, cache, p + act.astype(jnp.int32), keys, counts,
+                    bad), nxt[:, 0]
 
-        (last, cache, pos2, keys, counts), toks = jax.lax.scan(
-            body, (tokens, cache, pos_vec, keys, counts), None, length=n
+        bad0 = jnp.zeros(b, bool)
+        (last, cache, pos2, keys, counts, bad), toks = jax.lax.scan(
+            body, (tokens, cache, pos_vec, keys, counts, bad0), None, length=n
         )
-        return toks, cache, keys, pos2, last[:, 0], counts
+        return toks, cache, keys, pos2, last[:, 0], counts, bad
 
     @staticmethod
     def _spec_step_impl(cfg, attn_fn, col_fn, mm, mm_in, moe_impl, k, ngram,
@@ -804,6 +955,46 @@ class BatchEngine:
         on the dense layout."""
         return None if self.pool is None else self.pool.stats()
 
+    def warm_restart(self) -> None:
+        """Crash recovery WITHOUT a model reload: rebuild everything a
+        failed chunk may have poisoned — the KV cache buffers (the jitted
+        steps donate them, so an exception mid-step leaves them
+        indeterminate), the page pool, and every per-slot decode vector —
+        against the still-resident weights. The jitted callables are
+        untouched (same shapes ⇒ no recompile), so a warm restart costs one
+        cache allocation, not a checkpoint reload. The serving scheduler
+        calls this under its --restart-max budget and then re-admits
+        surviving requests (Scheduler._try_restart)."""
+        if self.pool is not None:
+            max_blocks = self.seq_len // self.page_size
+            audit_flag = self.pool.audit_on_release
+            self.pool = PagePool(self.pool.n_pages, self.page_size,
+                                 self.n_slots, max_blocks)
+            self.pool.audit_on_release = audit_flag
+            self.cache = PagedKVCache.create(
+                self.cfg, self.n_slots, self.pool.n_pages, self.page_size,
+                self.cache_dtype, max_blocks)
+        else:
+            self.cache = KVCache.create(self.cfg, self.n_slots,
+                                        self.cache_dtype, self.seq_len)
+        if self._shardings is not None:
+            self.cache = self._shardings.put_cache(self.cache)
+        self.pos[:] = 0
+        self.active[:] = False
+        self.last_token[:] = 0
+        self.temperature[:] = 0.0
+        self.topp[:] = 0.9
+        self.presence[:] = 0.0
+        self.frequency[:] = 0.0
+        self._counts = None
+        self._last_dev = jnp.zeros(self.n_slots, jnp.int32)
+        self._keys_dev = jnp.asarray(self.keys.copy())
+        self._t_last_consume = None
+        if self.spec_k:
+            self.history = jnp.full((self.n_slots, self.seq_len + 1), -1,
+                                    jnp.int32)
+        self._vec_dirty = True
+
     def copy_prefix_rows(self, src_slot: int, dst_slot: int, rows: int) -> None:
         """Cross-slot prefix share (the serving tier's RadixAttention-lite):
         make dst_slot's first `rows` KV rows identical to src_slot's, so an
@@ -975,6 +1166,45 @@ class BatchEngine:
             )
         return first
 
+    def resume_commit(self, adm: "Admission", last_token: int, key,
+                      temperature: float = 0.8, topp: float = 0.9,
+                      presence: float = 0.0, frequency: float = 0.0,
+                      counted=None) -> None:
+        """Activate a slot from warm-restart recovery. The admission
+        re-prefilled prompt + already-emitted tokens EXCEPT the last one
+        (a sampled token's KV row only exists once it is fed back); this
+        commit installs that last token and the request's recorded PRNG
+        `key` as the decode carry WITHOUT sampling anything new — the
+        resumed stream's next token is exactly what the uninterrupted run
+        would have produced. `counted` (penalized requests only) lists the
+        tokens fed so far, to rebuild the on-device occurrence counts."""
+        assert adm.off >= len(adm.toks), "admission not pumped"
+        slot = adm.slot
+        self.keys[slot] = np.asarray(key)
+        self.active[slot] = True
+        self.last_token[slot] = int(last_token)
+        self.temperature[slot] = temperature
+        self.topp[slot] = topp
+        self.presence[slot] = presence
+        self.frequency[slot] = frequency
+        self._vec_dirty = True
+        self._last_dev = self._last_dev.at[slot].set(int(last_token))
+        self._keys_dev = self._keys_dev.at[slot].set(jnp.asarray(self.keys[slot]))
+        if presence or frequency:
+            if self._counts is None:
+                self._counts = jnp.zeros((self.n_slots, self.cfg.vocab_size),
+                                         jnp.int32)
+            row = np.zeros(self.cfg.vocab_size, np.int32)
+            if counted:
+                np.add.at(row, np.asarray(counted, np.int64), 1)
+            self._counts = self._counts.at[slot].set(jnp.asarray(row))
+        if self.spec_k:
+            # invariant: history[slot, pos] holds the slot's unfed token
+            self.history = self._hist_write(
+                self.history, jnp.int32(slot), jnp.int32(self.pos[slot]),
+                jnp.full((1,), int(last_token), jnp.int32),
+            )
+
     def add(self, slot: int, prompt_tokens: list[int], temperature: float = 0.8,
             topp: float = 0.9, start_pos: int = 0, seed: int | None = None,
             presence: float = 0.0, frequency: float = 0.0,
@@ -1073,16 +1303,23 @@ class BatchEngine:
             or (self.frequency[self.active] != 0).any()
         ):
             (toks, self.cache, self._keys_dev, self._pos_dev, self._last_dev,
-             self._counts) = self._decode_pen(
+             self._counts, bad) = self._decode_pen(
                 *args, self._counts, self._pres_dev, self._freq_dev)
         else:
-            toks, self.cache, self._keys_dev, self._pos_dev, self._last_dev = (
-                self._decode(*args))
+            (toks, self.cache, self._keys_dev, self._pos_dev, self._last_dev,
+             bad) = self._decode(*args)
         start_pos = self.pos.copy()
         active = self.active.copy()
         advance = np.where(
             active, np.clip(limit - start_pos, 0, n), 0
         ).astype(np.int32)
+        bad_inject = None
+        if faults.flag("decode.nan"):
+            # drill the NaN guard without needing genuinely poisoned
+            # weights: flag the lowest active slot as if its logits went
+            # non-finite — the scheduler's consume path fails that request
+            bad_inject = np.zeros(self.n_slots, bool)
+            bad_inject[int(np.flatnonzero(active)[0])] = True
         if self.spec_k:
             # history backfill rides the device stream off the
             # not-yet-materialized tokens (no host round-trip). Rows whose
@@ -1099,7 +1336,7 @@ class BatchEngine:
         self.chunk_seq += 1
         return DecodeChunk(toks=toks, n=n, start_pos=start_pos, active=active,
                            advance=advance, t0=t0, seq=self.chunk_seq,
-                           t_disp=t_disp)
+                           t_disp=t_disp, bad=bad, bad_inject=bad_inject)
 
     def decode_consume(self, chunk: DecodeChunk) -> np.ndarray:
         """Block until the chunk's tokens are on host; fold them into the
@@ -1229,4 +1466,9 @@ class BatchEngine:
         elif self.pool is not None:
             self.pool.free_tail(slot, 0)
             self.pos[slot] = 0
+        if self.pool is not None and self.pool.audit_on_release:
+            # DLLAMA_POOL_AUDIT=1 (armed suite-wide by tests/conftest.py):
+            # any refcount/free-list corruption fails AT the release that
+            # caused it instead of surfacing as a mystery pages-leak later
+            self.pool.audit()
         self._vec_dirty = True

@@ -141,3 +141,17 @@ WATCHDOG_STALLS = metrics.counter(
 WATCHDOG_RECOVERIES = metrics.counter(
     "dllama_watchdog_recoveries_total",
     "Watchdog stall flags cleared after heartbeats resumed")
+ENGINE_RESTARTS = metrics.counter(
+    "dllama_engine_restarts_total",
+    "Warm engine restarts after a worker crash: decode state + page pool "
+    "rebuilt against resident weights (no model reload), --restart-max "
+    "budgeted")
+REQUESTS_RECOVERED = metrics.counter(
+    "dllama_requests_recovered_total",
+    "Requests that survived a warm restart and re-entered a slot (mid-"
+    "stream resumes re-prefill prompt + emitted tokens; mid-prefill "
+    "admissions restart their prefill)")
+KV_AUDIT_FAILURES = metrics.counter(
+    "dllama_kv_audit_failures_total",
+    "PagePool.audit() invariant violations + double-release guards: any "
+    "nonzero value means the paged KV allocator state was corrupt")

@@ -62,6 +62,9 @@ EVENT_CATALOG = {
     "fault.fire": "an armed fault injection activated; args carry point/action (track: scheduler)",
     "profile.start": "an on-demand jax.profiler capture started; args carry dir (track: profiler)",
     "profile.stop": "the on-demand capture stopped and wrote its files (track: profiler)",
+    "engine.restart": "warm restart after a worker crash: decode state + page pool rebuilt, weights resident; args carry attempt/error (track: scheduler)",
+    "request.recovered": "a request survived a warm restart and re-entered a slot; args carry resumed token count (track: requests)",
+    "request.timeout": "a request hit its per-request deadline (timeout_s / X-Request-Timeout); args carry where (queued/prefill/decoding) (track: requests)",
 }
 
 

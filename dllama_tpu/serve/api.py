@@ -71,6 +71,24 @@ class ClientDisconnected(Exception):
     into a dead socket."""
 
 
+def _parse_timeout(body: dict) -> float | None:
+    """Per-request deadline: `timeout_s` in the request body (do_POST also
+    folds an `X-Request-Timeout` header into it). Seconds from submission
+    until the request is ended with finish_reason="timeout" — expired-in-
+    queue requests never prefill, running ones stop at the next chunk
+    boundary. None/absent = no deadline."""
+    v = body.get("timeout_s")
+    if v is None:
+        return None
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        raise ApiError(400, "timeout_s must be a number of seconds") from None
+    if not v > 0:
+        raise ApiError(400, "timeout_s must be > 0")
+    return v
+
+
 @dataclass
 class PrefixCache:
     """NaiveCache equivalent: remember the last conversation's messages and
@@ -200,6 +218,7 @@ class ApiServer:
         frequency = float(body.get("frequency_penalty") or 0.0)
         seed = body.get("seed", self.defaults["seed"])
         max_tokens = int(body.get("max_tokens") or body.get("max_completion_tokens") or 0)
+        timeout_s = _parse_timeout(body)
         extra_stops = body.get("stop") or []
         if isinstance(extra_stops, str):
             extra_stops = [extra_stops]
@@ -208,7 +227,7 @@ class ApiServer:
             return self._complete_batched(
                 body, messages, temperature, topp, max_tokens, extra_stops, emit,
                 seed=seed, presence=presence, frequency=frequency, probe=probe,
-                req_id=req_id,
+                req_id=req_id, timeout_s=timeout_s,
             )
 
         self._trace_single_submit(req_id, t_submit)
@@ -227,14 +246,23 @@ class ApiServer:
                 presence, frequency)
             content, finish, n_generated, t_first = self._run_single(
                 prompt_tokens, budget, sampler,
-                self.stops + list(extra_stops), emit, probe=probe)
-            # cache the full conversation incl. the reply for the next turn
-            self.cache.messages = messages + [("assistant", content)]
-            self.cache.pos = self.engine.pos
-            self.cache.bos_sent = True
+                self.stops + list(extra_stops), emit, probe=probe,
+                deadline=None if timeout_s is None else t_submit + timeout_s)
+            if finish == "timeout" and n_generated == 0:
+                # expired on the engine lock: _run_single returned before
+                # ANY engine work, so the pre-call cache state is still the
+                # truth — recording the new conversation here would claim KV
+                # rows that were never prefilled and make the next turn
+                # resolve past a user message the model never saw
+                pass
+            else:
+                # cache the full conversation incl. the reply for the next turn
+                self.cache.messages = messages + [("assistant", content)]
+                self.cache.pos = self.engine.pos
+                self.cache.bos_sent = True
         timings = self._single_tier_timings(
             req_id, t_submit, t_admit, t_first, n_generated,
-            len(prompt_tokens), start_pos, finish)
+            len(prompt_tokens), start_pos, finish, timeout_s=timeout_s)
 
         return {
             "timings": timings,
@@ -275,6 +303,7 @@ class ApiServer:
         sent — a failure after the 200/chunked headers would corrupt the
         stream). Deeper failures (context window) still surface as HTTP 4xx
         on the non-streaming path."""
+        _parse_timeout(body)  # a malformed timeout_s is a clean 400 too
         if legacy:
             self._normalize_legacy_prompt(body)
             return
@@ -310,11 +339,15 @@ class ApiServer:
 
     @staticmethod
     def _single_tier_timings(req_id, t_submit, t_admit, t_first, n_generated,
-                             prompt_len, reused, finish) -> dict:
+                             prompt_len, reused, finish,
+                             timeout_s=None) -> dict:
         """Build the response `timings` object for a single-engine completion
         and close out its flight-recorder record (lock wait plays the role
         of queue wait; prefill has no separate mark on this tier — TTFT
-        covers it)."""
+        covers it). Deadline fields mirror the batched tier's
+        Request.timings(): present whenever the request carried a deadline,
+        so clients keying on `deadline_exceeded` behave the same on both
+        serving tiers."""
         t_done = time.monotonic()
         timings = {
             "queue_wait_ms": round((t_admit - t_submit) * 1000.0, 3),
@@ -323,6 +356,9 @@ class ApiServer:
             "e2e_ms": round((t_done - t_submit) * 1000.0, 3),
             "decode_tokens": n_generated,
         }
+        if timeout_s is not None:
+            timings["timeout_s"] = timeout_s
+            timings["deadline_exceeded"] = finish == "timeout"
         tr = trace.TRACER
         if tr.enabled and req_id:
             tr.req_admitted(req_id, t=t_admit)
@@ -330,11 +366,17 @@ class ApiServer:
                         reused_tokens=reused)
             if t_first is not None:
                 tr.req_first_token(req_id, t=t_first)
+            if finish == "timeout":
+                # same postmortem breadcrumb the scheduler leaves: on this
+                # tier "queued" means the deadline expired on the lock wait
+                tr.event("request.timeout", cat="deadline", track="requests",
+                         req_id=req_id,
+                         where="queued" if n_generated == 0 else "decoding")
             tr.req_end(req_id, finish, t=t_done, **timings)
         return timings
 
     def _run_single(self, prompt_tokens, budget, sampler, stops, emit,
-                    probe=None) -> tuple[str, str, int, float | None]:
+                    probe=None, deadline=None) -> tuple[str, str, int, float | None]:
         """Token loop of a single-engine completion (generate + EOS/stop
         detection + held-prefix flush) -> (content, finish_reason, n_tokens,
         first_token_monotonic_or_None — the TTFT mark of the `timings`
@@ -345,6 +387,11 @@ class ApiServer:
         holds the global engine lock, so cancelling it unblocks every other
         client, not just a slot. The engine is left mid-generation; the next
         request's reset()/prefix-cache miss rewrites those rows."""
+        if deadline is not None and time.monotonic() >= deadline:
+            # expired while waiting on the engine lock (this tier's
+            # "queue"): return before ANY engine work — no prefill, no
+            # decode — matching the batched tier's expired-in-queue shed
+            return "", "timeout", 0, None
         detector = EosDetector(self.tokenizer.eos_ids, stops,
                                padding_left=2, padding_right=2)
         self.tokenizer.reset_decoder()
@@ -352,6 +399,7 @@ class ApiServer:
         n_generated = 0
         finish = "length"
         t_first = None
+        timed_out = False
         probe_at = time.monotonic() + 0.25
         for t in self.engine.generate(prompt_tokens, budget, sampler,
                                       spec=self.spec):
@@ -371,8 +419,23 @@ class ApiServer:
             if res == EosResult.EOS:
                 finish = "stop"
                 break
+            if deadline is not None and time.monotonic() >= deadline:
+                # per-request deadline on the single-engine tier: the lock
+                # wait (this tier's "queue") counts toward it — a clean
+                # terminal finish, never an error
+                finish = "timeout"
+                timed_out = True
+                break
         else:
             # budget exhausted mid-held-prefix: the partial stop never completes
+            text = detector.flush()
+            if text:
+                parts.append(text)
+                if emit is not None:
+                    emit(text)
+        if timed_out:
+            # flush any held stop-prefix like the budget path: what was
+            # generated is delivered, just cut short
             text = detector.flush()
             if text:
                 parts.append(text)
@@ -382,7 +445,8 @@ class ApiServer:
 
     def _complete_batched(self, body, messages, temperature, topp, max_tokens,
                           extra_stops, emit, seed=None, presence=0.0,
-                          frequency=0.0, probe=None, req_id: str = "") -> dict:
+                          frequency=0.0, probe=None, req_id: str = "",
+                          timeout_s=None) -> dict:
         """Continuous-batching completion: submit to the scheduler, stream from
         the per-request queue. Per-request `seed` pins the slot's own PRNG
         stream (reproducible regardless of batch-mates). Prefix reuse lives in
@@ -397,7 +461,7 @@ class ApiServer:
             prompt_tokens, temperature, topp, max_tokens,
             self.stops + list(extra_stops), emit,
             seed=seed, presence=presence, frequency=frequency, probe=probe,
-            req_id=req_id)
+            req_id=req_id, timeout_s=timeout_s)
         return {
             "timings": timings,
             "id": f"chatcmpl-{uuid.uuid4().hex[:16]}",
@@ -420,7 +484,8 @@ class ApiServer:
 
     def _run_batched(self, prompt_tokens, temperature, topp, max_tokens,
                      stops, emit, seed=None, presence=0.0,
-                     frequency=0.0, probe=None, req_id: str = "") -> tuple[str, str, int, dict]:
+                     frequency=0.0, probe=None, req_id: str = "",
+                     timeout_s=None) -> tuple[str, str, int, dict]:
         """Token-level core of a batched completion: submit, stream-decode
         with EOS/stop detection, return (content, finish_reason, n_tokens,
         timings) — `timings` is the request's span-sourced latency object
@@ -446,7 +511,7 @@ class ApiServer:
             prompt_tokens, temperature, topp, budget, self.tokenizer.eos_ids,
             presence=presence, frequency=frequency,
             seed=int(seed) if seed is not None else None,
-            req_id=req_id,
+            req_id=req_id, timeout_s=timeout_s,
         )
         parts: list[str] = []
         n_generated = 0
@@ -491,9 +556,11 @@ class ApiServer:
             # finished{reason} metric matches what the client is told below
             self.scheduler.cancel(
                 req, reason="stop" if ended_on_eos else "cancelled")
-        # scheduler reasons: stop/length pass through; a cancel here means the
-        # stream ended on a string stop-sequence -> "stop"
-        finish = req.finish_reason if req.finish_reason in ("stop", "length") else "stop"
+        # scheduler reasons: stop/length/timeout pass through; a cancel here
+        # means the stream ended on a string stop-sequence -> "stop"
+        finish = (req.finish_reason
+                  if req.finish_reason in ("stop", "length", "timeout")
+                  else "stop")
         timings = req.timings()
         if timings["e2e_ms"] is None:
             # a stop-string release is finalized asynchronously by the worker;
@@ -519,6 +586,7 @@ class ApiServer:
         frequency = float(body.get("frequency_penalty") or 0.0)
         seed = body.get("seed", self.defaults["seed"])
         max_tokens = int(body.get("max_tokens") or 16)  # OpenAI legacy default
+        timeout_s = _parse_timeout(body)
         extra_stops = body.get("stop") or []
         if isinstance(extra_stops, str):
             extra_stops = [extra_stops]
@@ -529,7 +597,7 @@ class ApiServer:
                 prompt_tokens, temperature, topp, max_tokens,
                 list(extra_stops),  # raw prompt: no chat-template stops
                 emit, seed=seed, presence=presence, frequency=frequency,
-                probe=probe, req_id=req_id)
+                probe=probe, req_id=req_id, timeout_s=timeout_s)
         else:
             self._trace_single_submit(req_id, t_submit)
             with self.lock:
@@ -543,10 +611,12 @@ class ApiServer:
                 # legacy endpoint: no chat stop strings, only explicit ones
                 content, finish, n_generated, t_first = self._run_single(
                     prompt_tokens, budget, sampler, list(extra_stops), emit,
-                    probe=probe)
+                    probe=probe,
+                    deadline=(None if timeout_s is None
+                              else t_submit + timeout_s))
             timings = self._single_tier_timings(
                 req_id, t_submit, t_admit, t_first, n_generated,
-                len(prompt_tokens), 0, finish)
+                len(prompt_tokens), 0, finish, timeout_s=timeout_s)
 
         return {
             "timings": timings,
@@ -600,6 +670,7 @@ _KNOWN_PATHS = {
     "/debug/trace": "/debug/trace",
     "/debug/requests": "/debug/requests",
     "/debug/profile": "/debug/profile",
+    "/debug/kv": "/debug/kv",
 }
 
 
@@ -693,10 +764,32 @@ class _Handler(BaseHTTPRequestHandler):
             except OSError:
                 pass
 
+    def _debug_kv(self) -> None:
+        """GET /debug/kv — paged KV pool occupancy plus a full
+        PagePool.audit() run on demand: the operator's allocator-integrity
+        probe (refcounts vs block tables, free-list disjointness, gauge
+        consistency). 200 with audit.ok=true when clean; 500 when the audit
+        found corruption (alertable). Works without the span tracer."""
+        sched = self.api.scheduler
+        pool = (getattr(sched.engine, "pool", None)
+                if sched is not None else None)
+        if pool is None:
+            self._send_json(200, {"layout": "dense", "pool": None,
+                                  "audit": None})
+            return
+        report = pool.audit(raise_on_fail=False)
+        self._send_json(200 if report["ok"] else 500,
+                        {"layout": "paged", "page_size": pool.page_size,
+                         "pool": pool.stats(), "audit": report})
+
     def _debug_get(self) -> None:
         """GET /debug/trace (Chrome trace-event JSON for Perfetto),
-        GET /debug/requests (flight-recorder summaries), and
-        GET /debug/requests/{req_id} (one request's full timeline)."""
+        GET /debug/requests (flight-recorder summaries),
+        GET /debug/requests/{req_id} (one request's full timeline), and
+        GET /debug/kv (paged-pool occupancy + on-demand audit)."""
+        if self.path == "/debug/kv":
+            self._debug_kv()  # independent of the span tracer
+            return
         tr = trace.TRACER
         if not tr.enabled:
             self._send_json(404, {"error": {
@@ -776,6 +869,12 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, json.JSONDecodeError):
             self._send_json(400, {"error": {"message": "invalid JSON body"}})
             return
+        tmo_hdr = self.headers.get("X-Request-Timeout")
+        if tmo_hdr is not None and isinstance(body, dict) \
+                and "timeout_s" not in body:
+            # header form of the per-request deadline (proxies/gateways set
+            # it without touching the JSON body); an explicit body field wins
+            body["timeout_s"] = tmo_hdr
         try:
             if self.api.draining:
                 ins.REQUESTS_SHED.labels(reason="draining").inc()
@@ -969,6 +1068,9 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
         log.warning("--max-queue / --stall-deadline-s need --slots > 0; the "
                     "single-engine tier has no admission queue or worker "
                     "thread to watch — ignored")
+    if n_slots <= 0 and defaults.get("restart_max"):
+        log.warning("--restart-max needs --slots > 0; the single-engine tier "
+                    "has no scheduler worker to warm-restart — ignored")
     if n_slots <= 0 and defaults.get("kv_layout") == "paged":
         log.warning("--kv-layout paged needs --slots > 0; the single-engine "
                     "tier keeps its dense per-sequence cache — ignored")
@@ -1019,6 +1121,13 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
             sched_kw["max_queue"] = int(defaults["max_queue"])
         if defaults.get("stall_deadline_s"):
             sched_kw["stall_deadline_s"] = float(defaults["stall_deadline_s"])
+        # self-healing (--restart-max / --restart-window-s): warm engine
+        # restart on worker crash, budgeted; 0 keeps crash = permanent
+        # unhealthy (external supervisor owns the restart)
+        if defaults.get("restart_max"):
+            sched_kw["restart_max"] = int(defaults["restart_max"])
+        if defaults.get("restart_window_s") is not None:
+            sched_kw["restart_window_s"] = float(defaults["restart_window_s"])
         # overlapped decode pipeline (--overlap, default on): chunk N+1
         # dispatches before chunk N's tokens are consumed; off restores the
         # lockstep loop for A/B (token streams are identical either way)

@@ -16,6 +16,19 @@ inherit the same one-chunk overrun contract: the in-flight chunk keeps
 decoding a just-finished slot, its tokens are discarded at consumption, and
 release(keep_rows=) rewinds the slot to the truly-emitted prefix.
 
+**Self-healing** (ISSUE 6): with ``restart_max > 0`` a worker crash
+warm-restarts the engine in-process — decode state and the KV page pool are
+rebuilt against the still-resident weights (no model reload), queued
+requests survive untouched, and in-flight streams resume bit-exact by
+re-prefilling prompt + emitted tokens with their recorded PRNG key
+(`_try_restart`). Budget-bounded (``restart_max`` within
+``restart_window_s``, capped exponential backoff); budget exhausted falls
+back to the PR 1 permanent-unhealthy contract. Per-request deadlines
+(``timeout_s``) shed expired queued requests before prefill and finish
+running ones with ``finish_reason="timeout"`` at a chunk boundary; the
+decode NaN guard fails a request whose logits go non-finite without
+touching its batch-mates.
+
 **Per-slot prefix cache** (the batched-tier NaiveCache, dllama-api.cpp:264-309):
 released slots keep their KV rows and the token history that produced them.
 Admission matches a new request's prompt against every idle slot's history and
@@ -95,6 +108,30 @@ class Request:
     # not pollute the finished{reason="cancelled"} counter
     cancel_reason: str = "cancelled"
     cancelled: threading.Event = field(default_factory=threading.Event)
+    # per-request deadline (body `timeout_s` / X-Request-Timeout header):
+    # expired-in-queue requests are shed before prefill, running ones finish
+    # with finish_reason="timeout" at the next chunk boundary. deadline_at
+    # is the absolute monotonic deadline (submit time + timeout_s).
+    timeout_s: float | None = None
+    deadline_at: float | None = None
+    # warm-restart recovery (set by Scheduler._try_restart, consumed at
+    # re-admission): resume_tokens are the tokens already emitted to the
+    # client — all but the last are re-prefilled (teacher-forced), the last
+    # becomes the decode carry's fed token; resume_key is the request's
+    # PRNG key advanced to the interruption point, so a resumed sampled
+    # stream is bit-exact. `recovered` marks the request for the
+    # requests_recovered counter at its post-restart (re)commit.
+    resume_tokens: list[int] | None = None
+    resume_key: object | None = None
+    recovered: bool = False
+    # PRNG advances already baked into engine.keys[slot] at the last
+    # (re)commit: 0 after a fresh add_commit (the row holds the commit-time
+    # key), produced-1 after a resume_commit (the row holds a key
+    # pre-advanced to the interruption point). A SECOND warm restart must
+    # replay only the advances since — replaying the cumulative `produced`
+    # would double-count the pre-first-crash tokens and silently break the
+    # bit-exact-resume guarantee for sampled streams.
+    key_advances: int = 0
     # latency marks (time.monotonic): the serving-tier observability the
     # reference's per-token console lines provide (dllama.cpp:82-87)
     submitted_at: float = 0.0
@@ -127,9 +164,15 @@ class Request:
         ttft = self.ttft_ms
         e2e = (None if self.finished_at is None
                else round((self.finished_at - self.submitted_at) * 1000.0, 3))
-        return {"queue_wait_ms": qw,
-                "ttft_ms": None if ttft is None else round(ttft, 3),
-                "e2e_ms": e2e, "decode_tokens": self.produced}
+        out = {"queue_wait_ms": qw,
+               "ttft_ms": None if ttft is None else round(ttft, 3),
+               "e2e_ms": e2e, "decode_tokens": self.produced}
+        if self.timeout_s is not None:
+            # deadline accounting rides the same summary: what was asked,
+            # and whether the deadline (not EOS/budget) ended the request
+            out["timeout_s"] = self.timeout_s
+            out["deadline_exceeded"] = self.finish_reason == "timeout"
+        return out
 
     def tokens(self, poll=None, poll_s: float = 0.25):
         """Blocking iterator over generated tokens (ends on EOS/budget/cancel).
@@ -161,7 +204,10 @@ class Scheduler:
                  admit_ttft_deadline_ms: float | None = None,
                  max_queue: int = 0,
                  stall_deadline_s: float = 0.0,
-                 overlap: bool = True):
+                 overlap: bool = True,
+                 restart_max: int = 0,
+                 restart_window_s: float = 60.0,
+                 restart_backoff_s: float = 0.5):
         self.engine = engine
         self.chunk = chunk
         self.admit_timeout = admit_timeout
@@ -233,6 +279,21 @@ class Scheduler:
         ins.SLOTS_TOTAL.set(engine.n_slots)
         self._wake = threading.Event()
         self._stop = threading.Event()
+        # ---- self-healing (warm restart): on a worker crash, tear down
+        # decode state + page pool, rebuild against the still-resident
+        # weights (no model reload) and re-enter the loop — at most
+        # --restart-max times within --restart-window-s, with exponential
+        # backoff (restart_backoff_s * 2^(attempt-1)). 0 keeps the PR 1
+        # behavior: any crash is permanent-unhealthy, the external
+        # supervisor owns the restart.
+        self.restart_max = int(restart_max)
+        self.restart_window_s = float(restart_window_s)
+        self.restart_backoff_s = float(restart_backoff_s)
+        self._restarts: list[float] = []  # monotonic stamps inside the window
+        self.restart_count = 0  # lifetime total (health/observability)
+        # requests that survived a restart, awaiting re-admission at the
+        # queue head (mid-stream resumes first, in submission order)
+        self._recover: list[Request] = []
         # ---- supervision state (all read by health(), written by the worker
         # or watchdog; plain attribute stores are atomic under the GIL)
         self.crashed: BaseException | None = None  # worker died with this
@@ -262,12 +323,16 @@ class Scheduler:
 
     def submit(self, prompt, temperature, topp, max_tokens, eos_ids,
                seed: int | None = None, presence: float = 0.0,
-               frequency: float = 0.0, req_id: str = "") -> Request:
+               frequency: float = 0.0, req_id: str = "",
+               timeout_s: float | None = None) -> Request:
         self.check_admission()
         req = Request(list(prompt), float(temperature), float(topp), int(max_tokens),
                       frozenset(eos_ids), seed=seed, presence=float(presence),
                       frequency=float(frequency), submitted_at=time.monotonic(),
                       req_id=req_id)
+        if timeout_s is not None and timeout_s > 0:
+            req.timeout_s = float(timeout_s)
+            req.deadline_at = req.submitted_at + req.timeout_s
         # flight-recorder record BEFORE the queue put: the worker may pop and
         # admit the request before this thread runs again
         trace.TRACER.req_submit(req.req_id, prompt_tokens=len(req.prompt),
@@ -325,6 +390,7 @@ class Scheduler:
         """Whether the worker owes anyone progress (watchdog gating: an idle
         worker parked on its wake event must never read as stalled)."""
         return (bool(self.slots) or bool(self._inflight)
+                or bool(self._recover)
                 or self._deferred is not None or not self.pending.empty())
 
     def health(self) -> dict:
@@ -360,6 +426,11 @@ class Scheduler:
             "draining": self._draining.is_set(),
             "crashed": repr(self.crashed) if self.crashed is not None else None,
             "join_failed": self.join_failed,
+            # warm-restart supervision: lifetime restarts, the budget, and
+            # how many recovered requests still await re-admission
+            "restarts": self.restart_count,
+            "restart_max": self.restart_max,
+            "recovering": len(self._recover),
         }
 
     def drain(self, timeout_s: float = 30.0) -> bool:
@@ -387,6 +458,15 @@ class Scheduler:
                         self.pending.qsize())
         trace.TRACER.event("drain.end", cat="lifecycle", track="scheduler",
                            clean=clean)
+        pool = getattr(self.engine, "pool", None)
+        if pool is not None:
+            # allocator integrity check at the lifecycle boundary: a drain
+            # that leaks pages (or drove refcounts inconsistent) is reported
+            # here — and counted — even when the serving run looked clean
+            report = pool.audit(raise_on_fail=False)
+            if not report["ok"]:
+                log.error("kv page-pool audit FAILED at drain: %s",
+                          "; ".join(report["problems"]))
         self.shutdown()
         return clean
 
@@ -450,6 +530,10 @@ class Scheduler:
     #: how long shutdown() waits for the worker before declaring it wedged
     #: (attribute, not constant: fault drills shrink it instead of sleeping)
     join_timeout_s: float = 10.0
+
+    #: ceiling on the exponential restart backoff (attribute, not constant:
+    #: the chaos soak shrinks it so hundreds of injected crashes stay fast)
+    restart_backoff_max_s: float = 5.0
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -589,10 +673,12 @@ class Scheduler:
         return {s: int(n) for s, n in zip(donors, lens)}
 
     def _queue_depth(self) -> int:
-        """Requests owed service but not yet admitted: the pending queue
-        plus the capacity-deferred head (one definition for the gauge,
-        /health, and the --max-queue shed bound — they must not disagree)."""
-        return self.pending.qsize() + (1 if self._deferred is not None else 0)
+        """Requests owed service but not yet admitted: the pending queue,
+        the capacity-deferred head, and any restart-recovered requests
+        awaiting re-admission (one definition for the gauge, /health, and
+        the --max-queue shed bound — they must not disagree)."""
+        return (self.pending.qsize() + (1 if self._deferred is not None else 0)
+                + len(self._recover))
 
     def _evict_idle_pages(self, needed: int, exclude: set) -> bool:
         """Paged prefix-cache reclaim: drop idle slots' cached pages
@@ -615,6 +701,50 @@ class Scheduler:
             self.slot_tokens[s] = []
         return freed > 0
 
+    def _shed_timeout(self, req: Request, where: str = "queued") -> None:
+        """Terminal 'timeout' finish for a not-yet-admitted request: shed
+        BEFORE prefill — no slot, no pages, no device work spent on a
+        request whose client stopped waiting. A timeout is a clean terminal
+        finish, not an error: the stream just ends with
+        finish_reason="timeout"."""
+        ins.REQUESTS_SHED.labels(reason="timeout").inc()
+        trace.TRACER.event("request.timeout", cat="deadline",
+                           track="requests", req_id=req.req_id, where=where)
+        # _finish handles the rest (slot is -1: no release) — crucially the
+        # _completed ring append, so queue-expired timeouts show up in
+        # latency_summary() exactly like decode-boundary ones
+        self._finish(req, "timeout")
+
+    def _shed_expired_queued(self) -> None:
+        """Deadline sweep over requests the worker has NOT admitted yet:
+        the pending queue, the capacity-deferred head, and the restart-
+        recover list. The pop path below also checks deadlines, but a
+        saturated server (every slot busy, or a parked deferred head) can
+        go entire requests without popping anything — timeout_s must bound
+        the client's wait even when no slot ever frees. Runs once per
+        chunk boundary, same granularity as the running-request check."""
+        now = time.monotonic()
+
+        def expired(r: Request) -> bool:
+            return r.deadline_at is not None and now >= r.deadline_at
+
+        dead: list[Request] = []
+        with self.pending.mutex:
+            q = self.pending.queue
+            if any(expired(r) for r in q):
+                dead.extend(r for r in q if expired(r))
+                keep = [r for r in q if not expired(r)]
+                q.clear()
+                q.extend(keep)
+        if self._deferred is not None and expired(self._deferred):
+            dead.append(self._deferred)
+            self._deferred = None
+        if any(expired(r) for r in self._recover):
+            dead.extend(r for r in self._recover if expired(r))
+            self._recover = [r for r in self._recover if not expired(r)]
+        for req in dead:
+            self._shed_timeout(req)
+
     def _admit_starts(self) -> None:
         """Pop pending requests into in-flight admissions while slots allow.
 
@@ -624,11 +754,20 @@ class Scheduler:
         `_deferred` (FIFO head; later requests wait behind it) until
         releases free capacity. Shedding still applies while it waits: the
         deferred request counts toward --max-queue depth."""
+        self._shed_expired_queued()
         reserved = len(self._inflight)
-        while self._deferred is not None or not self.pending.empty():
+        while (self._recover or self._deferred is not None
+               or not self.pending.empty()):
             if int((~self.engine.active).sum()) - reserved <= 0:
                 return
-            if self._deferred is not None:
+            from_recover = False
+            if self._recover:
+                # restart-recovered requests re-admit FIRST (they are the
+                # oldest work in the system); mid-stream resumes re-prefill
+                # prompt + emitted tokens below
+                req = self._recover.pop(0)
+                from_recover = True
+            elif self._deferred is not None:
                 req, self._deferred = self._deferred, None
             else:
                 try:
@@ -641,7 +780,18 @@ class Scheduler:
                 self._observe_finish(req)
                 req.out.put(_END)
                 continue
-            if len(req.prompt) >= self.engine.seq_len:
+            if (req.deadline_at is not None
+                    and time.monotonic() >= req.deadline_at):
+                # expired between the sweep and the pop: same shed path
+                self._shed_timeout(req)
+                continue
+            # the rows this admission must write: the prompt — plus, for a
+            # restart resume, every already-emitted token except the last
+            # (a sampled token's KV row only exists once it is fed back;
+            # the last one becomes the decode carry via resume_commit)
+            toks = (req.prompt if req.resume_tokens is None
+                    else req.prompt + req.resume_tokens[:-1])
+            if len(toks) >= self.engine.seq_len:
                 # reject BEFORE slot search or any donor copy: a hopeless
                 # admission must not evict a slot's cached prefix (nor pay
                 # the per-slot LCP scan)
@@ -649,12 +799,12 @@ class Scheduler:
                 req.finished_at = time.monotonic()
                 self._observe_finish(req)
                 req.out.put(ValueError(
-                    f"prompt ({len(req.prompt)}) exceeds seq_len {self.engine.seq_len}"
+                    f"prompt ({len(toks)}) exceeds seq_len {self.engine.seq_len}"
                 ))
                 continue
             pool = getattr(self.engine, "pool", None)
             if (pool is not None
-                    and self.engine.min_pages_for(len(req.prompt)) > pool.n_pages):
+                    and self.engine.min_pages_for(len(toks)) > pool.n_pages):
                 # never-fits reject: the prompt's pages (+ the decode
                 # reserve) must ALL be resident at once, and reused/shared
                 # prefix pages still occupy pool pages — so the bound is
@@ -665,26 +815,32 @@ class Scheduler:
                 req.finished_at = time.monotonic()
                 self._observe_finish(req)
                 req.out.put(ValueError(
-                    f"prompt ({len(req.prompt)}) needs "
-                    f"{self.engine.min_pages_for(len(req.prompt))} KV pages; "
+                    f"prompt ({len(toks)}) needs "
+                    f"{self.engine.min_pages_for(len(toks))} KV pages; "
                     f"the pool holds {pool.n_pages}"))
                 continue
-            slot, reuse, donor = self._pick_slot(req.prompt)
+            slot, reuse, donor = self._pick_slot(toks)
             cross = donor is not None and donor != slot and reuse > 0
             deficit = self.engine.admission_deficit(slot, reuse,
-                                                    len(req.prompt), cross)
+                                                    len(toks), cross)
             if deficit > 0:
                 # pool short: reclaim just enough idle cache (keeping the
                 # destination and donor — their rows are this admission's
                 # reuse), then re-pick (eviction may change the best donor)
                 if self._evict_idle_pages(deficit, {slot, donor}):
-                    slot, reuse, donor = self._pick_slot(req.prompt)
+                    slot, reuse, donor = self._pick_slot(toks)
                     cross = donor is not None and donor != slot and reuse > 0
-                if self.engine.admission_deficit(slot, reuse, len(req.prompt),
+                if self.engine.admission_deficit(slot, reuse, len(toks),
                                                  cross) > 0:
                     # still short: every missing page is held by RUNNING
-                    # requests — park at the head until releases free them
-                    self._deferred = req
+                    # requests — park at the head until releases free them.
+                    # A recovered request parks back at the recover head
+                    # (the _deferred box may already hold the pre-crash
+                    # queue head — never overwrite it).
+                    if from_recover:
+                        self._recover.insert(0, req)
+                    else:
+                        self._deferred = req
                     return
             try:
                 if cross:
@@ -694,11 +850,20 @@ class Scheduler:
                     self.slot_tokens[slot] = list(
                         self.slot_tokens.get(donor, [])[:reuse]
                     )
-                adm = self.engine.add_begin(slot, req.prompt[reuse:],
+                adm = self.engine.add_begin(slot, toks[reuse:],
                                             start_pos=reuse, req_id=req.req_id)
             except Exception as e:  # bad request (too long, …) — fail just this one
                 log.exception("admission rejected",
                               extra={"request_id": req.req_id})
+                # the slot's cache state is unknown: a paged add_begin may
+                # have freed + partially reallocated its pages before
+                # failing (e.g. a pool.alloc fault mid-grow), so the old
+                # token-history claim could map reused prompts onto
+                # uninitialized rows. Drop the claim and the pages — safe,
+                # merely losing this slot's prefix reuse.
+                self.slot_tokens[slot] = []
+                if hasattr(self.engine, "drop_slot_pages"):
+                    self.engine.drop_slot_pages(slot)
                 req.finish_reason = "error"
                 req.finished_at = time.monotonic()
                 self._observe_finish(req)
@@ -717,6 +882,9 @@ class Scheduler:
         # preserve them (keep_rows=None) nor miss the metrics ring
         self.slot_tokens[adm.slot] = []
         if isinstance(reason, Exception):
+            # reason BEFORE the put: a client reads finish_reason the moment
+            # the exception lands on its queue — it must never see None
+            req.finish_reason = "error"
             req.out.put(reason)
             reason = "error"
         self._finish(req, reason)
@@ -734,6 +902,16 @@ class Scheduler:
             if req.cancelled.is_set():
                 self._inflight.pop(0)
                 self._abort_admission(req, adm, "cancelled")
+                continue
+            if (req.deadline_at is not None
+                    and time.monotonic() >= req.deadline_at):
+                # deadline crossed mid-prefill: stop spending chunks on it —
+                # the slot's partial rows are abandoned like a cancel's
+                self._inflight.pop(0)
+                trace.TRACER.event("request.timeout", cat="deadline",
+                                   track="requests", req_id=req.req_id,
+                                   where="prefill")
+                self._abort_admission(req, adm, "timeout")
                 continue
             try:
                 tr = trace.TRACER
@@ -759,18 +937,49 @@ class Scheduler:
                                total=len(adm.toks))
                 worked = True
                 if done:
-                    first = self.engine.add_commit(adm, req.temperature, req.topp,
-                                                   seed=req.seed,
-                                                   presence=req.presence,
-                                                   frequency=req.frequency)
-                    self._inflight.pop(0)
-                    self.reused_prefix_tokens += reuse  # rows actually served
-                    ins.REUSED_PREFIX_TOKENS.inc(reuse)
-                    self.slot_tokens[adm.slot] = list(req.prompt)
-                    self.slots[adm.slot] = req
-                    trace.TRACER.req_prefill_done(
-                        req.req_id, tokens=len(req.prompt), reused=reuse)
-                    self._emit(req, first, int(self.engine.pos[adm.slot]))
+                    if req.resume_tokens is not None:
+                        # restart resume: install the last emitted token and
+                        # the recorded PRNG key as the decode carry — no new
+                        # token is sampled, so the client's stream continues
+                        # exactly where the crash cut it
+                        self.engine.resume_commit(
+                            adm, req.resume_tokens[-1], req.resume_key,
+                            req.temperature, req.topp,
+                            presence=req.presence, frequency=req.frequency,
+                            counted=(req.resume_tokens[:-1]
+                                     if (req.presence or req.frequency)
+                                     else None))
+                        self._inflight.pop(0)
+                        self.slot_tokens[adm.slot] = (list(req.prompt)
+                                                      + list(req.resume_tokens))
+                        self.slots[adm.slot] = req
+                        trace.TRACER.req_prefill_done(
+                            req.req_id, tokens=len(adm.toks) + reuse,
+                            reused=reuse)
+                    else:
+                        first = self.engine.add_commit(adm, req.temperature,
+                                                       req.topp,
+                                                       seed=req.seed,
+                                                       presence=req.presence,
+                                                       frequency=req.frequency)
+                        self._inflight.pop(0)
+                        self.reused_prefix_tokens += reuse  # rows really served
+                        ins.REUSED_PREFIX_TOKENS.inc(reuse)
+                        self.slot_tokens[adm.slot] = list(req.prompt)
+                        self.slots[adm.slot] = req
+                        trace.TRACER.req_prefill_done(
+                            req.req_id, tokens=len(req.prompt), reused=reuse)
+                        self._emit(req, first, int(self.engine.pos[adm.slot]))
+                    if req.recovered:
+                        # counted at the moment the request really made it
+                        # back into a slot (not at restart time — it could
+                        # still fail or cancel during re-admission)
+                        req.recovered = False
+                        ins.REQUESTS_RECOVERED.inc()
+                        trace.TRACER.event("request.recovered",
+                                           cat="supervision", track="requests",
+                                           req_id=req.req_id,
+                                           tokens=req.produced)
             except Exception as e:
                 log.exception("prefill failed",
                               extra={"request_id": req.req_id})
@@ -827,6 +1036,9 @@ class Scheduler:
         if self._deferred is not None:
             self._fail_req(self._deferred, exc)
             self._deferred = None
+        for req in self._recover:
+            self._fail_req(req, exc)
+        self._recover = []
         for req in list(self.slots.values()):
             self._fail_req(req, exc)
         self.slots.clear()
@@ -869,16 +1081,142 @@ class Scheduler:
                             "stall flag (%d total stalls)", self.stall_count)
 
     def _run(self) -> None:
-        """Supervised worker entry: any escape from the serving loop fails
-        every in-flight request (finish_reason='error', queues unblocked)
-        and flips the health flag instead of silently stranding clients."""
-        try:
-            self._loop()
-        except BaseException as e:  # noqa: BLE001 — supervision must be total
-            self.crashed = e
-            log.exception("scheduler worker crashed; failing all in-flight "
-                          "requests and marking /health unhealthy")
-            self._fail_all(e)
+        """Supervised worker entry: any escape from the serving loop first
+        attempts a warm restart under the --restart-max budget (decode state
+        + page pool rebuilt against resident weights, surviving requests
+        recovered, the loop re-entered); with no budget — or a restart that
+        itself dies — it falls back to PR 1 semantics: every in-flight
+        request fails fast (finish_reason='error', queues unblocked) and
+        /health flips permanently unhealthy."""
+        while True:
+            try:
+                self._loop()
+                return
+            except BaseException as e:  # noqa: BLE001 — supervision must be total
+                try:
+                    if self._try_restart(e):
+                        continue
+                except BaseException as e2:  # noqa: BLE001 — restart died too
+                    log.exception("warm restart failed; giving up")
+                    e = e2
+                self.crashed = e
+                log.exception("scheduler worker crashed; failing all "
+                              "in-flight requests and marking /health "
+                              "unhealthy")
+                self._fail_all(e)
+                return
+
+    #: one jitted fori_loop shared by every restart: replaying a 4000-token
+    #: stream must cost ONE dispatch, not 4000 serial split() round-trips
+    #: on the worker thread while every recovered request waits
+    _advance_key_fn = staticmethod(jax.jit(lambda key, n: jax.lax.fori_loop(
+        0, n, lambda _, k: jax.random.split(k)[0], key)))
+
+    @classmethod
+    def _advance_key(cls, key0, n: int) -> np.ndarray:
+        """Replay the decode scan's per-token threefry advance: the
+        device-side key after emitting n decode tokens is split(key)[0]
+        applied n times to the last (re)commit-time key (BatchEngine.keys
+        row). The live carry is lost with the crashed chunk, but its value
+        is a pure function of the start key and the emitted-token count —
+        which is what makes resumed sampled streams bit-exact."""
+        key = jax.numpy.asarray(np.asarray(key0), jax.numpy.uint32)
+        return np.asarray(cls._advance_key_fn(key, jax.numpy.int32(n)))
+
+    def _try_restart(self, exc: BaseException) -> bool:
+        """Warm restart after a worker crash. Returns False when the budget
+        (--restart-max within --restart-window-s) is spent or restarts are
+        disabled — the caller then applies the permanent-unhealthy path.
+
+        Recovery semantics: queued + capacity-deferred requests survive
+        untouched; mid-prefill admissions restart their prefill from
+        scratch; mid-stream requests resume by re-prefilling prompt +
+        already-emitted tokens with their recorded PRNG key and position
+        (bit-exact continuation — clients see no duplicate or dropped
+        tokens); requests whose state cannot be trusted fail individually
+        with finish_reason='error'."""
+        if self.restart_max <= 0 or self._stop.is_set():
+            return False
+        now = time.monotonic()
+        self._restarts = [t for t in self._restarts
+                          if now - t < self.restart_window_s]
+        if len(self._restarts) >= self.restart_max:
+            log.error("restart budget exhausted (%d within --restart-window-s"
+                      " %.1fs); staying down", self.restart_max,
+                      self.restart_window_s)
+            return False
+        self._restarts.append(now)
+        self.restart_count += 1
+        attempt = len(self._restarts)
+        ins.ENGINE_RESTARTS.inc()
+        trace.TRACER.event("engine.restart", cat="supervision",
+                           track="scheduler", attempt=attempt,
+                           error=repr(exc))
+        log.warning("scheduler worker crashed (%r); warm restart %d/%d "
+                    "(window %.1fs)", exc, attempt, self.restart_max,
+                    self.restart_window_s)
+        faults.fire("engine.restart")  # drill: a restart that itself dies
+        # exponential backoff, capped: repeated crashes inside one window
+        # space their restarts out without ever sleeping unboundedly (the
+        # budget, not the backoff, is what gives up)
+        delay = min(self.restart_backoff_s * (2 ** min(attempt - 1, 10)),
+                    self.restart_backoff_max_s)
+        deadline = now + delay
+        while time.monotonic() < deadline and not self._stop.is_set():
+            # heartbeat-stamped backoff sleep: the watchdog must read
+            # "restarting" as progress, not as a hung device chunk
+            self._heartbeat = time.monotonic()
+            time.sleep(min(0.05, max(0.0, deadline - time.monotonic())))
+        # ---- collect the recovery set BEFORE touching the engine (the
+        # host-side records are intact; only device state is suspect)
+        recover: list[Request] = []
+        for slot, req in sorted(self.slots.items(),
+                                key=lambda kv: kv[1].submitted_at):
+            emitted = self.slot_tokens.get(slot, [])[len(req.prompt):]
+            req.slot = -1
+            if req.produced < 1 or len(emitted) != req.produced:
+                # bookkeeping drift between the emit records — resuming
+                # could duplicate or drop tokens; fail this one request
+                self._fail_req(req, RuntimeError(
+                    "request not recoverable across engine restart "
+                    f"(emitted-token record {len(emitted)} != produced "
+                    f"{req.produced})"))
+                continue
+            req.resume_tokens = list(emitted)
+            # advance by the tokens emitted SINCE the last (re)commit only:
+            # after a prior resume, keys[slot] is already an advanced key —
+            # replaying the cumulative produced-1 would double-count
+            req.resume_key = self._advance_key(
+                self.engine.keys[slot],
+                req.produced - 1 - req.key_advances)
+            req.key_advances = req.produced - 1
+            req.recovered = True
+            recover.append(req)
+        self.slots.clear()
+        for req, _adm, _ in self._inflight:
+            # mid-prefill: no tokens reached the client yet — re-prefill the
+            # whole prompt (their partially-written rows died with the cache)
+            req.slot = -1
+            req.recovered = True
+            recover.append(req)
+        self._inflight.clear()
+        self.slot_tokens.clear()
+        # ---- rebuild decode state + page pool against resident weights
+        self.engine.warm_restart()
+        pool = getattr(self.engine, "pool", None)
+        if pool is not None:
+            pool.audit()  # a fresh pool failing audit means the rebuild is
+            # broken — crash the restart (budget-accounted) rather than
+            # serve from a corrupt allocator
+        self._recover = recover + self._recover
+        self._t_dec_end = None
+        self._t_consumed = None
+        self._heartbeat = time.monotonic()
+        self._wake.set()
+        log.warning("warm restart complete: %d request(s) recovered for "
+                    "re-admission, %d queued untouched",
+                    len(recover), self.pending.qsize())
+        return True
 
     def _needs_boundary(self, inflight_chunk=None) -> bool:
         """True when the next chunk must wait for a fully-consumed pipeline:
@@ -892,9 +1230,15 @@ class Scheduler:
         if self._stop.is_set() or getattr(self.engine, "spec_k", 0):
             return True
         if (not self.slots or self._inflight or self._deferred is not None
-                or not self.pending.empty()):
+                or self._recover or not self.pending.empty()):
             return True
-        if any(r.cancelled.is_set() for r in self.slots.values()):
+        now = time.monotonic()
+        if any(r.cancelled.is_set()
+               or (r.deadline_at is not None and now >= r.deadline_at)
+               for r in self.slots.values()):
+            # a pending cancel OR an expired per-request deadline needs
+            # boundary work: "running requests finish with
+            # finish_reason='timeout' at the next chunk boundary"
             return True
         # row limit = seq_len on dense; on paged also each slot's allocated
         # pages — a slot AT its limit needs boundary work (finish at the
@@ -1018,9 +1362,24 @@ class Scheduler:
             tr.span_at("decode.consume", t0, tr.now(), cat="decode",
                        track="scheduler", chunk=chunk.seq, n=chunk.n)
             t_emit = tr.now()
+        bad = chunk.nonfinite()  # NaN guard: rows whose logits went
+        # non-finite (or an armed decode.nan injection) — fail THOSE
+        # requests, not the engine; their chunk tokens are garbage and are
+        # never emitted, their rows are released unreusable
         for slot, req in snapshot.items():
             if self.slots.get(slot) is not req:
                 continue  # finished mid-flight: overrun tokens discarded
+            if bad is not None and bad[slot]:
+                log.error("non-finite logits in decode chunk %d (slot %d); "
+                          "failing the request, engine stays up",
+                          chunk.seq, slot, extra={"request_id": req.req_id})
+                self.slot_tokens[slot] = []  # rows are poisoned: never reuse
+                req.finish_reason = "error"  # before the put (client-visible)
+                req.out.put(RuntimeError(
+                    f"non-finite logits in decode chunk {chunk.seq}; "
+                    "request failed (engine healthy)"))
+                self._finish(req, "error")
+                continue
             if tr.enabled and chunk.advance[slot]:
                 # flight-recorder chunk entry BEFORE the tokens reach the
                 # client queue: a response never races its own record
@@ -1063,6 +1422,16 @@ class Scheduler:
             for slot, req in list(self.slots.items()):
                 if req.cancelled.is_set():
                     self._finish(req, req.cancel_reason,
+                                 keep_rows=int(self.engine.pos[slot]))
+                elif (req.deadline_at is not None
+                      and time.monotonic() >= req.deadline_at):
+                    # per-request deadline: the stream ends cleanly at this
+                    # chunk boundary with finish_reason="timeout"; the rows
+                    # already emitted keep their prefix-cache value
+                    trace.TRACER.event("request.timeout", cat="deadline",
+                                       track="requests", req_id=req.req_id,
+                                       where="decoding")
+                    self._finish(req, "timeout",
                                  keep_rows=int(self.engine.pos[slot]))
                 elif int(self.engine.pos[slot]) >= self.engine.seq_len:
                     self._finish(req, "length")
@@ -1123,6 +1492,9 @@ class Scheduler:
         # silently truncated content). One path for all three places a client
         # can be parked: mid-admission, decoding, still queued.
         def cut(req: Request) -> None:
+            # reason BEFORE the put: the client reads finish_reason as soon
+            # as the exception lands — it must never observe None
+            req.finish_reason = "shutdown"
             req.out.put(SchedulerDraining(
                 "server shut down before this request completed"))
             self._finish(req, "shutdown")  # metrics ring + _END + slot release
@@ -1136,6 +1508,9 @@ class Scheduler:
         if self._deferred is not None:
             cut(self._deferred)
             self._deferred = None
+        for req in self._recover:
+            cut(req)
+        self._recover = []
         while True:
             try:
                 cut(self.pending.get_nowait())

@@ -18,6 +18,16 @@ Injection points (armed sites call :func:`fire` with their point name):
 ``scheduler.queue``     admission-queue overflow: Scheduler.submit sheds the
                         request as if --max-queue were exceeded
 ``scheduler.loop``      top of the scheduler worker loop (worker-crash drill)
+``pool.alloc``          before a paged-KV page allocation (PagePool._alloc_page)
+                        — fails an admission per-request, or crashes a decode
+                        top-up into the warm-restart path
+``engine.restart``      inside the warm-restart sequence, before the engine
+                        rebuild (Scheduler._try_restart) — drills a restart
+                        that itself dies, which exhausts the budget
+``decode.nan``          poisons one slot of the next decode chunk as if its
+                        logits went non-finite (``raise`` armed, consumed via
+                        :func:`flag`): the scheduler's NaN guard fails THAT
+                        request with finish_reason="error", not the engine
 ======================  =====================================================
 
 Actions: ``raise`` (throw :class:`InjectedFault`) and ``delay`` (sleep
@@ -58,6 +68,9 @@ POINTS = frozenset({
     "loader.read",
     "scheduler.queue",
     "scheduler.loop",
+    "pool.alloc",
+    "engine.restart",
+    "decode.nan",
 })
 
 ACTIONS = frozenset({"raise", "delay"})
@@ -176,15 +189,32 @@ def active(point: str) -> bool:
     return point in _plan
 
 
-def fire(point: str) -> None:
-    """The armed-site hook: no-op unless a fault is installed at `point`.
-    Raises InjectedFault for 'raise', sleeps for 'delay'."""
+def pending(point: str) -> bool:
+    """Whether an armed fault at `point` can still fire (its ``times``
+    window is not exhausted). A plan entry outlives its last firing — this
+    is how a drill detects that some OTHER thread consumed the activation
+    it armed (e.g. a fixture server's idle worker loop) and re-arms."""
     f = _plan.get(point)
     if f is None:
-        return
+        return False
+    with f.lock:
+        if f.times is not None and f.fired >= f.times:
+            return False
+        return True
+
+
+def flag(point: str) -> bool:
+    """The armed-site hook for sites with their OWN failure semantics (e.g.
+    ``decode.nan``, where the failure is poisoned data, not an exception):
+    returns True when an armed ``raise`` fault at `point` fires — counted at
+    /metrics and on the trace timeline exactly like :func:`fire` — instead
+    of raising. ``delay`` still sleeps and returns False."""
+    f = _plan.get(point)
+    if f is None:
+        return False
     action = f.visit()
     if action is None:
-        return
+        return False
     # every activation is a countable incident: drills and live mishaps
     # alike show up at /metrics (dllama_fault_fires_total{point,action})
     # AND on the request-flow trace timeline (/debug/trace)
@@ -195,7 +225,13 @@ def fire(point: str) -> None:
         log.warning("injected delay at %r: %.0f ms", point, f.ms,
                     extra={"fault_point": point})
         time.sleep(f.ms / 1000.0)
-    else:
-        log.warning("injected fault at %r", point,
-                    extra={"fault_point": point})
+        return False
+    log.warning("injected fault at %r", point, extra={"fault_point": point})
+    return True
+
+
+def fire(point: str) -> None:
+    """The armed-site hook: no-op unless a fault is installed at `point`.
+    Raises InjectedFault for 'raise', sleeps for 'delay'."""
+    if flag(point):
         raise InjectedFault(point)

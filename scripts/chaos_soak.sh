@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# chaos_soak.sh — the ISSUE 6 acceptance soak: >=200 mixed greedy/sampled/
+# penalized/deadline requests through a warm-restart-enabled paged scheduler
+# under a seeded randomized fault schedule (crashes, delays, pool-alloc
+# failures, NaN injections). Asserts: 100% terminal finish reasons, a clean
+# PagePool.audit() with zero leaked pages, /health recovered to live+ready,
+# and restart/recovered/timeout counters reconciled against the flight
+# recorder. Exit 0 = survived.
+#
+#   CHAOS_REQUESTS=200 CHAOS_SEED=0 scripts/chaos_soak.sh
+#
+# CPU-only and hermetic (tiny random-weight model, no model files). The
+# fast bounded variant runs in tier-1 as tests/test_chaos.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+exec env JAX_PLATFORMS=cpu DLLAMA_POOL_AUDIT=1 python experiments/chaos.py \
+    --requests "${CHAOS_REQUESTS:-200}" \
+    --seed "${CHAOS_SEED:-0}" \
+    --clients "${CHAOS_CLIENTS:-4}"

@@ -4,11 +4,12 @@
 #   1. `python -m compileall` over the package, tests, and bench — syntax
 #      errors fail here in milliseconds instead of mid-suite;
 #   2. observability catalog drift check — every metric registered in
-#      dllama_tpu/obs/instruments.py and every span/event name in
-#      dllama_tpu/obs/trace.{SPAN,EVENT}_CATALOG must appear in README.md's
-#      Observability tables. The catalogs are the single definition sites;
-#      this keeps the docs from silently rotting when an instrument or a
-#      trace point is added.
+#      dllama_tpu/obs/instruments.py, every span/event name in
+#      dllama_tpu/obs/trace.{SPAN,EVENT}_CATALOG, and every fault-injection
+#      point in dllama_tpu/utils/faults.POINTS must appear in README.md.
+#      The catalogs are the single definition sites; this keeps the docs
+#      from silently rotting when an instrument, a trace point, or a fault
+#      point is added.
 #
 # Pure host: imports only dllama_tpu.obs (stdlib-only — no jax, no model),
 # so it runs anywhere in <1s. Exit 0 = PASS.
@@ -46,10 +47,21 @@ for name in sorted(trace.EVENT_CATALOG):
     if name not in readme:
         missing.append(f"event:{name}")
 
+# fault-injection points (utils/faults.POINTS is the single definition
+# site, armed sites call fire()/flag() with these names): each must be
+# documented in the README Operations section AND in the faults.py
+# docstring table — an undrillable failure path is not a failure path
+from dllama_tpu.utils import faults
+for name in sorted(faults.POINTS):
+    if name not in readme:
+        missing.append(f"fault:{name}")
+    if name not in (faults.__doc__ or ""):
+        missing.append(f"fault-docstring:{name}")
+
 if missing:
     sys.exit("README observability-catalog drift — document these in the "
              "README tables: " + ", ".join(missing))
 print(f"checks: catalog drift OK ({len(metrics.REGISTRY.names())} metrics, "
-      f"{len(trace.SPAN_CATALOG)} spans, {len(trace.EVENT_CATALOG)} events "
-      "all documented)")
+      f"{len(trace.SPAN_CATALOG)} spans, {len(trace.EVENT_CATALOG)} events, "
+      f"{len(faults.POINTS)} fault points all documented)")
 PY

@@ -14,6 +14,10 @@ import os
 # late — the config update below is the authoritative override. XLA_FLAGS is
 # still read lazily at first backend init, so setting it here works.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Paged-KV allocator auditing after EVERY release (engine/batch.PagePool):
+# any refcount/free-list corruption fails at the release that caused it,
+# suite-wide, instead of surfacing as a mystery page leak later.
+os.environ.setdefault("DLLAMA_POOL_AUDIT", "1")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

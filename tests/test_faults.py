@@ -201,7 +201,9 @@ def test_injected_queue_overflow():
         with pytest.raises(QueueFull):
             sched.submit([1, 2], 0.0, 0.9, 4, eos_ids=frozenset())
         req = sched.submit([1, 2], 0.0, 0.9, 4, eos_ids=frozenset())  # disarmed
-        toks, exc = drain_tokens(req, timeout=5.0)
+        # 30s: first token may pay a cold decode compile when this test runs
+        # early in a (re)ordered suite
+        toks, exc = drain_tokens(req, timeout=30.0)
         assert exc is None and len(toks) == 4
     finally:
         faults.clear()
@@ -473,6 +475,56 @@ def test_http_nonstream_disconnect_cancels_request(fserver):
     assert cancelled.produced < 400  # nowhere near the (clamped) budget
 
 
+def test_http_request_timeout_body_and_header(fserver):
+    """`timeout_s` in the body (and the X-Request-Timeout header) ends a
+    running completion with finish_reason="timeout" — a clean 200 with the
+    deadline fields in `timings`, not an error."""
+    import http.client
+
+    from tests.test_serve import post
+
+    port, _api, _ = fserver
+    faults.install("engine.decode", "delay", ms=40.0)
+    try:
+        st, data = post(port, "/v1/chat/completions",
+                        {"messages": [{"role": "user", "content": "hi"}],
+                         "max_tokens": 4096, "temperature": 0.0,
+                         "timeout_s": 0.4})
+        assert st == 200
+        out = json.loads(data)
+        assert out["choices"][0]["finish_reason"] == "timeout"
+        assert out["timings"]["timeout_s"] == 0.4
+        assert out["timings"]["deadline_exceeded"] is True
+        # header form (proxies set it without touching the JSON body)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("POST", "/v1/chat/completions",
+                     json.dumps({"messages": [{"role": "user", "content": "x"}],
+                                 "max_tokens": 4096, "temperature": 0.0}),
+                     {"Content-Type": "application/json",
+                      "X-Request-Timeout": "0.3"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200
+        assert out["choices"][0]["finish_reason"] == "timeout"
+    finally:
+        faults.clear()
+    # malformed timeout is a clean 400, stream or not
+    st, data = post(port, "/v1/chat/completions",
+                    {"messages": [{"role": "user", "content": "x"}],
+                     "timeout_s": "soon"})
+    assert st == 400 and b"timeout_s" in data
+
+
+def test_http_debug_kv_dense(fserver):
+    """GET /debug/kv answers on the dense layout too (layout marker, no
+    audit) — the paged audit payload is covered at the pool level in
+    tests/test_paged_kv.py and by the chaos soak."""
+    port, _api, _ = fserver
+    st, body, _ = _get(port, "/debug/kv")
+    assert st == 200 and body["layout"] == "dense" and body["audit"] is None
+
+
 def test_http_drain_503_and_inflight_completes(fserver):
     """graceful_drain over HTTP: in-flight finishes with 200, new requests
     get 503 + Retry-After, then the listener stops. Runs LAST against this
@@ -626,3 +678,221 @@ def test_engine_add_cooperative_abort():
     assert not eng.active[0]  # slot still admits fresh work
     first = eng.add(0, [1, 2, 3], temperature=0.0, seed=1)
     assert isinstance(first, int)
+
+
+# ------------------------------------------------- warm restart (ISSUE 6)
+
+
+def test_warm_restart_resumes_streams_bit_exact():
+    """The ISSUE 6 crash drill: with --restart-max 2, a scheduler.loop crash
+    mid-stream warm-restarts the engine in-process (no model reload, no
+    external supervisor). The interrupted GREEDY stream resumes bit-exact
+    against an uninterrupted reference run, the interrupted SAMPLED stream
+    resumes bit-exact too (recorded PRNG key replay), a queued request
+    survives untouched, and /health returns to live=true/ready=true."""
+    from dllama_tpu.obs import metrics
+
+    # uninterrupted references (separate scheduler, identical params/seeds)
+    ref = make_sched(n_slots=2)
+    try:
+        rg = ref.submit([1, 2, 3, 4, 5], 0.0, 0.9, 24, frozenset(), seed=5)
+        ref_greedy, exc = drain_tokens(rg, timeout=60.0)
+        assert exc is None
+        rs = ref.submit([7, 8, 9], 1.0, 0.9, 20, frozenset(), seed=11)
+        ref_sampled, exc = drain_tokens(rs, timeout=60.0)
+        assert exc is None
+    finally:
+        ref.shutdown()
+
+    restarts0 = metrics.REGISTRY.sample("dllama_engine_restarts_total") or 0.0
+    recov0 = metrics.REGISTRY.sample("dllama_requests_recovered_total") or 0.0
+    sched = make_sched(n_slots=2, restart_max=2, restart_backoff_s=0.01)
+    try:
+        warm = sched.submit([9, 8, 7], 0.0, 0.9, 3, frozenset(), seed=0)
+        assert drain_tokens(warm, timeout=60.0)[1] is None  # compile warm-up
+        g = sched.submit([1, 2, 3, 4, 5], 0.0, 0.9, 24, frozenset(), seed=5)
+        s = sched.submit([7, 8, 9], 1.0, 0.9, 20, frozenset(), seed=11)
+        it = g.tokens()
+        head = [next(it) for _ in range(4)]  # mid-stream before the crash
+        # queued request: both slots busy, so it waits in the pending queue
+        queued = sched.submit([4, 5, 6], 0.0, 0.9, 4, frozenset(), seed=3)
+        faults.install("scheduler.loop", "raise", times=1)
+        got_g = head + list(it)
+        got_s, exc_s = drain_tokens(s, timeout=30.0)
+        got_q, exc_q = drain_tokens(queued, timeout=30.0)
+        assert got_g == ref_greedy, "resumed greedy stream must be bit-exact"
+        assert exc_s is None and got_s == ref_sampled, \
+            "resumed sampled stream must be bit-exact (PRNG key replay)"
+        assert exc_q is None and len(got_q) == 4  # queued survived untouched
+        h = sched.health()
+        assert h["live"] is True and h["ready"] is True
+        assert h["restarts"] == 1 and h["crashed"] is None
+        restarts = metrics.REGISTRY.sample("dllama_engine_restarts_total")
+        recovered = metrics.REGISTRY.sample("dllama_requests_recovered_total")
+        assert restarts == restarts0 + 1
+        assert recovered >= recov0 + 2  # both interrupted streams resumed
+    finally:
+        faults.clear()
+        sched.shutdown()
+
+
+def _crash_worker_until(sched, n, deadline_s=30.0):
+    """Arm scheduler.loop:raise and wait until THIS scheduler has warm-
+    restarted >= n times. The fault plan is process-global, so another live
+    scheduler (e.g. a module fixture server's idle worker) can consume the
+    armed raise first — re-arm until our worker's own counter moves."""
+    faults.install("scheduler.loop", "raise", times=1)
+    deadline = time.monotonic() + deadline_s
+    while sched.health()["restarts"] < n:
+        if not faults.pending("scheduler.loop"):
+            faults.install("scheduler.loop", "raise", times=1)
+        assert time.monotonic() < deadline, f"restart {n} never happened"
+        time.sleep(0.01)
+
+
+def test_second_warm_restart_still_bit_exact():
+    """TWO crashes inside one sampled stream: the key replay must advance
+    by the tokens emitted since the LAST resume only — after the first
+    resume the slot's key is already advanced, so replaying the cumulative
+    produced-1 would double-count the pre-first-crash tokens and the
+    resumed stream would silently diverge."""
+    ref = make_sched(n_slots=1)
+    try:
+        r = ref.submit([7, 8, 9], 1.0, 0.9, 48, frozenset(), seed=11)
+        ref_toks, exc = drain_tokens(r, timeout=60.0)
+        assert exc is None and len(ref_toks) == 48
+    finally:
+        ref.shutdown()
+
+    # generous budget: a stolen-then-re-armed fault can cost an extra
+    # restart or two; the budget must never exhaust mid-drill
+    sched = make_sched(n_slots=1, restart_max=20, restart_backoff_s=0.01)
+    try:
+        warm = sched.submit([9, 8], 0.0, 0.9, 2, frozenset())
+        assert drain_tokens(warm, timeout=60.0)[1] is None  # compile warm-up
+        # slow chunks: both mid-stream crash windows need to stay open
+        faults.install("engine.decode", "delay", ms=20.0)
+        s = sched.submit([7, 8, 9], 1.0, 0.9, 48, frozenset(), seed=11)
+        it = s.tokens()
+        got = [next(it) for _ in range(4)]
+        _crash_worker_until(sched, 1)
+        got += [next(it) for _ in range(6)]  # resumed past crash 1
+        _crash_worker_until(sched, 2)
+        rest, exc = drain_tokens(s, timeout=60.0)
+        assert exc is None
+        assert got + rest == ref_toks, \
+            "stream resumed across TWO restarts must stay bit-exact"
+        assert sched.health()["restarts"] >= 2
+    finally:
+        faults.clear()
+        sched.shutdown()
+
+
+def test_restart_budget_exhausted_goes_permanently_unhealthy():
+    """--restart-max 1 with two crashes inside the window: the first warm-
+    restarts, the second exhausts the budget — PR 1 semantics return
+    (in-flight requests fail fast, /health permanently unhealthy, submit
+    refuses work)."""
+    from dllama_tpu.serve.scheduler import SchedulerUnhealthy
+
+    sched = make_sched(n_slots=1, restart_max=1, restart_backoff_s=0.01)
+    try:
+        warm = sched.submit([9, 8], 0.0, 0.9, 2, frozenset())
+        assert drain_tokens(warm, timeout=60.0)[1] is None
+        req = sched.submit([1, 2, 3], 0.0, 0.9, 50, frozenset(), seed=1)
+        faults.install("scheduler.loop", "raise", times=2)
+        _, exc = drain_tokens(req, timeout=10.0)
+        assert isinstance(exc, faults.InjectedFault)
+        assert req.finish_reason == "error"
+        deadline = time.monotonic() + 5.0
+        while sched.crashed is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        h = sched.health()
+        assert h["live"] is False and h["restarts"] == 1
+        with pytest.raises(SchedulerUnhealthy):
+            sched.submit([1], 0.0, 0.9, 2, frozenset())
+    finally:
+        faults.clear()
+        sched.shutdown()
+
+
+def test_engine_restart_fault_kills_restart():
+    """The engine.restart injection point: a restart that itself dies falls
+    back to permanent-unhealthy (the restart path is drillable too)."""
+    faults.install("scheduler.loop", "raise", times=1)
+    faults.install("engine.restart", "raise", times=1)
+    sched = make_sched(n_slots=1, restart_max=3, restart_backoff_s=0.01)
+    try:
+        deadline = time.monotonic() + 5.0
+        while sched.crashed is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        h = sched.health()
+        assert h["live"] is False
+        assert h["crashed"] and "engine.restart" in h["crashed"]
+    finally:
+        faults.clear()
+        sched.shutdown()
+
+
+# --------------------------------------- NaN guard + per-request deadlines
+
+
+def test_nan_guard_and_deadlines_one_scheduler():
+    """decode.nan fails ONE request (finish_reason='error') while the engine
+    stays live; a running request past its timeout_s finishes 'timeout' at a
+    chunk boundary with deadline fields in timings(); an expired-in-queue
+    request is shed before prefill (zero tokens, clean terminal finish)."""
+    from dllama_tpu.obs import metrics
+
+    sched = make_sched(n_slots=1)
+    try:
+        warm = sched.submit([9, 8], 0.0, 0.9, 2, frozenset())
+        assert drain_tokens(warm, timeout=60.0)[1] is None
+
+        # --- decode.nan: per-request failure, engine healthy
+        r1 = sched.submit([1, 2, 3], 0.0, 0.9, 30, frozenset(), seed=1)
+        it = r1.tokens()
+        next(it)
+        faults.install("decode.nan", "raise", times=1)
+        _, exc1 = drain_tokens(r1, timeout=10.0)
+        faults.clear()
+        assert isinstance(exc1, RuntimeError) and "non-finite" in str(exc1)
+        assert r1.finish_reason == "error"
+        assert sched.health()["live"] is True
+
+        # --- running request past its deadline: 'timeout' at chunk boundary
+        fin_tmo0 = metrics.REGISTRY.sample(
+            "dllama_requests_finished_total", {"reason": "timeout"}) or 0.0
+        faults.install("engine.decode", "delay", ms=30.0)
+        r2 = sched.submit([1, 2, 3], 0.0, 0.9, 10_000, frozenset(),
+                          timeout_s=0.4)
+        toks2, exc2 = drain_tokens(r2, timeout=15.0)
+        assert exc2 is None and r2.finish_reason == "timeout" and toks2
+        t = r2.timings()
+        assert t["timeout_s"] == 0.4 and t["deadline_exceeded"] is True
+
+        # --- expired in queue: shed before prefill (no tokens, no slot)
+        shed_tmo0 = metrics.REGISTRY.sample(
+            "dllama_requests_shed_total", {"reason": "timeout"}) or 0.0
+        runner = sched.submit([1, 2, 3], 0.0, 0.9, 200, frozenset())
+        queued = sched.submit([4, 5], 0.0, 0.9, 5, frozenset(),
+                              timeout_s=0.2)
+        toks_q, exc_q = drain_tokens(queued, timeout=15.0)
+        assert exc_q is None and toks_q == []
+        assert queued.finish_reason == "timeout" and queued.slot == -1
+        # the shed must happen WHILE the slot is still busy (the saturated-
+        # server case deadlines exist for), not after the runner finishes
+        assert runner.finish_reason is None, \
+            "queued deadline must fire while every slot is busy"
+        sched.cancel(runner)
+        drain_tokens(runner, timeout=15.0)
+        faults.clear()
+        fin_tmo = metrics.REGISTRY.sample(
+            "dllama_requests_finished_total", {"reason": "timeout"})
+        shed_tmo = metrics.REGISTRY.sample(
+            "dllama_requests_shed_total", {"reason": "timeout"})
+        assert fin_tmo >= fin_tmo0 + 2  # running + queued both counted
+        assert shed_tmo == shed_tmo0 + 1  # only the queued one was shed
+    finally:
+        faults.clear()
+        sched.shutdown()

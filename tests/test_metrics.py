@@ -428,18 +428,25 @@ def test_metrics_scrape_concurrent_with_generation(mserver):
 
 def test_build_info_gauge_and_health_build(mserver):
     """dllama_tpu_build_info: value 1, labels carry version/jax/backend/
-    overlap; the same payload rides /health as the `build` object."""
+    overlap; the same payload rides /health as the `build` object. The
+    registry is process-global, so an earlier test's single-tier server may
+    have registered an overlap="n/a" series too — match THIS server's
+    labelset (from /health) rather than whichever series scrapes first."""
     port, _api, _ = mserver
+    st, data, _ = _get_raw(port, "/health")
+    assert st == 200
+    build = json.loads(data)["build"]
+    assert build["overlap"] == "on"  # mserver runs the default pipeline
+    assert build["backend"] == "cpu" and build["version"] and build["jax"]
     st, data, _ = _get_raw(port, "/metrics")
     assert st == 200
-    m = re.search(r'^dllama_tpu_build_info\{([^}]*)\} 1$', data.decode(), re.M)
-    assert m, "dllama_tpu_build_info missing from /metrics"
-    labels = dict(re.findall(r'(\w+)="([^"]*)"', m.group(1)))
-    assert labels["overlap"] == "on"  # mserver runs the default pipeline
-    assert labels["backend"] == "cpu" and labels["version"] and labels["jax"]
-    st, data, _ = _get_raw(port, "/health")
-    build = json.loads(data)["build"]
-    assert build == labels
+    found = None
+    for m in re.finditer(r'^dllama_tpu_build_info\{([^}]*)\} 1$',
+                         data.decode(), re.M):
+        labels = dict(re.findall(r'(\w+)="([^"]*)"', m.group(1)))
+        if labels == build:
+            found = labels
+    assert found == build, "no build_info series matches /health build"
 
 
 def test_timings_object_and_flight_recorder(mserver):

@@ -271,6 +271,128 @@ def test_cross_slot_share_moves_shared_gauge():
         sched.shutdown()
 
 
+def test_pool_audit_detects_corruption_and_double_free():
+    """PagePool.audit(): clean on a live pool; detects a fabricated
+    refcount/table mismatch (raising + counting); _decref refuses to drive
+    a refcount negative (the double-release guard)."""
+    from dllama_tpu.engine.batch import PoolAuditError
+    from dllama_tpu.obs import metrics
+
+    eng = _engine("paged", n_slots=2)
+    pool = eng.pool
+    eng.add(0, list(range(1, 20)), temperature=0.0, seed=0)
+    eng.decode(4)
+    assert pool.audit()["ok"]  # live pool, invariants hold
+    fails0 = metrics.REGISTRY.sample("dllama_kv_audit_failures_total") or 0.0
+    # fabricate corruption: bump a live page's refcount with no table ref
+    page = int(pool.tables[0, 0])
+    pool.refcount[page] += 1
+    with pytest.raises(PoolAuditError, match="refcount"):
+        pool.audit()
+    report = pool.audit(raise_on_fail=False)
+    assert not report["ok"] and report["problems"]
+    pool.refcount[page] -= 1  # restore
+    assert pool.audit()["ok"]
+    # double-release guard: a second free of the same tail raises instead
+    # of silently going negative
+    pool.refcount[page] = 0  # as if already released (free list untouched)
+    with pytest.raises(PoolAuditError, match="double release"):
+        pool.free_tail(0, 0)
+    fails = metrics.REGISTRY.sample("dllama_kv_audit_failures_total")
+    assert fails >= fails0 + 3  # two failed audits + the double-free guard
+
+
+def test_deferred_request_cut_cleanly_at_drain():
+    """deferred x drain: a capacity-parked request gets a clean terminal
+    finish at drain (no hang), its client sees the drain error, every page
+    returns to the pool, and the audit is clean."""
+    from dllama_tpu.serve.scheduler import SchedulerDraining
+    from dllama_tpu.utils import faults
+
+    sched = _make_sched("paged", n_slots=3, chunk=3, kv_pages=8)
+    try:
+        # slow chunks: r1 must still be running (and r2 still parked) when
+        # the drain window closes
+        faults.install("engine.decode", "delay", ms=30.0)
+        r1 = sched.submit(list(range(1, 41)), 0.0, 0.9, 200, frozenset(),
+                          seed=1)
+        it1 = r1.tokens()
+        next(it1)
+        r2 = sched.submit(list(range(30, 60)), 0.0, 0.9, 4, frozenset(),
+                          seed=2)
+        import time as _t
+
+        deadline = _t.monotonic() + 30
+        while not sched.health()["admission_deferred"]:
+            assert _t.monotonic() < deadline, "admission never deferred"
+            _t.sleep(0.01)
+        assert sched.drain(0.2) is False  # r1 outlives the window
+        toks2 = []
+        exc2 = None
+        try:
+            for t in r2.tokens():
+                toks2.append(t)
+        except SchedulerDraining as e:
+            exc2 = e
+        assert exc2 is not None and toks2 == []
+        assert r2.finish_reason == "shutdown" and r2.slot == -1
+        pool = sched.engine.pool
+        assert pool.audit()["ok"]
+        for s in range(sched.engine.n_slots):
+            if not sched.engine.active[s]:
+                sched.engine.drop_slot_pages(s)
+        assert pool.stats()["used"] == 0, "drain leaked pages"
+    finally:
+        faults.clear()
+        sched.shutdown()
+
+
+def test_deferred_request_survives_restart():
+    """deferred x restart: a worker crash with a capacity-parked head does
+    not lose it — the running request resumes, the deferred one admits once
+    pages free, and the rebuilt pool audits clean with zero leaks."""
+    from dllama_tpu.utils import faults
+
+    sched = _make_sched("paged", n_slots=3, chunk=3, kv_pages=8)
+    sched.restart_max = 3
+    sched.restart_backoff_s = 0.01
+    try:
+        warm = sched.submit([5, 6], 0.0, 0.9, 2, frozenset())
+        list(warm.tokens())  # compile warm-up
+        # budget 8: prompt 40 + at most 7 resumed rows needs 7 pages incl.
+        # the decode reserve, so the resume ALWAYS fits the 8-page pool no
+        # matter how far r1 got before the crash
+        r1 = sched.submit(list(range(1, 41)), 0.0, 0.9, 8, frozenset(),
+                          seed=1)
+        it1 = r1.tokens()
+        next(it1)
+        r2 = sched.submit(list(range(30, 60)), 0.0, 0.9, 4, frozenset(),
+                          seed=2)
+        import time as _t
+
+        deadline = _t.monotonic() + 30
+        while not sched.health()["admission_deferred"]:
+            assert _t.monotonic() < deadline, "admission never deferred"
+            _t.sleep(0.01)
+        faults.install("scheduler.loop", "raise", times=1)
+        out1 = list(it1)
+        out2 = list(r2.tokens())
+        assert r1.finish_reason == "length" and len(out1) + 1 == 8
+        assert r2.finish_reason == "length" and len(out2) == 4
+        h = sched.health()
+        assert h["live"] and h["restarts"] == 1
+        assert not h["admission_deferred"]
+        pool = sched.engine.pool
+        assert pool.audit()["ok"]
+        for s in range(sched.engine.n_slots):
+            if not sched.engine.active[s]:
+                sched.engine.drop_slot_pages(s)
+        assert pool.stats()["used"] == 0, "restart recovery leaked pages"
+    finally:
+        faults.clear()
+        sched.shutdown()
+
+
 def test_all_slots_starved_finishes_one_to_free_pages():
     """Pool dry with every active slot starved: the scheduler finishes the
     most-advanced request ('length') so its pages un-freeze the rest —
