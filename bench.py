@@ -45,6 +45,9 @@ Env knobs:
                        configuration)
   BENCH_PAGED_HI       int: slot count for the high-slot paged leg
                        (default 2x the A/B slot count / 2x max BENCH_SLOTS)
+  BENCH_SLO            '0': skip the SLO/saturation snapshot record (windowed
+                       percentiles + scheduler time ledger + roofline
+                       attainment — the fields scripts/perf_gate.sh diffs)
 """
 
 import json
@@ -827,6 +830,77 @@ def bench_paged(cfg, params, slots, n_decode=64, page_size=128,
     return out
 
 
+def bench_slo(cfg, params, n_slots=8, chunk=4, steps=48, pf_chunk=64,
+              slo_ttft_ms=5000.0, slo_itl_ms=500.0):
+    """SLO & saturation record (ISSUE 7): serve a short mixed burst through
+    a Scheduler with SLO targets armed and report the /debug/perf join —
+    sliding-window TTFT/ITL percentiles, SLO attainment, the scheduler time
+    ledger's per-state fractions plus its partition-invariant residual
+    (|sum(states) - wall| / wall, ~0 by construction), and roofline/goodput
+    attribution of the decode path. experiments/perfdiff.py gates
+    BENCH_rN-vs-r(N-1) on these fields, so regressions in tail latency or
+    bandwidth attainment fail mechanically instead of by eyeball. The
+    default targets are deliberately loose (CPU-feasible): the record's job
+    is a populated, comparable snapshot, not a pass/fail on this host."""
+    import numpy as np
+
+    from dllama_tpu.engine.batch import BatchEngine
+    from dllama_tpu.obs import instruments as ins
+    from dllama_tpu.serve.scheduler import Scheduler
+
+    mk = lambda base: [int(x) for x in
+                       ((np.arange(3) * 13 + base) % (cfg.vocab_size - 2) + 1)]
+    sched = None
+    try:
+        eng = BatchEngine(cfg, params, n_slots=n_slots,
+                          cache_dtype=_cache_dtype(),
+                          max_prefill_chunk=pf_chunk,
+                          attn_impl=os.environ.get("BENCH_ATTN", "auto"))
+        sched = Scheduler(eng, chunk=chunk,
+                          slo_ttft_ms=slo_ttft_ms, slo_itl_ms=slo_itl_ms)
+        warm = sched.submit(mk(311), 0.0, 0.9, 2 * chunk, frozenset(), seed=3)
+        list(warm.tokens())
+        sched.reset_latency_stats()  # compile latencies out of the window
+        # burn counters are process-global and monotonic: baseline them here
+        # so the record reports THIS leg's violations, not the warmup's
+        # compile-time burns
+        base_v = {k: ins.SLO_VIOLATIONS.labels(kind=k).value()
+                  for k in ("ttft", "itl")}
+        t0 = time.perf_counter()
+        reqs = [sched.submit(mk(811 + 89 * s), 0.8 if s % 2 else 0.0, 0.9,
+                             steps, frozenset(), seed=s)
+                for s in range(n_slots)]
+        total = sum(len(list(r.tokens())) for r in reqs)
+        dt = time.perf_counter() - t0
+        win = sched.perf.window_snapshot()
+        slo = sched.perf.slo_snapshot()
+        roof = sched.perf.roofline_snapshot()
+        led = sched.ledger.snapshot()
+        resid = (abs(led["covered_s"] - led["wall_s"]) / led["wall_s"]
+                 if led["wall_s"] > 0 else 0.0)
+        return {
+            "slots": n_slots, "chunk": chunk, "steps": steps,
+            "tokens": total, "agg_tok_s": round(total / dt, 1),
+            "targets_ms": {"ttft": slo_ttft_ms, "itl": slo_itl_ms},
+            "ttft_ms_p50": win["ttft"]["p50"],
+            "ttft_ms_p95": win["ttft"]["p95"],
+            "itl_ms_p50": win["itl"]["p50"],
+            "itl_ms_p95": win["itl"]["p95"],
+            "attainment": slo["attainment"],
+            "violations": {k: slo["violations_total"][k] - base_v[k]
+                           for k in base_v},
+            "ledger_fractions": led["fractions"],
+            "ledger_residual_frac": round(resid, 6),
+            "bandwidth_attainment": roof["bandwidth_attainment"],
+            "achieved_gbs": roof["achieved_gbs"],
+            "throughput_tok_s": roof["throughput_tok_s"],
+            "goodput_tok_s": roof["goodput_tok_s"],
+        }
+    finally:
+        if sched is not None:
+            sched.shutdown()
+
+
 def bench_trace(cfg, params, n_slots=8, chunk=4, steps=48, pf_chunk=64,
                 rounds=4):
     """Tracing-overhead A/B for the serving tier: aggregate decode tok/s
@@ -1268,6 +1342,20 @@ def worker():
         except Exception as e:
             trace_ab = {"error": repr(e)[:200]}
 
+    # SLO & saturation snapshot on the same preset (ISSUE 7): windowed
+    # percentiles, ledger fractions, roofline attainment — the record
+    # experiments/perfdiff.py gates round-over-round (BENCH_SLO=0 skips)
+    slo_rec = None
+    if (sweep_on and admit_params is not None
+            and os.environ.get("BENCH_SLO") != "0"
+            and time.monotonic() < deadline - 120):
+        try:
+            slo_rec = bench_slo(
+                LlamaConfig(**PRESETS[sweep_on]), admit_params,
+                n_slots=min(8, min(s for s in slot_list) if slot_list else 8))
+        except Exception as e:
+            slo_rec = {"error": repr(e)[:200]}
+
     # paged-vs-dense KV layout A/B + the high-slot paged leg dense cannot
     # run (ISSUE 5); BENCH_PAGED=0 skips
     paged_ab = None
@@ -1323,6 +1411,7 @@ def worker():
         "overlap": overlap_ab,
         "trace": trace_ab,
         "paged": paged_ab,
+        "slo": slo_rec,
         "kb_per_token_per_chip": kb_measured if kb_measured is not None else round(kb, 1),
         "kb_per_token_source": "measured_hlo" if kb_measured is not None else "analytic",
     }

@@ -124,6 +124,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve mode: the sliding window the --restart-max "
                         "budget counts warm restarts in; budget exhausted "
                         "within the window = stay down (default 60)")
+    p.add_argument("--slo-ttft-ms", type=float, default=None,
+                   help="serve mode: TTFT SLO target in ms — terminal "
+                        "requests over it burn dllama_slo_violations_total"
+                        "{kind=ttft} and drop out of goodput; windowed "
+                        "attainment at /debug/perf and "
+                        "dllama_slo_attainment (default: no target)")
+    p.add_argument("--slo-itl-ms", type=float, default=None,
+                   help="serve mode: inter-token-latency SLO target in ms "
+                        "(mean ITL per request, same derivation as the "
+                        "itl_ms metrics); violations burn "
+                        "dllama_slo_violations_total{kind=itl} "
+                        "(default: no target)")
     p.add_argument("--drain-timeout-s", type=float, default=30.0,
                    help="serve mode: on SIGTERM, stop admission (503) and "
                         "give in-flight requests this long to finish before "
@@ -386,6 +398,8 @@ def cmd_serve(args) -> int:
         restart_max=args.restart_max,
         restart_window_s=args.restart_window_s,
         drain_timeout_s=args.drain_timeout_s,
+        slo_ttft_ms=args.slo_ttft_ms,
+        slo_itl_ms=args.slo_itl_ms,
         overlap=args.overlap == "on",
         kv_layout=args.kv_layout,
         page_size=args.page_size,

@@ -408,6 +408,10 @@ class DecodeChunk:
     bad: jax.Array | None = None  # bool[B] rows whose logits went
     # non-finite inside the scan (the decode NaN guard's device-side half)
     bad_inject: np.ndarray | None = None  # decode.nan fault overlay
+    device_s: float = 0.0  # exclusive device window, stamped at consumption
+    # (same clock as DECODE_CHUNK_SECONDS: starts at the later of this
+    # chunk's dispatch and the previous chunk's consumption) — what the
+    # roofline-attainment gauge divides priced HBM bytes by
 
     def nonfinite(self) -> np.ndarray | None:
         """bool[B] rows whose logits went non-finite during this chunk
@@ -955,6 +959,29 @@ class BatchEngine:
         on the dense layout."""
         return None if self.pool is None else self.pool.stats()
 
+    def chunk_cost_model(self):
+        """Frozen obs/perf.ChunkCostModel pricing THIS engine's decode
+        steps (the scheduler's roofline-attainment feed): the same per-op
+        byte formula as experiments/hbm_traffic.py's offline tables, with
+        `weight_bytes` = the REAL resident parameter bytes — an unquantized
+        test model is priced as what it actually streams per step, not as a
+        hypothetical Q40."""
+        from dllama_tpu.obs.perf import ChunkCostModel
+        from dllama_tpu.utils.profiling import params_nbytes
+
+        try:
+            cache_el = np.dtype(self.cache_dtype).itemsize
+        except TypeError:  # ml_dtypes classes resolve via a jnp scalar
+            cache_el = jnp.zeros((), self.cache_dtype).dtype.itemsize
+        cfg = self.cfg
+        return ChunkCostModel(
+            n_layers=cfg.n_layers, dim=cfg.dim, hidden_dim=cfg.hidden_dim,
+            kv_dim=cfg.kv_dim, head_size=cfg.head_size,
+            n_kv_heads=cfg.n_kv_heads, vocab_size=cfg.vocab_size,
+            seq_len=self.seq_len, weight_bytes=int(params_nbytes(self.params)),
+            cache_bytes_per_el=int(cache_el),
+            paged=self.kv_layout == "paged", page_size=self.page_size)
+
     def warm_restart(self) -> None:
         """Crash recovery WITHOUT a model reload: rebuild everything a
         failed chunk may have poisoned — the KV cache buffers (the jitted
@@ -1354,6 +1381,7 @@ class BatchEngine:
         start = (chunk.t0 if self._t_last_consume is None
                  else max(chunk.t0, self._t_last_consume))
         ins.DECODE_CHUNK_SECONDS.observe(now - start)
+        chunk.device_s = now - start  # the roofline gauge's denominator
         self._t_last_consume = now
         ins.BATCH_OCCUPANCY.observe(int(chunk.active.sum()))
         tr = trace.TRACER

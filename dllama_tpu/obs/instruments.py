@@ -16,6 +16,9 @@ converted at the observation site), monotonic counts end in ``_total``.
 
 from __future__ import annotations
 
+import threading
+import time
+
 from dllama_tpu.obs import metrics
 
 # ------------------------------------------------------------ request flow
@@ -128,6 +131,103 @@ TOKEN_LATENCY_SECONDS = metrics.histogram(
     "Per-token host latency recorded by utils.profiling.TokenTimer "
     "(single-engine inference loop)",
     buckets=metrics.CHUNK_BUCKETS_S)
+
+# ------------------------------------------------- SLO & saturation (perf)
+
+SCHEDULER_TIME = metrics.counter(
+    "dllama_scheduler_time_seconds_total",
+    "Scheduler worker wall time attributed to exactly one exclusive state "
+    "(obs/perf.TimeLedger): the per-state totals partition loop wall time "
+    "by construction, so fractions answer 'what is the scheduler doing'",
+    ("state",))
+SLO_VIOLATIONS = metrics.counter(
+    "dllama_slo_violations_total",
+    "Terminal requests that missed a configured SLO target, by kind "
+    "(ttft vs --slo-ttft-ms, itl vs --slo-itl-ms); burn-rate source",
+    ("kind",))
+SLO_ATTAINMENT = metrics.gauge(
+    "dllama_slo_attainment",
+    "Fraction of requests finishing inside every configured SLO over the "
+    "sliding window (1.0 with no violations; refreshed at scrape time)")
+LATENCY_WINDOW = metrics.gauge(
+    "dllama_latency_window_seconds",
+    "Sliding-window latency quantiles (obs/perf.WindowQuantiles) for "
+    "metric=ttft|itl|e2e at quantile=p50|p95|p99 — the live-tail view the "
+    "per-request histograms cannot give without a quantile-capable backend",
+    ("metric", "quantile"))
+BW_ATTAINMENT = metrics.gauge(
+    "dllama_decode_bandwidth_attainment",
+    "Windowed decode HBM-bandwidth attainment: priced chunk bytes "
+    "(experiments/hbm_traffic.py's cost model, one definition site in "
+    "obs/perf.decode_step_bytes) / measured device seconds / peak HBM GB/s")
+THROUGHPUT = metrics.gauge(
+    "dllama_throughput_tok_s",
+    "Windowed completion-token rate over finished requests (scrape-time "
+    "refresh; companion of the goodput gauge)")
+GOODPUT = metrics.gauge(
+    "dllama_goodput_tok_s",
+    "Windowed GOODPUT token rate: only tokens of requests that finished "
+    "stop/length within every configured SLO count (goodput/throughput is "
+    "the useful-work fraction)")
+
+# -------------------------------------------------- process self-metrics
+
+PROCESS_UPTIME = metrics.gauge(
+    "dllama_process_uptime_seconds",
+    "Seconds since the serving process imported its metrics catalog "
+    "(refreshed at scrape time)")
+PROCESS_RSS = metrics.gauge(
+    "dllama_process_rss_bytes",
+    "Resident-set size of the serving process (/proc/self/statm; 0 when "
+    "the platform exposes neither procfs nor resource.getrusage)")
+PROCESS_THREADS = metrics.gauge(
+    "dllama_process_threads",
+    "Live Python threads (threading.active_count): worker + watchdog + "
+    "HTTP handler threads; a leak here shows before the OOM does")
+
+_PROC_START = time.monotonic()
+_PAGE_SIZE = 4096
+try:  # resource is stdlib but not on every platform
+    import resource as _resource
+except ImportError:  # pragma: no cover
+    _resource = None
+try:
+    import os as _os
+
+    _PAGE_SIZE = _os.sysconf("SC_PAGE_SIZE")
+except (ValueError, OSError, AttributeError):  # pragma: no cover
+    pass
+
+
+def _rss_bytes() -> int:
+    try:
+        with open("/proc/self/statm", encoding="ascii") as f:
+            return int(f.read().split()[1]) * _PAGE_SIZE
+    except (OSError, ValueError, IndexError):
+        pass
+    if _resource is not None:  # pragma: no cover - non-procfs fallback
+        # ru_maxrss is the PEAK (not current) — still better than nothing
+        # where /proc is absent. Unit is platform-defined: bytes on darwin,
+        # kilobytes on linux/BSD (getrusage(2))
+        import sys as _sys
+
+        peak = _resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss
+        return int(peak) * (1 if _sys.platform == "darwin" else 1024)
+    return 0  # pragma: no cover
+
+
+def refresh_process_gauges() -> dict:
+    """Refresh + return the process self-metrics (uptime, RSS, threads).
+    Called at scrape time (`/metrics`, `/health`, `/debug/perf`) rather
+    than on a timer — gauges are as fresh as their last read."""
+    up = time.monotonic() - _PROC_START
+    rss = _rss_bytes()
+    threads = threading.active_count()
+    PROCESS_UPTIME.set(up)
+    PROCESS_RSS.set(rss)
+    PROCESS_THREADS.set(threads)
+    return {"uptime_s": round(up, 3), "rss_bytes": rss, "threads": threads}
+
 
 # ------------------------------------------------------------ supervision
 

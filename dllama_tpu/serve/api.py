@@ -43,6 +43,7 @@ from dllama_tpu import __version__
 from dllama_tpu.engine.sampling import Sampler
 from dllama_tpu.obs import metrics, new_request_id, trace
 from dllama_tpu.obs import instruments as ins
+from dllama_tpu.obs import perf as perfmod
 from dllama_tpu.serve.scheduler import (
     QueueFull,
     SchedulerDraining,
@@ -114,7 +115,9 @@ class PrefixCache:
 
 class ApiServer:
     def __init__(self, loaded, default_temperature=0.8, default_topp=0.9, default_seed=None,
-                 scheduler=None, spec: int = 0):
+                 scheduler=None, spec: int = 0,
+                 slo_ttft_ms: float | None = None,
+                 slo_itl_ms: float | None = None):
         self.engine = loaded.engine
         self.tokenizer = loaded.tokenizer
         self.config = loaded.config
@@ -160,6 +163,15 @@ class ApiServer:
                         else ("on" if scheduler.overlap else "off")),
         }
         ins.BUILD_INFO.labels(**self.build_info).set(1)
+        # SLO policy for the /debug/requests/{req_id} postmortem verdict —
+        # ONE policy object per process: the scheduler's aggregator owns it
+        # on the continuous tier (it also burns the violation counters), the
+        # api holds a standalone one on the single tier so postmortems still
+        # get judged
+        self.slo = (scheduler.perf.slo if scheduler is not None
+                    else perfmod.SloPolicy(
+                        None if slo_ttft_ms is None else float(slo_ttft_ms),
+                        None if slo_itl_ms is None else float(slo_itl_ms)))
 
     # ---------------------------------------------------------------- health
 
@@ -183,6 +195,10 @@ class ApiServer:
         h["model_params_bytes"] = self.model_params_bytes
         h["kv_cache_bytes"] = self.kv_cache_bytes
         h["build"] = self.build_info
+        # process self-metrics ride every probe (and /metrics as gauges):
+        # uptime answers "did it just restart", RSS + threads answer "is it
+        # leaking" without a scrape pipeline
+        h["process"] = ins.refresh_process_gauges()
         return h
 
     def precheck_capacity(self) -> None:
@@ -671,6 +687,7 @@ _KNOWN_PATHS = {
     "/debug/requests": "/debug/requests",
     "/debug/profile": "/debug/profile",
     "/debug/kv": "/debug/kv",
+    "/debug/perf": "/debug/perf",
 }
 
 
@@ -722,7 +739,14 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             # Prometheus text exposition of the process-global registry —
             # served from this (threaded) handler, so scrapes proceed while
-            # completions run
+            # completions run. Scrape-time refresh keeps the windowed/derived
+            # gauges (latency quantiles, SLO attainment, roofline, process
+            # self-metrics) current without putting their aggregation on the
+            # serving hot path.
+            ins.refresh_process_gauges()
+            if self.api.scheduler is not None:
+                self.api.scheduler.ledger.poke()
+                self.api.scheduler.perf.refresh_gauges()
             body = metrics.REGISTRY.render().encode()
             self.send_response(200)
             self.send_header("Content-Type",
@@ -782,6 +806,31 @@ class _Handler(BaseHTTPRequestHandler):
                         {"layout": "paged", "page_size": pool.page_size,
                          "pool": pool.stats(), "audit": report})
 
+    def _debug_perf(self) -> None:
+        """GET /debug/perf — the ISSUE 7 join, one JSON document: sliding-
+        window TTFT/ITL/e2e p50/p95/p99, SLO targets/attainment/burn totals,
+        the scheduler time ledger (per-state seconds + fractions of loop
+        wall time), roofline/goodput attribution of the decode path, and
+        the process self-metrics. Works without the span tracer; the
+        single-engine tier answers with mode=single and no scheduler views
+        (it has no worker loop to ledger)."""
+        sched = self.api.scheduler
+        payload: dict = {"process": ins.refresh_process_gauges()}
+        if sched is None:
+            payload.update({
+                "mode": "single",
+                "slo": {"targets": {"ttft_ms": self.api.slo.ttft_ms,
+                                    "itl_ms": self.api.slo.itl_ms},
+                        "enabled": self.api.slo.enabled()},
+            })
+        else:
+            sched.ledger.poke()  # bill the open span: a long idle park must
+            # read as idle seconds now, not at the next state transition
+            sched.perf.refresh_gauges()  # /metrics and this JSON agree
+            payload["mode"] = "continuous"
+            payload.update(sched.perf.snapshot(ledger=sched.ledger))
+        self._send_json(200, payload)
+
     def _debug_get(self) -> None:
         """GET /debug/trace (Chrome trace-event JSON for Perfetto),
         GET /debug/requests (flight-recorder summaries),
@@ -789,6 +838,9 @@ class _Handler(BaseHTTPRequestHandler):
         GET /debug/kv (paged-pool occupancy + on-demand audit)."""
         if self.path == "/debug/kv":
             self._debug_kv()  # independent of the span tracer
+            return
+        if self.path == "/debug/perf":
+            self._debug_perf()  # also tracer-independent (registry + ledger)
             return
         tr = trace.TRACER
         if not tr.enabled:
@@ -808,6 +860,13 @@ class _Handler(BaseHTTPRequestHandler):
                     "message": f"no flight-recorder entry for {rid!r} "
                                "(never seen, or evicted from the ring)"}})
             else:
+                # postmortem SLO verdict from the record's own latency marks
+                # (ttft/e2e/decode_tokens — ITL derived the same way
+                # Request.itl_ms derives it), judged against the configured
+                # targets; all-None verdicts when no SLO is configured
+                rec["slo"] = self.api.slo.verdict_from_marks(
+                    rec.get("ttft_ms"), rec.get("e2e_ms"),
+                    rec.get("decode_tokens"))
                 self._send_json(200, rec)
         else:
             self._send_json(404, {"error": {"message": "not found"}})
@@ -1133,6 +1192,13 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
         # lockstep loop for A/B (token streams are identical either way)
         if defaults.get("overlap") is not None:
             sched_kw["overlap"] = bool(defaults["overlap"])
+        # SLO targets (--slo-ttft-ms / --slo-itl-ms): the scheduler's perf
+        # aggregator judges every terminal request against them (burn
+        # counters, attainment gauge, goodput accounting)
+        if defaults.get("slo_ttft_ms") is not None:
+            sched_kw["slo_ttft_ms"] = float(defaults["slo_ttft_ms"])
+        if defaults.get("slo_itl_ms") is not None:
+            sched_kw["slo_itl_ms"] = float(defaults["slo_itl_ms"])
         scheduler = Scheduler(be, **sched_kw)
     api = ApiServer(
         loaded,
@@ -1141,6 +1207,8 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
         default_seed=defaults.get("default_seed"),
         scheduler=scheduler,
         spec=defaults.get("spec", 0),
+        slo_ttft_ms=defaults.get("slo_ttft_ms"),
+        slo_itl_ms=defaults.get("slo_itl_ms"),
     )
     handler = type("Handler", (_Handler,), {"api": api})
     httpd = ThreadingHTTPServer((host, port), handler)

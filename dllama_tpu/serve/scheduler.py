@@ -52,6 +52,7 @@ import numpy as np
 
 from dllama_tpu.engine.batch import BatchEngine
 from dllama_tpu.obs import instruments as ins
+from dllama_tpu.obs import perf
 from dllama_tpu.obs import trace
 from dllama_tpu.utils import faults
 
@@ -207,7 +208,9 @@ class Scheduler:
                  overlap: bool = True,
                  restart_max: int = 0,
                  restart_window_s: float = 60.0,
-                 restart_backoff_s: float = 0.5):
+                 restart_backoff_s: float = 0.5,
+                 slo_ttft_ms: float | None = None,
+                 slo_itl_ms: float | None = None):
         self.engine = engine
         self.chunk = chunk
         self.admit_timeout = admit_timeout
@@ -301,6 +304,22 @@ class Scheduler:
         self._draining = threading.Event()  # admission stopped for drain
         self.stalled = False  # watchdog verdict: a chunk blew the deadline
         self.stall_count = 0  # total watchdog trips (stalled may recover)
+        # ---- SLO & saturation observability (ISSUE 7, obs/perf.py): the
+        # time ledger attributes every second of the worker loop to one
+        # exclusive state (dllama_scheduler_time_seconds_total{state} — the
+        # per-state totals partition loop wall time by construction), and
+        # the aggregator joins sliding-window TTFT/ITL/e2e quantiles, SLO
+        # burn/attainment accounting (--slo-ttft-ms / --slo-itl-ms), and
+        # roofline/goodput attribution of consumed decode chunks priced by
+        # the engine's cost model. Both feed GET /debug/perf and /metrics.
+        self.ledger = perf.TimeLedger(counter=ins.SCHEDULER_TIME)
+        cost_model = (engine.chunk_cost_model()
+                      if hasattr(engine, "chunk_cost_model") else None)
+        self.perf = perf.PerfAggregator(
+            slo=perf.SloPolicy(
+                None if slo_ttft_ms is None else float(slo_ttft_ms),
+                None if slo_itl_ms is None else float(slo_itl_ms)),
+            cost_model=cost_model)
         # worker heartbeat: stamped once per loop iteration. A device call
         # that hangs stops the heartbeat while work exists — which is exactly
         # the condition the watchdog turns into "stalled".
@@ -488,10 +507,19 @@ class Scheduler:
         ttfts = [r.ttft_ms for r in done if r.ttft_ms is not None]
         itls = [r.itl_ms for r in done if r.itl_ms is not None]
         mean = lambda xs: sum(xs) / len(xs) if xs else None
+        # tail latency from the sliding-window estimator (obs/perf): a mean
+        # alone hides exactly the requests the SLO work exists for
+        def q_ms(w, q):
+            v = w.quantile(q)
+            return None if v is None else round(v * 1000.0, 3)
         return {
             "completed": len(done),
             "ttft_ms_mean": mean(ttfts),
+            "ttft_ms_p50": q_ms(self.perf.ttft, 0.5),
+            "ttft_ms_p95": q_ms(self.perf.ttft, 0.95),
             "itl_ms_mean": mean(itls),
+            "itl_ms_p50": q_ms(self.perf.itl, 0.5),
+            "itl_ms_p95": q_ms(self.perf.itl, 0.95),
             "reused_prefix_tokens": self.reused_prefix_tokens,
             "admission_gaps": len(gaps),
             "admission_stall_ms_max": max(gaps) if gaps else None,
@@ -516,6 +544,11 @@ class Scheduler:
             self._host_gap_ms.clear()
         self._t_dec_end = None
         self._t_consumed = None
+        # fresh sliding windows too: warmup-compile latencies must not sit
+        # in the p95 for the next minute of a bench leg (same policy and
+        # cost model; attribute swap is atomic for concurrent scrapes)
+        self.perf = perf.PerfAggregator(slo=self.perf.slo,
+                                        cost_model=self.perf.cost_model)
 
     def cancel(self, req: Request, reason: str = "cancelled") -> None:
         """Release a request's slot. `reason` becomes the finish_reason when
@@ -571,6 +604,15 @@ class Scheduler:
         itl = req.itl_ms
         if itl is not None:
             ins.ITL_SECONDS.observe(itl / 1000.0)
+        # the SLO/goodput join (obs/perf): same marks as the histograms
+        # above, so the windowed quantiles, the burn counters, and /metrics
+        # cannot disagree about what this request experienced
+        self.perf.observe_finish(
+            finish_reason=req.finish_reason or "unknown",
+            ttft_ms=req.ttft_ms, itl_ms=itl,
+            e2e_ms=(None if req.finished_at is None
+                    else (req.finished_at - req.submitted_at) * 1000.0),
+            tokens=req.produced)
 
     def _finish(self, req: Request, reason: str, keep_rows: int | None = None) -> None:
         if req.slot >= 0:
@@ -916,6 +958,7 @@ class Scheduler:
             try:
                 tr = trace.TRACER
                 t_ch = tr.now() if tr.enabled else 0.0
+                self.ledger.transition("prefill")
                 done = self.engine.add_step(adm)
                 if self.slots and adm.logits is not None:
                     # sync whenever decoders could stall: JAX dispatch is
@@ -937,6 +980,7 @@ class Scheduler:
                                total=len(adm.toks))
                 worked = True
                 if done:
+                    self.ledger.transition("commit")
                     if req.resume_tokens is not None:
                         # restart resume: install the last emitted token and
                         # the recorded PRNG key as the decode carry — no new
@@ -1088,23 +1132,29 @@ class Scheduler:
         itself dies — it falls back to PR 1 semantics: every in-flight
         request fails fast (finish_reason='error', queues unblocked) and
         /health flips permanently unhealthy."""
-        while True:
-            try:
-                self._loop()
-                return
-            except BaseException as e:  # noqa: BLE001 — supervision must be total
+        try:
+            while True:
                 try:
-                    if self._try_restart(e):
-                        continue
-                except BaseException as e2:  # noqa: BLE001 — restart died too
-                    log.exception("warm restart failed; giving up")
-                    e = e2
-                self.crashed = e
-                log.exception("scheduler worker crashed; failing all "
-                              "in-flight requests and marking /health "
-                              "unhealthy")
-                self._fail_all(e)
-                return
+                    self._loop()
+                    return
+                except BaseException as e:  # noqa: BLE001 — supervision must be total
+                    try:
+                        if self._try_restart(e):
+                            continue
+                    except BaseException as e2:  # noqa: BLE001 — restart died too
+                        log.exception("warm restart failed; giving up")
+                        e = e2
+                    self.crashed = e
+                    log.exception("scheduler worker crashed; failing all "
+                                  "in-flight requests and marking /health "
+                                  "unhealthy")
+                    self._fail_all(e)
+                    return
+        finally:
+            # stop the ledger clock with the worker: the tail of the last
+            # state is billed and wall_s() freezes, keeping the partition
+            # invariant (sum of states == wall) true for a dead worker too
+            self.ledger.close()
 
     #: one jitted fori_loop shared by every restart: replaying a 4000-token
     #: stream must cost ONE dispatch, not 4000 serial split() round-trips
@@ -1146,6 +1196,10 @@ class Scheduler:
                       self.restart_window_s)
             return False
         self._restarts.append(now)
+        # from here until _loop() re-anchors the ledger, every instant —
+        # backoff sleep, recovery bookkeeping, engine rebuild — is restart
+        # time, not whatever state the crash interrupted
+        self.ledger.transition("restart_backoff")
         self.restart_count += 1
         attempt = len(self._restarts)
         ins.ENGINE_RESTARTS.inc()
@@ -1306,6 +1360,7 @@ class Scheduler:
         # mixed batch alternates spec cycles with plain decode chunks so
         # frozen slots still advance to their finish (no livelock) while
         # eligible ones keep multi-token acceptance on their cycles.
+        self.ledger.transition("decode_dispatch")
         use_spec = False
         if getattr(self.engine, "spec_k", 0):
             elig = self.engine.spec_eligible()  # the engine's freeze rule
@@ -1320,8 +1375,10 @@ class Scheduler:
         tr = trace.TRACER
         if use_spec:
             start_rows = {s: int(self.engine.pos[s]) for s in self.slots}
+            self.ledger.transition("decode_wait")  # spec consumes in place
             emit_toks, adv = self.engine.spec_step()  # records decode.spec
             self._t_dec_end = self._t_consumed = time.monotonic()
+            self.ledger.transition("emit")
             for slot, req in list(self.slots.items()):
                 if tr.enabled and adv[slot]:
                     tr.req_chunk(req.req_id, self.engine.chunk_seq,
@@ -1356,8 +1413,21 @@ class Scheduler:
         never serves overrun rows."""
         tr = trace.TRACER
         t0 = tr.now() if tr.enabled else 0.0
+        self.ledger.transition("decode_wait")
         toks = self.engine.decode_consume(chunk)  # records decode.device
         self._t_dec_end = self._t_consumed = time.monotonic()
+        self.ledger.transition("emit")
+        if chunk.active.any():
+            # roofline/goodput feed: price this chunk's HBM traffic at its
+            # dispatch-time occupancy and mean live-KV horizon against the
+            # exclusive device window decode_consume just measured
+            self.perf.observe_chunk(
+                occupancy=int(chunk.active.sum()),
+                live_rows=float(chunk.start_pos[chunk.active].mean())
+                + (chunk.n + 1) / 2.0,
+                steps=chunk.n,
+                tokens=int(chunk.advance.sum()),
+                device_s=chunk.device_s)
         if tr.enabled:
             tr.span_at("decode.consume", t0, tr.now(), cat="decode",
                        track="scheduler", chunk=chunk.seq, n=chunk.n)
@@ -1396,6 +1466,9 @@ class Scheduler:
         # end of the previous decode chunk (stall metric); instance attribute
         # so reset_latency_stats can rewind it from the caller's thread
         self._t_dec_end = None
+        # anchor the time ledger (re-entrant across warm restarts): from
+        # here until close(), every instant is billed to exactly one state
+        self.ledger.start("idle")
         pending = None  # overlap mode: the dispatched-but-unconsumed chunk
         while not self._stop.is_set():
             self._heartbeat = time.monotonic()
@@ -1417,8 +1490,12 @@ class Scheduler:
                 pending = nxt
                 continue
             t_boundary = time.monotonic()
+            self.ledger.transition("admission")
             self._admit_starts()
             admitted = self._pump_admissions()
+            # boundary scans below (cancels, deadlines, page starvation) are
+            # admission-side work; this also bills the pump's open tail
+            self.ledger.transition("admission")
             for slot, req in list(self.slots.items()):
                 if req.cancelled.is_set():
                     self._finish(req, req.cancel_reason,
@@ -1468,6 +1545,7 @@ class Scheduler:
             if not self.slots:
                 self._t_dec_end = None
                 if not self._inflight:
+                    self.ledger.transition("idle")
                     self._wake.wait(timeout=self.admit_timeout)
                     self._wake.clear()
                 continue

@@ -135,28 +135,23 @@ def batched_step_bytes(cfg: LlamaConfig, slots: int, live_frac: float = 1.0,
     reads the i32 block tables (slots * seq/page entries, k and v, per
     layer) as its scalar-prefetch operand. Both are per-step HBM reads the
     dense layout does not pay — the honest cost of making the 96-slot pool
-    allocatable at all."""
-    L, d, h, kv, hd = (cfg.n_layers, cfg.dim, cfg.hidden_dim, cfg.kv_dim,
-                       cfg.head_size)
-    m = max(8, slots)  # one fused step: all slots are rows of one matmul
-    weights = q40_weight_bytes(cfg)
-    acts = 0
+    allocatable at all.
 
-    def mm_act(k, n):
-        return m * k * 2 + m * n * 4
+    The byte formula itself lives in ``dllama_tpu/obs/perf.decode_step_bytes``
+    (ISSUE 7): the live bandwidth-attainment gauge prices every consumed
+    decode chunk with the SAME function, so the offline tables here and the
+    serving-time roofline cannot drift. This wrapper only supplies the
+    Q40-weight-stream pricing and cfg unpacking the offline tables want."""
+    from dllama_tpu.obs.perf import decode_step_bytes
 
-    acts += (mm_act(d, d) * 2 + mm_act(d, kv) * 2
-             + mm_act(d, h) * 2 + mm_act(h, d)) * L + mm_act(d, cfg.vocab_size)
-    live_rows = live_frac * cfg.seq_len
-    if paged:
-        # page-granular pruning horizon: live rows round up to whole pages
-        live_rows = -(-int(live_rows) // page_size) * page_size
-    kv_stream = int(2 * slots * cfg.n_kv_heads * live_rows * hd
-                    * cache_bytes_per_el) * L
-    kv_write = 2 * slots * kv * cache_bytes_per_el * L
-    table_read = (4 * slots * (cfg.seq_len // page_size) * 2 * L
-                  if paged else 0)  # i32 block tables, k + v, per layer
-    return weights + acts + kv_stream + kv_write + table_read + slots * d * 2
+    return decode_step_bytes(
+        n_layers=cfg.n_layers, dim=cfg.dim, hidden_dim=cfg.hidden_dim,
+        kv_dim=cfg.kv_dim, head_size=cfg.head_size,
+        n_kv_heads=cfg.n_kv_heads, vocab_size=cfg.vocab_size,
+        seq_len=cfg.seq_len, weight_bytes=q40_weight_bytes(cfg),
+        slots=slots, live_rows=live_frac * cfg.seq_len,
+        cache_bytes_per_el=cache_bytes_per_el,
+        paged=paged, page_size=page_size)
 
 
 def abstract_model(cfg: LlamaConfig, sharding):

@@ -1,0 +1,199 @@
+"""Mechanical BENCH_rN vs BENCH_r(N-1) regression gate (ISSUE 7).
+
+Every round so far has compared bench records by eyeball — which is how a
+10% decode regression hides behind a reshuffled JSON and a "looks fine".
+This tool makes the comparison mechanical: it flattens the ``parsed``
+record of two BENCH_r*.json files into dotted numeric leaves, matches each
+leaf against a per-metric rule table (direction + relative tolerance), and
+emits a machine-readable verdict. A metric only FAILS when it moved in its
+*worse* direction by more than its tolerance; improvements and un-gated
+informational fields never fail. Metrics missing from either side are
+reported but do not fail the gate (bench legs are budget- and
+env-gated — BENCH_PAGED=0 etc. — so absence is routine, not regression).
+
+Exit status: 0 = no gated regressions (self-diff is a pass by
+construction), 1 = at least one gated regression, 2 = usage/parse errors.
+
+Usage:
+    python experiments/perfdiff.py OLD.json NEW.json [--json] [--scale F]
+
+``--scale`` multiplies every tolerance (CPU fallback runs are noisier than
+TPU runs; scripts/perf_gate.sh forwards $PERFDIFF_SCALE). The wrapper
+scripts/perf_gate.sh is the CI entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import sys
+
+#: (path glob, direction, relative tolerance) — first match wins.
+#: direction 'higher' = bigger is better (fails when new < old * (1-tol)),
+#: 'lower' = smaller is better (fails when new > old * (1+tol)),
+#: 'info' = report only, never gate. Tolerances are deliberately loose:
+#: the gate exists to catch step-function regressions (a broken kernel
+#: route, a serialized pipeline), not to litigate run-to-run noise.
+RULES: list[tuple[str, str, float]] = [
+    # headline + per-preset engine numbers
+    ("value", "higher", 0.15),
+    ("presets.*.decode_tok_s", "higher", 0.15),
+    ("presets.*.prefill_tok_s", "higher", 0.25),
+    ("presets.*.decode_ms_per_token", "lower", 0.20),
+    ("presets.*.spec.tok_s", "higher", 0.25),
+    ("presets.*.compile_s", "lower", 1.00),
+    # serving-tier A/B ratios (already normalized — tight tolerances)
+    ("overlap.tok_s_ratio_on_off", "higher", 0.10),
+    ("overlap.host_gap_reduction_x", "higher", 0.50),
+    ("trace.tok_s_ratio_on_off", "higher", 0.05),
+    ("paged.tok_s_ratio_paged_dense", "higher", 0.10),
+    ("batch.*.agg_tok_s", "higher", 0.20),
+    ("admission.stall_reduction_x", "higher", 0.50),
+    # ISSUE 7 slo record: tail latency gates DOWN, attainment gates UP
+    ("slo.ttft_ms_p95", "lower", 0.35),
+    ("slo.itl_ms_p95", "lower", 0.35),
+    ("slo.agg_tok_s", "higher", 0.15),
+    ("slo.goodput_tok_s", "higher", 0.25),
+    ("slo.throughput_tok_s", "higher", 0.25),
+    ("slo.bandwidth_attainment", "higher", 0.35),
+    # the ledger partition invariant is an absolute property, not a trend:
+    # gate it against a fixed ceiling via the pseudo-rule below
+    ("slo.ledger_residual_frac", "ceiling", 0.02),
+    ("*", "info", 0.0),
+]
+
+
+def flatten(obj, prefix: str = "") -> dict[str, float]:
+    """Dotted-path -> numeric leaf map (list items become ``name.i`` —
+    dotted, so fnmatch ``*`` rules cover sweeps and indices alike; bools and
+    error strings are skipped — a leg that died carries no metrics)."""
+    out: dict[str, float] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.update(flatten(v, f"{prefix}.{k}" if prefix else str(k)))
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            out.update(flatten(v, f"{prefix}.{i}" if prefix else str(i)))
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        out[prefix] = float(obj)
+    return out
+
+
+def rule_for(path: str) -> tuple[str, str, float]:
+    # list indices are wildcarded so one rule covers the whole sweep
+    for pat, direction, tol in RULES:
+        if fnmatch.fnmatchcase(path, pat):
+            return pat, direction, tol
+    return "*", "info", 0.0
+
+
+def judge(path: str, old: float, new: float, scale: float) -> dict:
+    """One metric's verdict: status in {ok, regression, improved, info}."""
+    pat, direction, tol = rule_for(path)
+    tol *= scale
+    rec = {"metric": path, "rule": pat, "direction": direction,
+           "tol": round(tol, 4), "old": old, "new": new}
+    if direction == "info":
+        rec["status"] = "info"
+        return rec
+    if direction == "ceiling":
+        # absolute bound on the NEW value only (invariants, not trends);
+        # scale does not loosen invariants
+        rec["status"] = "ok" if new <= tol / scale else "regression"
+        rec["bound"] = tol / scale
+        return rec
+    span = abs(old)
+    if span == 0.0:
+        # a zero baseline gives relative tolerance nothing to scale by
+        # (0.0 -> anything is an infinite relative move): report, never
+        # gate — a self-diff or a first populated value must not fail
+        rec["status"] = "ok" if new == old else "zero_baseline"
+        return rec
+    if direction == "higher":
+        worse = old - new
+    else:
+        worse = new - old
+    rec["delta_frac"] = round((new - old) / span, 4)
+    if worse > tol * span:
+        rec["status"] = "regression"
+    elif worse < 0:
+        rec["status"] = "improved"
+    else:
+        rec["status"] = "ok"
+    return rec
+
+
+def diff(old: dict, new: dict, scale: float = 1.0) -> dict:
+    """Compare two parsed bench records -> the machine-readable verdict."""
+    fo, fn = flatten(old), flatten(new)
+    results = [judge(p, fo[p], fn[p], scale)
+               for p in sorted(fo.keys() & fn.keys())]
+    regressions = [r for r in results if r["status"] == "regression"]
+    return {
+        "ok": not regressions,
+        "checked": sum(1 for r in results if r["direction"] != "info"),
+        "compared": len(results),
+        "only_old": sorted(fo.keys() - fn.keys()),
+        "only_new": sorted(fn.keys() - fo.keys()),
+        "regressions": regressions,
+        "improvements": [r for r in results if r["status"] == "improved"],
+        "scale": scale,
+    }
+
+
+def _parsed(path: str) -> dict:
+    """The comparable record of a BENCH_r*.json: its ``parsed`` object (the
+    wrapper's n/cmd/tail are run provenance, not metrics); a bare bench
+    record (no wrapper) is accepted as-is."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    if isinstance(doc, dict):
+        return doc
+    raise ValueError(f"{path}: not a bench record (top level is "
+                     f"{type(doc).__name__}, expected object)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("old", help="baseline BENCH_r*.json (e.g. the previous round)")
+    ap.add_argument("new", help="candidate BENCH_r*.json")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full verdict object (machine-readable); "
+                         "default prints a human summary table")
+    ap.add_argument("--scale", type=float,
+                    default=1.0, help="tolerance multiplier (noisy hosts; "
+                                      "invariant ceilings are NOT scaled)")
+    args = ap.parse_args(argv)
+    try:
+        old, new = _parsed(args.old), _parsed(args.new)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"perfdiff: {e}", file=sys.stderr)
+        return 2
+    verdict = diff(old, new, scale=args.scale)
+    if args.json:
+        print(json.dumps(verdict, indent=2))
+    else:
+        print(f"perfdiff {args.old} -> {args.new}: "
+              f"{verdict['compared']} shared metrics, "
+              f"{verdict['checked']} gated, "
+              f"{len(verdict['regressions'])} regression(s), "
+              f"{len(verdict['improvements'])} improvement(s)")
+        for r in verdict["regressions"]:
+            bound = (f" bound={r['bound']}" if "bound" in r
+                     else f" tol={r['tol']}")
+            print(f"  REGRESSION {r['metric']} ({r['direction']}{bound}): "
+                  f"{r['old']} -> {r['new']}")
+        for r in verdict["improvements"]:
+            print(f"  improved   {r['metric']}: {r['old']} -> {r['new']}")
+        if verdict["only_old"]:
+            print(f"  (not in new run: {', '.join(verdict['only_old'][:8])}"
+                  + (" ..." if len(verdict["only_old"]) > 8 else "") + ")")
+        print("VERDICT:", "PASS" if verdict["ok"] else "FAIL")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -61,7 +61,35 @@ for name in sorted(faults.POINTS):
 if missing:
     sys.exit("README observability-catalog drift — document these in the "
              "README tables: " + ", ".join(missing))
+
+# scheduler time-ledger states: the README ledger table must match
+# obs/perf.LEDGER_STATES EXACTLY (both directions — a renamed state with a
+# stale doc row is attribution lying to the operator). The table is the one
+# whose header row is "| Ledger state |".
+import re
+
+from dllama_tpu.obs import perf
+
+rows, in_table = [], False
+for line in readme.splitlines():
+    if line.startswith("| Ledger state |"):
+        in_table = True
+        continue
+    if in_table:
+        if not line.startswith("|"):
+            break
+        m = re.match(r"^\| `([a-z_]+)` \|", line)
+        if m:
+            rows.append(m.group(1))
+readme_states, catalog_states = set(rows), set(perf.LEDGER_STATES)
+if readme_states != catalog_states:
+    sys.exit("ledger state-label drift between obs/perf.LEDGER_STATES and "
+             f"the README ledger table: catalog-only="
+             f"{sorted(catalog_states - readme_states)} readme-only="
+             f"{sorted(readme_states - catalog_states)}")
+
 print(f"checks: catalog drift OK ({len(metrics.REGISTRY.names())} metrics, "
       f"{len(trace.SPAN_CATALOG)} spans, {len(trace.EVENT_CATALOG)} events, "
-      f"{len(faults.POINTS)} fault points all documented)")
+      f"{len(faults.POINTS)} fault points, "
+      f"{len(perf.LEDGER_STATES)} ledger states all documented)")
 PY

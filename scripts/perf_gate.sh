@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# perf_gate.sh OLD.json NEW.json [extra perfdiff args] — the mechanical
+# bench regression gate (ISSUE 7): diffs two BENCH_r*.json records
+# field-by-field with per-metric directions and tolerances
+# (experiments/perfdiff.py owns the rule table) and exits with the verdict:
+#
+#   0  no gated metric regressed (a self-diff always passes)
+#   1  at least one gated regression (the printed table names them)
+#   2  usage / unreadable / malformed input
+#
+# $PERFDIFF_SCALE multiplies every trend tolerance (e.g. 2 on a noisy CPU
+# fallback host); invariant ceilings (ledger residual) are never scaled.
+# Typical round-close usage:   scripts/perf_gate.sh BENCH_r06.json BENCH_r07.json
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if [ $# -lt 2 ]; then
+  echo "usage: scripts/perf_gate.sh OLD.json NEW.json [--json] [--scale F]" >&2
+  exit 2
+fi
+exec python experiments/perfdiff.py "$1" "$2" \
+  ${PERFDIFF_SCALE:+--scale "$PERFDIFF_SCALE"} "${@:3}"

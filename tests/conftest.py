@@ -62,6 +62,7 @@ def rng():
 #: just in this order.
 _RUN_FIRST = (
     "test_tokenizer.py",
+    "test_perf.py",
     "test_trace.py",
     "test_native.py",
     "test_converters.py",

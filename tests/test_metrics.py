@@ -205,7 +205,11 @@ def mserver(tmp_path_factory):
     mpath, tpath, _cfg = make_tiny_files(tmp_path)
     loaded = load_model(mpath, tpath, mesh=None)
     httpd, api = make_server(loaded, host="127.0.0.1", port=0, n_slots=2,
-                             max_queue=4)
+                             max_queue=4,
+                             # loose SLO targets (CPU box): the /debug/perf
+                             # and postmortem-slo drills below want armed,
+                             # attainable targets — not real latency bars
+                             slo_ttft_ms=120_000.0, slo_itl_ms=120_000.0)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     st, _ = post(httpd.server_address[1], "/v1/chat/completions",
                  {"messages": [{"role": "user", "content": "hi"}],
@@ -574,6 +578,88 @@ def test_debug_profile_starts_and_conflicts_409(mserver, tmp_path, monkeypatch):
     # malformed duration is a client error, not a wedged session
     st, data, _ = _post_raw(port, "/debug/profile", {"duration_s": "soon"})
     assert st == 400
+
+
+def test_debug_perf_joins_windows_ledger_roofline(mserver):
+    """GET /debug/perf (ISSUE 7): after at least one served request the
+    join must show a populated TTFT window with p50/p95/p99, a ledger whose
+    per-state seconds partition loop wall time (within 2%), a priced
+    roofline view for the decode path, SLO accounting against the armed
+    targets, and the process self-metrics — one JSON document, no tracer
+    dependency."""
+    port, _api, _ = mserver
+    st, data, _ = _post_raw(port, "/v1/chat/completions",
+                            {"messages": [{"role": "user", "content": "perf"}],
+                             "max_tokens": 6, "temperature": 0.0})
+    assert st == 200
+    st, data, _ = _get_raw(port, "/debug/perf")
+    assert st == 200
+    doc = json.loads(data)
+    assert doc["mode"] == "continuous"
+    win = doc["window"]["ttft"]
+    assert win["count"] >= 1
+    assert win["p50"] is not None and win["p95"] is not None
+    assert win["p99"] >= win["p50"] > 0
+    led = doc["ledger"]
+    assert led["wall_s"] > 0
+    assert abs(led["covered_s"] - led["wall_s"]) / led["wall_s"] <= 0.02
+    assert set(led["fractions"]) == {
+        "idle", "admission", "prefill", "decode_dispatch", "decode_wait",
+        "emit", "commit", "restart_backoff"}
+    assert led["seconds"]["decode_wait"] > 0  # decode actually ran
+    roof = doc["roofline"]
+    assert roof["priced"] and roof["window_chunks"] > 0
+    assert roof["bandwidth_attainment"] is not None
+    assert roof["throughput_tok_s"] >= roof["goodput_tok_s"] >= 0
+    slo = doc["slo"]
+    assert slo["enabled"] and slo["targets"]["ttft_ms"] == 120_000.0
+    assert slo["attainment"] == 1.0  # targets are 2 minutes on purpose
+    proc = doc["process"]
+    assert proc["uptime_s"] > 0 and proc["threads"] >= 2
+    # the same views land on /metrics as gauges at scrape time
+    st, text, _ = _get_raw(port, "/metrics")
+    fams, samples = parse_exposition(text.decode())
+    assert samples[("dllama_latency_window_seconds",
+                    '{metric="ttft",quantile="p50"}')] > 0
+    assert ("dllama_scheduler_time_seconds_total",
+            '{state="decode_wait"}') in samples
+    assert samples[("dllama_slo_attainment", "")] == 1.0
+    assert samples[("dllama_process_uptime_seconds", "")] > 0
+    assert samples[("dllama_process_rss_bytes", "")] > 0
+
+
+def test_health_carries_process_self_metrics(mserver):
+    port, _api, _ = mserver
+    st, data, _ = _get_raw(port, "/health")
+    assert st == 200
+    proc = json.loads(data)["process"]
+    assert proc["uptime_s"] > 0
+    assert proc["rss_bytes"] > 0
+    assert proc["threads"] >= 2  # worker + this handler at minimum
+
+
+def test_postmortem_gains_slo_verdict(mserver):
+    """/debug/requests/{req_id} postmortems judge the request's recorded
+    marks against the configured SLOs: ttft_ok/itl_ok plus violated_by_ms,
+    derived from the flight recorder's own ttft/e2e/decode_tokens."""
+    port, _api, _ = mserver
+    rid = new_request_id()
+    st, _data, _ = _post_raw(port, "/v1/chat/completions",
+                             {"messages": [{"role": "user", "content": "slo"}],
+                              "max_tokens": 6, "temperature": 0.0},
+                             headers={"X-Request-Id": rid})
+    assert st == 200
+    st, data, _ = _get_raw(port, f"/debug/requests/{rid}")
+    assert st == 200
+    doc = json.loads(data)
+    v = doc["slo"]
+    assert v["targets"] == {"ttft_ms": 120_000.0, "itl_ms": 120_000.0}
+    assert v["ttft_ok"] is True  # a CPU tiny-model decode beats 2 minutes
+    assert v["ok"] is True
+    assert v["violated_by_ms"] == {"ttft": None, "itl": None}
+    assert v["itl_ms"] == pytest.approx(  # display-rounded to 3 places
+        (doc["e2e_ms"] - doc["ttft_ms"]) / (doc["decode_tokens"] - 1),
+        abs=1e-3)
 
 
 def test_crash_path_marks_error_and_counts_fault_fires(mserver):
