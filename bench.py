@@ -45,6 +45,12 @@ Env knobs:
                        configuration)
   BENCH_PAGED_HI       int: slot count for the high-slot paged leg
                        (default 2x the A/B slot count / 2x max BENCH_SLOTS)
+  BENCH_PAGED_KERNEL   '0': skip the paged-attention route A/B (jnp gather
+                       vs the fused flash-decode kernel at 2-3 page sizes;
+                       off-TPU the kernel leg runs interpret mode on a tiny
+                       synthetic model — the ratio is only meaningful on TPU)
+  BENCH_PAGED_KERNEL_PAGES  comma list of page sizes for that A/B
+                       (default '16,64,128' on TPU, '8,16' off)
   BENCH_SLO            '0': skip the SLO/saturation snapshot record (windowed
                        percentiles + scheduler time ledger + roofline
                        attainment — the fields scripts/perf_gate.sh diffs)
@@ -830,6 +836,93 @@ def bench_paged(cfg, params, slots, n_decode=64, page_size=128,
     return out
 
 
+def bench_paged_kernel(cfg=None, params=None, slots=4, n_decode=None,
+                       page_sizes=None):
+    """Paged-attention ROUTE A/B (ISSUE 8): the same paged engine decoding
+    through the jnp block-table gather (`attn_impl='jnp'` ->
+    'paged_gather') vs the fused flash-decode kernel (`attn_impl='flash'`
+    -> 'paged_kernel') at 2-3 page sizes — including ones the old %64 gate
+    could not route. Token streams are bit-identical (tested); the ratio is
+    the traffic/dispatch win of streaming live pages + fusing the KV
+    scatter instead of re-materializing the whole view through XLA.
+
+    Off-TPU the kernel leg runs in Pallas INTERPRET mode (an emulator, not
+    a perf path), so the record shrinks to a tiny synthetic model and tags
+    itself ``interpret: true`` — the ratio only carries meaning from a TPU
+    window. BENCH_PAGED_KERNEL=0 skips."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from dllama_tpu.engine.batch import BatchEngine
+    from dllama_tpu.models.config import LlamaConfig
+    from dllama_tpu.models.llama import random_params
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    tiny = cfg is None or params is None or not on_tpu
+    if n_decode is None:
+        # the tiny fixture's 64-row context must bound the decode window
+        # even on TPU (prompt 8 + warmup + timed passes must stay inside
+        # the per-row limit, or the timed pass measures frozen no-op steps)
+        n_decode = 8 if tiny else 64
+    if page_sizes is None:
+        env = os.environ.get("BENCH_PAGED_KERNEL_PAGES")
+        if env:
+            page_sizes = tuple(int(x) for x in env.split(","))
+        else:
+            page_sizes = (8, 16) if tiny else (16, 64, 128)
+    if tiny:
+        cfg = LlamaConfig(dim=64, hidden_dim=128, n_layers=2, n_heads=4,
+                          n_kv_heads=2, vocab_size=96, seq_len=64)
+        params = random_params(cfg, seed=0, dtype=jnp.float32, quantize=False)
+        cache_dtype = jnp.float32
+    else:
+        cache_dtype = _cache_dtype()
+    rng = np.random.default_rng(0)
+    out = {"interpret": not on_tpu, "n_decode": n_decode, "slots": slots,
+           "pages": {}}
+
+    def run(attn_impl, page):
+        eng = BatchEngine(cfg, params, n_slots=slots, cache_dtype=cache_dtype,
+                          max_prefill_chunk=64, kv_layout="paged",
+                          page_size=page, attn_impl=attn_impl)
+        try:
+            route = eng.attn_route
+            for s in range(slots):
+                eng.add(s, list(rng.integers(1, cfg.vocab_size, 8)),
+                        temperature=0.0, seed=s)
+            eng.decode(n_decode)  # compile + warmup
+            t0 = time.perf_counter()
+            eng.decode(n_decode)
+            t = time.perf_counter() - t0
+            return {"attn_route": route,
+                    "agg_tok_s": round(slots * n_decode / t, 1),
+                    "step_ms": round(1000.0 * t / n_decode, 2)}
+        finally:
+            del eng
+    for page in page_sizes:
+        # shrink to the largest 8-row-aligned divisor of the context so tiny
+        # presets keep every requested leg
+        p = min(page, cfg.seq_len) // 8 * 8  # align down to the sublane
+        while p >= 8 and cfg.seq_len % p:
+            p -= 8
+        if p < 8 or str(p) in out["pages"]:
+            continue
+        rec = {}
+        for impl, attn in (("gather", "jnp"), ("kernel", "flash")):
+            try:
+                rec[impl] = run(attn, p)
+            except Exception as e:
+                rec[impl] = {"error": repr(e)[:200]}
+        g, k = rec.get("gather", {}), rec.get("kernel", {})
+        if g.get("agg_tok_s") and k.get("agg_tok_s"):
+            rec["tok_s_ratio_kernel_gather"] = round(
+                k["agg_tok_s"] / g["agg_tok_s"], 3)
+        out["pages"][str(p)] = rec
+    return out
+
+
 def bench_slo(cfg, params, n_slots=8, chunk=4, steps=48, pf_chunk=64,
               slo_ttft_ms=5000.0, slo_itl_ms=500.0):
     """SLO & saturation record (ISSUE 7): serve a short mixed burst through
@@ -1370,6 +1463,18 @@ def worker():
         except Exception as e:
             paged_ab = {"error": repr(e)[:200]}
 
+    # paged-attention route A/B: jnp gather vs the fused flash-decode
+    # kernel at 2-3 page sizes (ISSUE 8); BENCH_PAGED_KERNEL=0 skips
+    paged_kernel_ab = None
+    if (os.environ.get("BENCH_PAGED_KERNEL") != "0"
+            and time.monotonic() < deadline - 90):
+        try:
+            paged_kernel_ab = bench_paged_kernel(
+                LlamaConfig(**PRESETS[sweep_on]) if sweep_on else None,
+                admit_params)
+        except Exception as e:
+            paged_kernel_ab = {"error": repr(e)[:200]}
+
     # bytes/token describes the headline (sweep) config when one ran
     cfg8 = LlamaConfig(**PRESETS[sweep_on or run_presets[-1]])
     n_dev = jax.device_count()
@@ -1411,6 +1516,7 @@ def worker():
         "overlap": overlap_ab,
         "trace": trace_ab,
         "paged": paged_ab,
+        "paged_kernel": paged_kernel_ab,
         "slo": slo_rec,
         "kb_per_token_per_chip": kb_measured if kb_measured is not None else round(kb, 1),
         "kb_per_token_source": "measured_hlo" if kb_measured is not None else "analytic",

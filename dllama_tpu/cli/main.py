@@ -61,18 +61,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="KV cache element type; f8 (e4m3) halves cache HBM "
                         "traffic/footprint — 2x the slots or context per chip "
                         "at a small accuracy cost")
-    p.add_argument("--kv-layout", choices=["dense", "paged"], default="dense",
+    p.add_argument("--kv-layout", choices=["auto", "dense", "paged"],
+                   default="auto",
                    help="serve mode, needs --slots > 0: KV cache layout. "
                         "'paged' backs slots with a refcounted page pool + "
                         "block tables instead of a full per-slot context "
                         "reservation — bit-exact token streams, prefix reuse "
                         "shares pages copy-free, and admission becomes "
                         "capacity-aware (defers when the pool can't cover "
-                        "prompt + one decode page). 'dense' stays default "
-                        "until a TPU window times the paged path")
+                        "prompt + one decode page). 'auto' (default) picks "
+                        "'paged' on unsharded engines where the paged "
+                        "flash-decode kernel's capability check passes "
+                        "(any 8-row-aligned page size; f8 caches and "
+                        "meshes stay 'dense'). Pin 'dense' to opt out, or "
+                        "'paged' to force the layout regardless of kernel "
+                        "capability (see MIGRATION.md)")
     p.add_argument("--page-size", type=int, default=128,
                    help="paged KV cache: rows per page (must divide the "
-                        "context length; 128 keeps pages flash-tileable)")
+                        "context length; kv-layout auto shrinks it to "
+                        "gcd(page-size, context) so short contexts stay "
+                        "paged; any multiple of 8 rides the Pallas paged "
+                        "kernel — no 64-row tileability requirement)")
     p.add_argument("--kv-pages", type=int, default=0,
                    help="paged KV cache: pool size in pages; 0 = full "
                         "coverage (slots x context / page-size — same "

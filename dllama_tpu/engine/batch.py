@@ -579,9 +579,14 @@ class BatchEngine:
 
         sel = resolve_kernels(cfg, self.seq_len, n_slots, kernels, attn_impl,
                               shardings, paged=self.pool is not None,
-                              page_size=self.page_size)
+                              page_size=self.page_size,
+                              cache_dtype=cache_dtype)
         mm, mm_in, attn_fn = sel.mm, sel.mm_in, sel.attn_fn
         self.backend = sel.backend
+        # which attention path actually runs ('paged_kernel' = the fused
+        # flash-decode kernel, 'paged_gather' = jnp view gather, ...) — the
+        # cost model prices the two paged routes very differently
+        self.attn_route = sel.attn_route
 
         self._prefill_step = jax.jit(
             partial(self._prefill_impl, cfg, attn_fn, self._col_fn, mm, mm_in, moe_impl),
@@ -980,7 +985,12 @@ class BatchEngine:
             n_kv_heads=cfg.n_kv_heads, vocab_size=cfg.vocab_size,
             seq_len=self.seq_len, weight_bytes=int(params_nbytes(self.params)),
             cache_bytes_per_el=int(cache_el),
-            paged=self.kv_layout == "paged", page_size=self.page_size)
+            paged=self.kv_layout == "paged", page_size=self.page_size,
+            # the routed attention path decides the paged pricing: the
+            # gather fallback re-materializes the whole block-table view
+            # through XLA every step, the kernel streams live pages only
+            paged_impl=("gather" if self.attn_route == "paged_gather"
+                        else "kernel"))
 
     def warm_restart(self) -> None:
         """Crash recovery WITHOUT a model reload: rebuild everything a

@@ -19,12 +19,25 @@ import jax
 from dllama_tpu.models.config import LlamaConfig
 
 
+#: Paged-layout attention routes (documented in the README "Paged KV cache"
+#: routing table — scripts/checks.sh asserts the two stay in sync):
+#: ``paged_kernel`` = the any-page-size Pallas flash-decode kernel with the
+#: fused KV scatter (ops/pallas/paged_attention), ``paged_gather`` = the jnp
+#: block-table gather fallback (ops/layers.paged_gqa_attention).
+PAGED_ROUTES = ("paged_kernel", "paged_gather")
+
+
 @dataclass
 class KernelSelection:
     mm: Callable  # matmul for output-dim-sharded / replicated weights
     mm_in: Callable | None  # matmul for input-dim-sharded weights (wo/w2)
     attn_fn: Callable | None  # attention impl; None = jnp gqa_attention
     backend: str  # 'pallas' | 'xla' (what the quantized matmuls run on)
+    attn_route: str = "jnp"  # which attention path attn_fn resolves to:
+    # 'jnp' | 'flash' | 'sharded_flash' | 'ring' | 'paged_kernel' |
+    # 'paged_gather' — the single string obs/bench/README quote for "what
+    # actually runs", and what chunk_cost_model prices (kernel vs gather
+    # paged bytes differ by the whole re-materialized view)
 
 
 def resolve_kernels(
@@ -36,6 +49,8 @@ def resolve_kernels(
     shardings=None,
     paged: bool = False,  # paged KV layout: route the paged attention path
     page_size: int = 0,
+    cache_dtype=None,  # KV pool element type (paged capability check);
+    # None = bf16, the serving default
 ) -> KernelSelection:
     """Resolution rules:
 
@@ -65,34 +80,56 @@ def resolve_kernels(
         mm, mm_in = shardings.pallas_mms(batch)
         backend = "pallas"
 
-    if paged:
+    if paged and shardings is None:
         # paged KV cache (BatchEngine --kv-layout paged; unsharded only — the
-        # page pool has no slot axis for a dp mesh to shard). attn_fn=None
-        # means models.llama.forward defaults to the jnp gather fallback
-        # (ops.layers.paged_gqa_attention), valid everywhere; the
-        # block-table-indexed flash kernel rides the same gate as dense
-        # flash where the page size is tileable.
-        from dllama_tpu.ops.pallas.flash_attention import (
-            paged_flash_gqa_attention,
-            paged_supported,
+        # page pool has no slot axis for a dp mesh to shard, and BatchEngine
+        # rejects paged+mesh at construction; a sharded resolve_kernels call
+        # falls through to the dense rules below as defense in depth).
+        # attn_fn=None means models.llama.forward defaults to the jnp gather
+        # fallback (ops.layers.paged_gqa_attention), valid everywhere but
+        # re-materializing the whole paged view through XLA each step; the
+        # general flash-decode kernel (scalar-prefetched block tables,
+        # double-buffered page DMA, fused KV scatter) routes on an explicit
+        # CAPABILITY check — dtype/head-dim/page-geometry, ANY page size —
+        # not the old whole-64-row-tile gate.
+        from dllama_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention,
+            paged_decode_supported,
         )
 
+        import jax.numpy as jnp
+
         attn_fn = None
-        if attn_impl != "jnp" and paged_supported(
-            (cfg.n_heads, cfg.head_size), page_size
-        ) and (attn_impl == "flash" or (on_tpu and shardings is None)):
-            attn_fn = partial(paged_flash_gqa_attention, interpret=not on_tpu)
+        route = "paged_gather"
+        if attn_impl != "jnp" and paged_decode_supported(
+            (cfg.n_heads, cfg.head_size), page_size,
+            kv_dtype=cache_dtype if cache_dtype is not None else jnp.bfloat16,
+        ) and (attn_impl == "flash" or on_tpu):
+            interp = not on_tpu
+
+            def attn_fn(q, k_pool, v_pool, tables, pos, new_k, new_v, active):
+                return paged_decode_attention(
+                    q, k_pool, v_pool, tables, pos, new_k, new_v, active,
+                    interpret=interp)
+
+            # models/llama._layer hands the new KV rows to the kernel
+            # instead of paying a separate scatter dispatch per layer
+            attn_fn.fused_kv_scatter = True
+            route = "paged_kernel"
         return KernelSelection(mm=mm, mm_in=mm_in, attn_fn=attn_fn,
-                               backend=backend)
+                               backend=backend, attn_route=route)
 
     attn_fn = shardings.attn_fn(batch) if shardings is not None else None
+    route = "ring" if attn_fn is not None else "jnp"
     if attn_fn is None and attn_impl != "jnp":
         from dllama_tpu.ops.pallas.flash_attention import flash_gqa_attention, supported
 
         if supported((cfg.n_heads, cfg.head_size), seq_len):
             if sharded_pallas:
                 attn_fn = shardings.pallas_attn(batch, interpret=not on_tpu)
+                route = "sharded_flash"
             elif attn_impl == "flash" or (on_tpu and shardings is None):
+                route = "flash"
                 attn_fn = partial(
                     flash_gqa_attention, interpret=not on_tpu,
                     # kv grids bucketed by live-context length — decode steps
@@ -107,4 +144,5 @@ def resolve_kernels(
                     # at pos=8 but CPU interpret timings don't transfer.
                     s_buckets=os.environ.get("DLLAMA_FLASH_BUCKETS") == "1")
 
-    return KernelSelection(mm=mm, mm_in=mm_in, attn_fn=attn_fn, backend=backend)
+    return KernelSelection(mm=mm, mm_in=mm_in, attn_fn=attn_fn,
+                           backend=backend, attn_route=route)

@@ -126,16 +126,12 @@ def _paged_cache_update(pool, new, tables, pos_base, active):
     active==False are routed to the TRASH page (index P-1, never allocated)
     — a per-row index swap instead of the dense path's whole-cache where().
     """
+    from dllama_tpu.ops.layers import paged_write_targets
+
     new = new.astype(pool.dtype)
     b, h, t, hd = new.shape
-    page = pool.shape[2]
-    pos = jnp.broadcast_to(jnp.asarray(pos_base, jnp.int32), (b,))
-    rows = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None]  # [B, T]
-    blk = jnp.clip(rows // page, 0, tables.shape[1] - 1)
-    off = rows % page
-    pages = jnp.take_along_axis(tables, blk, axis=1)  # [B, T]
-    if active is not None:
-        pages = jnp.where(active[:, None], pages, pool.shape[0] - 1)
+    pages, off = paged_write_targets(tables, pos_base, t, pool.shape[2],
+                                     pool.shape[0], active)
     return pool.at[pages, :, off, :].set(new.transpose(0, 2, 1, 3))
 
 
@@ -183,6 +179,14 @@ def _layer(cfg: LlamaConfig, x, layers, li, k_cache, v_cache, rope, pos_base, at
         k_cache = _cache_update(k_cache, k.transpose(0, 2, 1, 3), pos_base, active)
         v_cache = _cache_update(v_cache, v.transpose(0, 2, 1, 3), pos_base, active)
         att = attn_fn(q, k_cache, v_cache, pos_base).reshape(b, t, d)
+    elif getattr(attn_fn, "fused_kv_scatter", False):
+        # paged flash-decode kernel: the new rows' scatter write is fused
+        # into the attention launch (ops/pallas/paged_attention) — no
+        # separate per-layer scatter dispatch, identical pool contents
+        att, k_cache, v_cache = attn_fn(
+            q, k_cache, v_cache, tables, pos_base,
+            k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3), active)
+        att = att.reshape(b, t, d)
     else:  # paged layout: scatter at block-table positions, same math
         k_cache = _paged_cache_update(k_cache, k.transpose(0, 2, 1, 3),
                                       tables, pos_base, active)

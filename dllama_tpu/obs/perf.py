@@ -321,7 +321,8 @@ def decode_step_bytes(*, n_layers: int, dim: int, hidden_dim: int,
                       vocab_size: int, seq_len: int, weight_bytes: int,
                       slots: int, live_rows: float,
                       cache_bytes_per_el: int = 2, paged: bool = False,
-                      page_size: int = 128) -> int:
+                      page_size: int = 128,
+                      paged_impl: str = "kernel") -> int:
     """Per-STEP HBM bytes of a ``slots``-wide batched decode — THE cost
     model (moved here from ``experiments/hbm_traffic.py``, which now
     delegates, so the offline roofline tables and the live attainment gauge
@@ -329,9 +330,22 @@ def decode_step_bytes(*, n_layers: int, dim: int, hidden_dim: int,
     every slot; the KV stream scales with slots; activations scale with
     slots but stay negligible. ``live_rows`` is the per-slot live KV
     horizon in rows (the offline script passes ``live_frac * seq_len``; the
-    live path passes the chunk's mean position). paged=True adds the paged
-    layout's honest overhead: live rows round up to whole pages and each
-    kernel re-reads the i32 block tables (k + v, per layer)."""
+    live path passes the chunk's mean position).
+
+    paged=True prices by the routed attention path (``paged_impl``, set
+    from ``KernelSelection.attn_route``):
+
+    * ``kernel`` — the Pallas flash-decode kernel: PER-PAGE KV reads (live
+      rows round up to whole pages — the page DMA quantum) plus the i32
+      block tables, scalar-prefetched ONCE per fused launch per layer (the
+      fused scatter rides the same launch, so there is no second table
+      read and no separate scatter dispatch).
+    * ``gather`` — the jnp fallback: on top of the per-page pool reads,
+      XLA MATERIALIZES the full ``max_blocks*page = seq_len``-row
+      contiguous view for k and v (one write + one read of the whole view,
+      per layer, every step) and reads the tables once per gather (k + v).
+      This is the traffic blowup the kernel exists to remove — the two
+      routes' bytes differ by design, not by drift."""
     L, d, h = n_layers, dim, hidden_dim
     m = max(8, slots)  # one fused step: all slots are rows of one matmul
 
@@ -341,14 +355,22 @@ def decode_step_bytes(*, n_layers: int, dim: int, hidden_dim: int,
     acts = (mm_act(d, d) * 2 + mm_act(d, kv_dim) * 2
             + mm_act(d, h) * 2 + mm_act(h, d)) * L + mm_act(d, vocab_size)
     rows = float(live_rows)
+    view_rows = 0.0
     if paged:
         # page-granular pruning horizon: live rows round up to whole pages
         rows = -(-int(rows) // page_size) * page_size
-    kv_stream = int(2 * slots * n_kv_heads * rows * head_size
+        if paged_impl == "gather":
+            # full contiguous view, written then read, k and v, per layer
+            view_rows = 2.0 * seq_len
+    kv_stream = int(2 * slots * n_kv_heads * (rows + view_rows) * head_size
                     * cache_bytes_per_el) * L
     kv_write = 2 * slots * kv_dim * cache_bytes_per_el * L
-    table_read = (4 * slots * (seq_len // page_size) * 2 * L
-                  if paged else 0)  # i32 block tables, k + v, per layer
+    if not paged:
+        table_read = 0
+    elif paged_impl == "gather":
+        table_read = 4 * slots * (seq_len // page_size) * 2 * L  # k + v gathers
+    else:
+        table_read = 4 * slots * (seq_len // page_size) * L  # one fused launch
     return int(weight_bytes + acts + kv_stream + kv_write + table_read
                + slots * d * 2)
 
@@ -373,6 +395,7 @@ class ChunkCostModel:
     cache_bytes_per_el: int = 2
     paged: bool = False
     page_size: int = 128
+    paged_impl: str = "kernel"  # 'kernel' | 'gather' (KernelSelection route)
 
     def step_bytes(self, slots: int, live_rows: float) -> int:
         return decode_step_bytes(
@@ -382,7 +405,8 @@ class ChunkCostModel:
             seq_len=self.seq_len, weight_bytes=self.weight_bytes,
             slots=slots, live_rows=live_rows,
             cache_bytes_per_el=self.cache_bytes_per_el,
-            paged=self.paged, page_size=self.page_size)
+            paged=self.paged, page_size=self.page_size,
+            paged_impl=self.paged_impl)
 
 
 # -------------------------------------------------------------- SLO policy

@@ -265,6 +265,28 @@ def paged_view(pool: jax.Array, tables: jax.Array) -> jax.Array:
     return kv.transpose(0, 2, 1, 3, 4).reshape(b, hkv, nb * page, hd)
 
 
+def paged_write_targets(tables: jax.Array, pos_base: jax.Array, t: int,
+                        page: int, n_pool: int,
+                        active: jax.Array | None) -> tuple[jax.Array, jax.Array]:
+    """(pages, offsets) i32[B, T] for writing T new KV rows at block-table
+    positions — THE single definition of paged write addressing: logical
+    row pos+tt of slot b lands in pool page tables[b, (pos+tt) // page] at
+    offset (pos+tt) % page, block index clipped to the table width, and
+    rows of inactive slots routed to the trash page (n_pool - 1, never
+    allocated). Shared by models/llama._paged_cache_update (the XLA
+    scatter) and ops/pallas/paged_attention (the fused in-kernel scatter),
+    so the two write paths cannot drift apart."""
+    b, nb = tables.shape
+    pos = jnp.broadcast_to(jnp.asarray(pos_base, jnp.int32), (b,))
+    rows = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None]  # [B, T]
+    blk = jnp.clip(rows // page, 0, nb - 1)
+    off = rows % page
+    pages = jnp.take_along_axis(tables, blk, axis=1)  # [B, T]
+    if active is not None:
+        pages = jnp.where(active[:, None], pages, n_pool - 1)
+    return pages.astype(jnp.int32), off.astype(jnp.int32)
+
+
 def paged_gqa_attention(
     q: jax.Array,  # [B, T, Hq, hd]
     k_pool: jax.Array,  # [P, Hkv, page, hd] (one layer's pool slice)
@@ -273,8 +295,12 @@ def paged_gqa_attention(
     pos_base: jax.Array,  # i32 scalar, or [B] per-sequence positions
 ) -> jax.Array:
     """Causal GQA over the paged KV cache: the jnp reference/fallback path —
-    gather the block-table view, then run the dense attention math unchanged
-    (the flash variant in ops/pallas/flash_attention.py DMA-indexes pages
-    directly instead of materializing the view)."""
+    gather the block-table view, then run the dense attention math unchanged.
+    This re-materializes the ENTIRE view through XLA every step; the routed
+    production path (`kernel_select` route 'paged_kernel') is the
+    flash-decode kernel in ops/pallas/paged_attention.py, which DMA-walks
+    pages via scalar-prefetched tables instead — this gather stays the
+    bit-for-bit correctness reference and serves attn_impl='jnp', f8 pools,
+    and non-sublane-aligned page sizes."""
     return gqa_attention(q, paged_view(k_pool, tables),
                          paged_view(v_pool, tables), pos_base)

@@ -1147,10 +1147,45 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
             log.warning("--spec is unavailable on dp>1 meshes; the "
                         "continuous-batching tier decodes without speculation")
             spec_n = 0
-        # paged KV cache (--kv-layout paged): the pool replaces the per-slot
-        # context reservation; unsharded engines only (BatchEngine raises on
-        # meshes — startup is the right place to find that out)
-        kv_layout = defaults.get("kv_layout") or "dense"
+        # paged KV cache (--kv-layout): 'auto' — the serving default —
+        # resolves to 'paged' on unsharded engines (the general paged
+        # flash-decode kernel serves any page size, so the layout no longer
+        # waits on tileability) and 'dense' on meshes (the pool has no slot
+        # axis to shard; BatchEngine raises on paged+mesh — startup is the
+        # right place to find an explicit 'paged' conflict out). The page
+        # size shrinks to gcd(page_size, context) so short contexts stay
+        # paged; a degenerate gcd (< 8 rows) falls back to dense.
+        import math as _math
+
+        kv_layout = defaults.get("kv_layout") or "auto"
+        page_size = int(defaults.get("page_size") or 128)
+        if kv_layout == "auto":
+            if loaded.shardings is not None:
+                kv_layout = "dense"
+            else:
+                # paged-by-default only where the flash-decode KERNEL could
+                # route (paged_decode_supported): a config the kernel must
+                # refuse — f8 pools, non-sublane-aligned pages — would
+                # silently serve every step through the gather fallback's
+                # re-materialized-view traffic, which is worse than the
+                # dense default it replaced. Explicit --kv-layout paged
+                # still honors the user's choice unconditionally.
+                from dllama_tpu.ops.pallas.paged_attention import (
+                    paged_decode_supported,
+                )
+
+                g = _math.gcd(page_size, loaded.engine.seq_len)
+                capable = g >= 8 and paged_decode_supported(
+                    (loaded.config.n_heads, loaded.config.head_size), g,
+                    kv_dtype=loaded.engine.cache.k.dtype)
+                kv_layout = "paged" if capable else "dense"
+                if capable and g != page_size:
+                    log.info("kv-layout auto: page size %d does not divide "
+                             "context %d; using %d", page_size,
+                             loaded.engine.seq_len, g)
+                if capable:
+                    page_size = g
+            log.info("kv-layout auto -> %s", kv_layout)
         be = BatchEngine(
             loaded.config,
             loaded.engine.params,
@@ -1161,7 +1196,7 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
             sync=getattr(loaded, "sync", "bf16"),
             spec=spec_n,
             kv_layout=kv_layout,
-            page_size=int(defaults.get("page_size") or 128),
+            page_size=page_size,
             kv_pages=int(defaults.get("kv_pages") or 0),
         )
         # admission pacing (serve/scheduler.py): budget bounds the decode

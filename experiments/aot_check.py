@@ -161,6 +161,43 @@ def cases(full: bool):
                                                     s_buckets=True),
                 (q8k, kv8k, kv8k), True))
 
+    # general paged flash-decode kernel (ops/pallas/paged_attention): the
+    # paged-by-default serving route — scalar-prefetched block tables,
+    # double-buffered page DMA, fused KV-row scatter (whole-page RMW).
+    # Production at the shipped default page size AND at the small/odd
+    # sizes the old %64 gate rejected (the capability check admits them,
+    # so Mosaic must keep accepting them); the t=9 case is the batched
+    # spec-verify shape, the t=256 case exercises the XLA pre-scatter
+    # prefill path of the same wrapper.
+    from dllama_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+    def paged(page, nb, t=1, b=4, read_only=False):
+        hq, hkv, hd = 32, 8, 128
+        npool = b * nb + 1
+        pools = S((npool, hkv, page, hd), jnp.bfloat16)
+        args = [S((b, t, hq, hd), jnp.bfloat16), pools, pools,
+                S((b, nb), jnp.int32), S((b,), jnp.int32)]
+        if read_only:
+            return (lambda q, kp, vp, tb, pos: paged_decode_attention(
+                q, kp, vp, tb, pos, interpret=False), tuple(args))
+        args += [S((b, hkv, t, hd), jnp.bfloat16),
+                 S((b, hkv, t, hd), jnp.bfloat16), S((b,), jnp.bool_)]
+        return (lambda q, kp, vp, tb, pos, nk, nv, act: paged_decode_attention(
+            q, kp, vp, tb, pos, nk, nv, act, interpret=False), tuple(args))
+
+    fn, args = paged(128, 8)
+    out.append(("paged decode p=128 fused scatter", fn, args, True))
+    fn, args = paged(8, 64)
+    out.append(("paged decode p=8 fused scatter", fn, args, True))
+    fn, args = paged(24, 16)
+    out.append(("paged decode p=24 (odd page) fused scatter", fn, args, True))
+    fn, args = paged(128, 8, t=9)
+    out.append(("paged spec verify t=9 p=128 fused scatter", fn, args, True))
+    fn, args = paged(128, 8, t=256, b=1)
+    out.append(("paged prefill t=256 p=128 (XLA pre-scatter)", fn, args, True))
+    fn, args = paged(128, 8, read_only=True)
+    out.append(("paged decode p=128 read-only sweep", fn, args, True))
+
     from dllama_tpu.ops.pallas.rms_norm import rms_norm as prms
 
     out.append(("rms_norm (reserve)", lambda x, w: prms(x, w, 1e-5),

@@ -121,7 +121,8 @@ def kernel_stream_bytes(cfg: LlamaConfig, live_frac: float = 1.0,
 
 def batched_step_bytes(cfg: LlamaConfig, slots: int, live_frac: float = 1.0,
                        cache_bytes_per_el: int = 2, paged: bool = False,
-                       page_size: int = 128) -> int:
+                       page_size: int = 128,
+                       paged_impl: str = "kernel") -> int:
     """Per-STEP HBM bytes of a `slots`-wide batched decode (BatchEngine):
     the weight stream is read once and serves every slot (the entire point
     of the serving tier), while the KV stream scales with slots — each
@@ -130,12 +131,14 @@ def batched_step_bytes(cfg: LlamaConfig, slots: int, live_frac: float = 1.0,
 
     paged=True accounts the paged layout's overhead against the SAME
     DMA-contract discipline as the dense rows: (1) the live KV stream
-    rounds up to whole pages per slot (the kv grid's clamp granularity is
-    the page once tiles can't span page boundaries), and (2) each kernel
-    reads the i32 block tables (slots * seq/page entries, k and v, per
-    layer) as its scalar-prefetch operand. Both are per-step HBM reads the
-    dense layout does not pay — the honest cost of making the 96-slot pool
-    allocatable at all.
+    rounds up to whole pages per slot (the page is the DMA quantum of the
+    flash-decode kernel), and (2) the i32 block tables ride as the
+    scalar-prefetch operand — once per fused launch per layer on the
+    ``paged_impl='kernel'`` route (ops/pallas/paged_attention, the shipped
+    default), or per gather (k + v) PLUS a full re-materialized
+    ``seq_len``-row view write+read on the ``'gather'`` jnp fallback. Both
+    are per-step HBM reads the dense layout does not pay — the honest cost
+    of making the 96-slot pool allocatable at all.
 
     The byte formula itself lives in ``dllama_tpu/obs/perf.decode_step_bytes``
     (ISSUE 7): the live bandwidth-attainment gauge prices every consumed
@@ -151,7 +154,7 @@ def batched_step_bytes(cfg: LlamaConfig, slots: int, live_frac: float = 1.0,
         seq_len=cfg.seq_len, weight_bytes=q40_weight_bytes(cfg),
         slots=slots, live_rows=live_frac * cfg.seq_len,
         cache_bytes_per_el=cache_bytes_per_el,
-        paged=paged, page_size=page_size)
+        paged=paged, page_size=page_size, paged_impl=paged_impl)
 
 
 def abstract_model(cfg: LlamaConfig, sharding):

@@ -93,3 +93,45 @@ print(f"checks: catalog drift OK ({len(metrics.REGISTRY.names())} metrics, "
       f"{len(faults.POINTS)} fault points, "
       f"{len(perf.LEDGER_STATES)} ledger states all documented)")
 PY
+
+# paged flash-decode kernel (ISSUE 8): the op must stay registered in the
+# AOT Mosaic gate's inventory — deleting the aot_check cases would let a
+# Mosaic rejection survive to a live window while kernel_select still
+# routes the kernel by default. Textual check (no jax import: this script
+# stays sub-second).
+grep -q "paged_decode_attention" experiments/aot_check.py || {
+    echo "checks: paged_decode_attention missing from the AOT gate" \
+         "(experiments/aot_check.py op inventory)" >&2; exit 1; }
+grep -q "fused scatter" experiments/aot_check.py || {
+    echo "checks: the AOT gate lost its fused-scatter paged cases" >&2
+    exit 1; }
+
+# ...and the README routing table must name every route kernel_select can
+# resolve the paged layout to (engine/kernel_select.PAGED_ROUTES is the
+# definition site; both directions checked textually)
+for route in paged_kernel paged_gather; do
+    grep -q "\"$route\"" dllama_tpu/engine/kernel_select.py || {
+        echo "checks: route '$route' missing from engine/kernel_select.py" \
+             "(PAGED_ROUTES drifted?)" >&2; exit 1; }
+    grep -q "| \`$route\` |" README.md || {
+        echo "checks: README 'Paged KV cache' routing table lost its" \
+             "'$route' row" >&2; exit 1; }
+done
+python - <<'PY'
+import re
+
+with open("dllama_tpu/engine/kernel_select.py", encoding="utf-8") as f:
+    m = re.search(r"PAGED_ROUTES\s*=\s*\(([^)]*)\)", f.read())
+assert m, "PAGED_ROUTES tuple missing from engine/kernel_select.py"
+routes = set(re.findall(r'"([a-z_]+)"', m.group(1)))
+with open("README.md", encoding="utf-8") as f:
+    readme_routes = set(re.findall(r"^\| `([a-z_]+)` \|", f.read(), re.M))
+extra = {r for r in readme_routes if r.startswith("paged_")} - routes
+missing = routes - readme_routes
+if extra or missing:
+    raise SystemExit(
+        "README paged-routing drift vs kernel_select.PAGED_ROUTES: "
+        f"readme-only={sorted(extra)} catalog-only={sorted(missing)}")
+print(f"checks: paged kernel AOT registration + routing table OK "
+      f"({len(routes)} routes)")
+PY

@@ -516,13 +516,24 @@ def test_http_request_timeout_body_and_header(fserver):
     assert st == 400 and b"timeout_s" in data
 
 
-def test_http_debug_kv_dense(fserver):
-    """GET /debug/kv answers on the dense layout too (layout marker, no
-    audit) — the paged audit payload is covered at the pool level in
-    tests/test_paged_kv.py and by the chaos soak."""
-    port, _api, _ = fserver
+def test_http_debug_kv_default_paged_and_dense_marker(fserver):
+    """GET /debug/kv against the serving DEFAULT — kv-layout auto resolves
+    to paged since ISSUE 8 — returns the live pool stats plus a clean
+    on-demand audit; the dense branch keeps its layout-marker contract
+    (pool swapped out under try/finally, read per-request by the handler)."""
+    port, api, _ = fserver
     st, body, _ = _get(port, "/debug/kv")
-    assert st == 200 and body["layout"] == "dense" and body["audit"] is None
+    assert st == 200 and body["layout"] == "paged"
+    assert body["audit"]["ok"] is True and body["page_size"] >= 8
+    assert body["pool"]["total"] > 0
+    eng = api.scheduler.engine
+    saved = eng.pool
+    try:
+        eng.pool = None
+        st, body, _ = _get(port, "/debug/kv")
+        assert st == 200 and body["layout"] == "dense" and body["audit"] is None
+    finally:
+        eng.pool = saved
 
 
 def test_http_drain_503_and_inflight_completes(fserver):

@@ -63,3 +63,58 @@ def test_seq_len_untileable_skips_flash():
     # flash needs cache_seq_len % 64 == 0
     sel = resolve_kernels(CFG, 96, 1, kernels="pallas")
     assert sel.backend == "pallas" and sel.attn_fn is None
+
+
+# ------------------------------------------------- paged-layout routing
+# (ISSUE 8): the capability check replaced the old %64 tileability gate —
+# small/odd page sizes route to the fused flash-decode kernel, attn_impl=jnp
+# keeps the gather fallback, and sharded meshes stay dense-only.
+
+
+def test_paged_small_odd_pages_route_to_kernel():
+    """Page sizes the old `paged_supported` gate rejected (8, 24) now hit
+    the Pallas kernel when flash is requested (or on TPU via auto)."""
+    for page in (8, 24, 128):
+        sel = resolve_kernels(CFG, 128, 1, paged=True, page_size=page,
+                              attn_impl="flash")
+        assert sel.attn_route == "paged_kernel", page
+        assert sel.attn_fn is not None and sel.attn_fn.fused_kv_scatter
+
+
+def test_paged_attn_impl_jnp_keeps_gather():
+    sel = resolve_kernels(CFG, 128, 1, paged=True, page_size=128,
+                          attn_impl="jnp")
+    assert sel.attn_route == "paged_gather" and sel.attn_fn is None
+
+
+def test_paged_auto_on_cpu_keeps_gather():
+    # auto never picks a Pallas path off-TPU (interpret mode is a debug
+    # tool, not a serving default) — CPU serving stays on the jnp gather
+    sel = resolve_kernels(CFG, 128, 1, paged=True, page_size=128)
+    assert sel.attn_route == "paged_gather" and sel.attn_fn is None
+
+
+def test_paged_capability_fail_falls_back_to_gather():
+    # 12 rows is not sublane-aligned; f8 pools lack the Mosaic extension
+    sel = resolve_kernels(CFG, 128, 1, paged=True, page_size=12,
+                          attn_impl="flash")
+    assert sel.attn_route == "paged_gather" and sel.attn_fn is None
+    sel = resolve_kernels(CFG, 128, 1, paged=True, page_size=128,
+                          attn_impl="flash", cache_dtype=jnp.float8_e4m3fn)
+    assert sel.attn_route == "paged_gather" and sel.attn_fn is None
+
+
+def test_paged_on_sharded_mesh_resolves_dense_only():
+    """Defense in depth: BatchEngine rejects paged+mesh at construction,
+    and a paged resolve over a mesh ignores the flag — the dense sharded
+    rules apply (no paged route ever reaches a mesh)."""
+    sel = resolve_kernels(CFG, 128, 1, kernels="pallas", paged=True,
+                          page_size=128, shardings=sh(dict(tp=4)))
+    assert sel.attn_route not in ("paged_kernel", "paged_gather")
+    assert sel.attn_route == "sharded_flash" and sel.mm_in is not None
+
+
+def test_attn_route_matches_dense_resolution():
+    assert resolve_kernels(CFG, 128, 1).attn_route == "jnp"
+    assert resolve_kernels(CFG, 128, 1, kernels="pallas",
+                           attn_impl="flash").attn_route == "flash"

@@ -1,0 +1,184 @@
+"""General paged flash-decode kernel (ISSUE 8): interpret-mode parity of
+``ops/pallas/paged_attention`` against the jnp block-table gather reference
+across page sizes the old ``% 64`` gate rejected ({8, 16, 24}), plus 64;
+partial last pages; GQA group > 1; the fused KV scatter landing rows exactly
+where ``PagedKVCache``/`_paged_cache_update` expects (bitwise, incl. the
+trash-page routing of inactive rows); and the engine-level contract — the
+fused kernel's token streams are BIT-IDENTICAL to the gather path's through
+the real decode scan.
+
+Numerics note: the attention OUTPUT is online-softmax (flash), so op-level
+parity vs the materialized-softmax gather is allclose at f32 tolerance (the
+same contract as test_paged_kv's legacy flash test); the scattered POOL
+CONTENTS and the engine token streams are exact. Tiny shapes keep the file
+inside the fast tier-1 band."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dllama_tpu.models.llama import _paged_cache_update
+from dllama_tpu.ops.layers import paged_gqa_attention
+from dllama_tpu.ops.pallas.paged_attention import (
+    FUSED_SCATTER_MAX_T,
+    paged_decode_attention,
+    paged_decode_supported,
+)
+
+
+def _setup(rng, page, nb, b=2, t=1, hq=4, hkv=2, hd=64, dtype=jnp.float32):
+    npool = b * nb + 1  # +1 trash page, like PagedKVCache.create
+    q = jnp.asarray(rng.standard_normal((b, t, hq, hd)), dtype)
+    kp = jnp.asarray(rng.standard_normal((npool, hkv, page, hd)), dtype)
+    vp = jnp.asarray(rng.standard_normal((npool, hkv, page, hd)), dtype)
+    # shuffled tables: physical page order must not matter
+    tables = jnp.asarray(
+        rng.permutation(npool - 1)[: b * nb].reshape(b, nb), jnp.int32)
+    return q, kp, vp, tables
+
+
+def _reference(q, kp, vp, tables, pos, nk=None, nv=None, active=None):
+    """Scatter via the model's own `_paged_cache_update`, then the jnp
+    gather attention — the exact pair of dispatches the fused kernel
+    replaces."""
+    if nk is not None:
+        kp = _paged_cache_update(kp, nk, tables, pos, active)
+        vp = _paged_cache_update(vp, nv, tables, pos, active)
+    return paged_gqa_attention(q, kp, vp, tables, pos), kp, vp
+
+
+@pytest.mark.parametrize("page,nb,pos", [
+    (8, 8, [19, 1]),      # small page the old gate rejected
+    (16, 4, [35, 0]),     # pow-2, one slot empty
+    (24, 3, [51, 17]),    # non-power-of-2, partial last page both slots
+    (64, 2, [63, 127]),   # legacy-tileable size, page-boundary edges
+])
+def test_read_parity_any_page_size(rng, page, nb, pos):
+    """Read-only sweep matches the gather reference for every (page_size,
+    horizon) combo — incl. pages the old `% 64` gate rejected."""
+    q, kp, vp, tables = _setup(rng, page, nb)
+    pos = jnp.asarray(pos, jnp.int32)
+    want, _, _ = _reference(q, kp, vp, tables, pos)
+    got = paged_decode_attention(q, kp, vp, tables, pos, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("page,nb,t,pos", [
+    (8, 8, 1, [19, 1]),    # decode step
+    (8, 8, 5, [9, 2]),     # spec-verify chunk crossing a page boundary
+    (24, 3, 1, [23, 47]),  # write at the exact last row of a page
+])
+def test_fused_scatter_parity(rng, page, nb, t, pos):
+    """Fused path: pools match `_paged_cache_update` BITWISE (the row lands
+    where PagedKVCache expects) and the output reads the just-written rows."""
+    q, kp, vp, tables = _setup(rng, page, nb, t=t)
+    pos = jnp.asarray(pos, jnp.int32)
+    nk = jnp.asarray(rng.standard_normal((2, 2, t, 64)), jnp.float32)
+    nv = jnp.asarray(rng.standard_normal((2, 2, t, 64)), jnp.float32)
+    want, kp_ref, vp_ref = _reference(q, kp, vp, tables, pos, nk, nv)
+    got, kp2, vp2 = paged_decode_attention(q, kp, vp, tables, pos, nk, nv,
+                                           interpret=True)
+    np.testing.assert_array_equal(np.asarray(kp2), np.asarray(kp_ref))
+    np.testing.assert_array_equal(np.asarray(vp2), np.asarray(vp_ref))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_fused_scatter_inactive_rows_hit_trash_page(rng):
+    """active=False rows scatter to the trash page (pool page P-1) exactly
+    like `_paged_cache_update`'s masked write — live pages untouched."""
+    q, kp, vp, tables = _setup(rng, 16, 4)
+    pos = jnp.asarray([35, 1], jnp.int32)
+    active = jnp.asarray([True, False])
+    nk = jnp.asarray(rng.standard_normal((2, 2, 1, 64)), jnp.float32)
+    nv = jnp.asarray(rng.standard_normal((2, 2, 1, 64)), jnp.float32)
+    _, kp_ref, vp_ref = _reference(q, kp, vp, tables, pos, nk, nv, active)
+    _, kp2, vp2 = paged_decode_attention(q, kp, vp, tables, pos, nk, nv,
+                                         active, interpret=True)
+    np.testing.assert_array_equal(np.asarray(kp2), np.asarray(kp_ref))
+    np.testing.assert_array_equal(np.asarray(vp2), np.asarray(vp_ref))
+    # slot 1's own pages really kept their old contents (the write went to
+    # the trash page, not to its table positions)
+    for pg in np.asarray(tables[1]):
+        np.testing.assert_array_equal(np.asarray(kp2[pg]), np.asarray(kp[pg]))
+
+
+def test_gqa_group_gt_one(rng):
+    """group 4 (the llama-3 ratio): one kv sweep serves the whole folded
+    query group."""
+    q, kp, vp, tables = _setup(rng, 8, 8, hq=8, hkv=2)
+    pos = jnp.asarray([19, 3], jnp.int32)
+    want, _, _ = _reference(q, kp, vp, tables, pos)
+    got = paged_decode_attention(q, kp, vp, tables, pos, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_prefill_chunk_pre_scatter_path(rng):
+    """t > FUSED_SCATTER_MAX_T takes the XLA pre-scatter branch of the same
+    wrapper: identical pools and output as the fused contract."""
+    t = FUSED_SCATTER_MAX_T * 2
+    q, kp, vp, tables = _setup(rng, 8, 8, t=t)
+    pos = jnp.asarray([0, 3], jnp.int32)
+    nk = jnp.asarray(rng.standard_normal((2, 2, t, 64)), jnp.float32)
+    nv = jnp.asarray(rng.standard_normal((2, 2, t, 64)), jnp.float32)
+    want, kp_ref, vp_ref = _reference(q, kp, vp, tables, pos, nk, nv)
+    got, kp2, vp2 = paged_decode_attention(q, kp, vp, tables, pos, nk, nv,
+                                           interpret=True)
+    np.testing.assert_array_equal(np.asarray(kp2), np.asarray(kp_ref))
+    np.testing.assert_array_equal(np.asarray(vp2), np.asarray(vp_ref))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_capability_check():
+    """The explicit capability contract that replaced the %64 tileability
+    gate: any 8-row-aligned page (incl. odd sizes), hd >= 8, 16/32-bit
+    pools; f8 and sub-sublane pages route to the gather fallback."""
+    assert paged_decode_supported((32, 128), 8)
+    assert paged_decode_supported((32, 128), 24)   # old gate: rejected
+    assert paged_decode_supported((32, 128), 120)  # old gate: rejected
+    assert paged_decode_supported((32, 128), 128, kv_dtype=jnp.float32)
+    assert not paged_decode_supported((32, 128), 12)   # not sublane-aligned
+    assert not paged_decode_supported((32, 4), 128)    # head dim too small
+    assert not paged_decode_supported((32, 128), 128,
+                                      kv_dtype=jnp.float8_e4m3fn)
+
+
+def test_engine_streams_bit_exact_kernel_vs_gather():
+    """The serving contract: with the SAME engine construction, routing
+    attention through the fused kernel (attn_impl='flash' -> paged_kernel)
+    yields BIT-IDENTICAL greedy and sampled token streams to the jnp gather
+    route — through the real decode scan, scatter fused and all."""
+    from dllama_tpu.engine.batch import BatchEngine
+    from dllama_tpu.models.config import LlamaConfig
+    from dllama_tpu.models.llama import random_params
+
+    cfg = LlamaConfig(dim=64, hidden_dim=128, n_layers=2, n_heads=4,
+                      n_kv_heads=2, vocab_size=96, seq_len=64)
+    params = random_params(cfg, seed=3, dtype=jnp.float32, quantize=False)
+
+    def run(attn_impl, spec=0):
+        eng = BatchEngine(cfg, params, n_slots=2, cache_dtype=jnp.float32,
+                          kv_layout="paged", page_size=8, attn_impl=attn_impl,
+                          spec=spec)
+        eng.add(0, [1, 2, 3, 4, 5], temperature=0.0, seed=0)
+        eng.add(1, [9, 8, 7], temperature=0.7, seed=42)
+        if spec:
+            toks, counts = eng.spec_step()
+            return eng.attn_route, np.asarray(toks), np.asarray(counts)
+        return eng.attn_route, np.asarray(eng.decode(10))
+
+    route_g, toks_g = run("jnp")
+    route_k, toks_k = run("flash")
+    assert (route_g, route_k) == ("paged_gather", "paged_kernel")
+    np.testing.assert_array_equal(toks_g, toks_k)
+    # batched spec verify (t = k+1 > 1): the fused scatter's multi-row
+    # page RMW through the real propose/verify cycle, same emissions
+    rg, eg, ag = run("jnp", spec=4)
+    rk, ek, ak = run("flash", spec=4)
+    assert (rg, rk) == ("paged_gather", "paged_kernel")
+    np.testing.assert_array_equal(ag, ak)
+    np.testing.assert_array_equal(eg, ek)

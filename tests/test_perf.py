@@ -315,17 +315,33 @@ def test_cost_model_single_definition_site():
     gauge share one formula — the ISSUE 7 no-drift contract)."""
     hbm = pytest.importorskip("experiments.hbm_traffic")
     cfg = hbm.PRESETS["1b"]
-    for slots, frac, paged in ((8, 0.5, False), (32, 1.0, False),
-                               (8, 0.25, True), (96, 1.0, True)):
+    for slots, frac, paged, impl in ((8, 0.5, False, "kernel"),
+                                     (32, 1.0, False, "kernel"),
+                                     (8, 0.25, True, "kernel"),
+                                     (96, 1.0, True, "kernel"),
+                                     (8, 0.25, True, "gather"),
+                                     (96, 1.0, True, "gather")):
         expect = perf.decode_step_bytes(
             n_layers=cfg.n_layers, dim=cfg.dim, hidden_dim=cfg.hidden_dim,
             kv_dim=cfg.kv_dim, head_size=cfg.head_size,
             n_kv_heads=cfg.n_kv_heads, vocab_size=cfg.vocab_size,
             seq_len=cfg.seq_len, weight_bytes=hbm.q40_weight_bytes(cfg),
-            slots=slots, live_rows=frac * cfg.seq_len, paged=paged)
-        assert hbm.batched_step_bytes(cfg, slots, live_frac=frac,
-                                      paged=paged) == expect
+            slots=slots, live_rows=frac * cfg.seq_len, paged=paged,
+            paged_impl=impl)
+        assert hbm.batched_step_bytes(cfg, slots, live_frac=frac, paged=paged,
+                                      paged_impl=impl) == expect
     assert hbm.V5E_HBM_GBS == perf.PEAK_HBM_GBS
+    # the two paged routes price DIFFERENT traffic by design: the gather
+    # fallback pays the re-materialized seq_len-row view (write + read, k+v,
+    # per layer) the kernel route exists to remove
+    kb = hbm.batched_step_bytes(cfg, 8, live_frac=0.25, paged=True,
+                                paged_impl="kernel")
+    gb = hbm.batched_step_bytes(cfg, 8, live_frac=0.25, paged=True,
+                                paged_impl="gather")
+    view = (2 * 8 * cfg.n_kv_heads * 2 * cfg.seq_len * cfg.head_size * 2
+            * cfg.n_layers)
+    table = 4 * 8 * (cfg.seq_len // 128) * cfg.n_layers
+    assert gb - kb == view + table
 
 
 # ------------------------------------------------- real-scheduler invariant
