@@ -45,6 +45,9 @@ Env knobs:
                        configuration)
   BENCH_PAGED_HI       int: slot count for the high-slot paged leg
                        (default 2x the A/B slot count / 2x max BENCH_SLOTS)
+  BENCH_RADIX          '0': skip the radix prefix-cache chat-replay record
+                       (shared-system-prompt + multi-turn legs, cold-vs-warm
+                       TTFT and saved-prefill tokens)
   BENCH_PAGED_KERNEL   '0': skip the paged-attention route A/B (jnp gather
                        vs the fused flash-decode kernel at 2-3 page sizes;
                        off-TPU the kernel leg runs interpret mode on a tiny
@@ -923,6 +926,97 @@ def bench_paged_kernel(cfg=None, params=None, slots=4, n_decode=None,
     return out
 
 
+def bench_radix(cfg, params, n_slots=4, chunk=4, steps=24, pf_chunk=64,
+                page_size=64, sys_pages=4, followers=4, turns=3):
+    """Radix prefix-cache chat-replay record (ISSUE 9): the two dominant
+    reuse shapes, measured cold vs warm through a real Scheduler with the
+    cache ON (the paged default):
+
+    * **shared-system-prompt leg**: one cold request pays the full prefill
+      of a `sys_pages`-page system prompt; `followers` requests sharing it
+      map the pages from the tree and prefill only their few-token suffix —
+      warm TTFT collapses toward the suffix cost
+      (`warm_cold_ttft_ratio`, the perfdiff-gated field);
+    * **multi-turn leg**: a conversation re-sending its whole history each
+      turn — per-turn prefilled-vs-saved token counts show prefill cost
+      proportional to NEW tokens only.
+
+    BENCH_RADIX=0 skips. CPU-feasible; the ratio is meaningful on any
+    host since both legs share one engine/compile."""
+    import numpy as np
+
+    from dllama_tpu.engine.batch import BatchEngine
+    from dllama_tpu.serve.scheduler import Scheduler
+
+    page_size = min(page_size, cfg.seq_len)
+    while cfg.seq_len % page_size:
+        page_size //= 2
+    sys_len = min(sys_pages * page_size, max(8, cfg.seq_len // 2))
+    rng = np.random.default_rng(0)
+    system = [int(x) for x in rng.integers(1, cfg.vocab_size - 1, sys_len)]
+    sched = None
+    try:
+        eng = BatchEngine(cfg, params, n_slots=n_slots, cache_dtype=_cache_dtype(),
+                          max_prefill_chunk=pf_chunk, kv_layout="paged",
+                          page_size=page_size, radix_cache="on",
+                          kernels=os.environ.get("BENCH_KERNELS", "auto"),
+                          attn_impl=os.environ.get("BENCH_ATTN", "auto"))
+        sched = Scheduler(eng, chunk=chunk)
+        warm = sched.submit([3, 1, 4], 0.0, 0.9, 2 * chunk, frozenset(), seed=5)
+        list(warm.tokens())  # compile warm-up (prefill + decode paths)
+        eng.radix_evict(1 << 30)  # start the legs from an empty tree
+        sched.reset_latency_stats()
+
+        def run_one(prompt, seed):
+            r = sched.submit(list(prompt), 0.0, 0.9, steps, frozenset(),
+                             seed=seed)
+            toks = list(r.tokens())
+            return r.ttft_ms, len(toks)
+
+        base = eng.radix_stats()["hit_tokens"]
+        cold_ttft, _ = run_one(system + [7, 8], seed=0)
+        warm_ttfts = []
+        for i in range(followers):
+            t, _ = run_one(system + [20 + i, 21 + i], seed=i + 1)
+            warm_ttfts.append(t)
+        st = eng.radix_stats()
+        shared_leg = {
+            "system_tokens": sys_len,
+            "followers": followers,
+            "cold_ttft_ms": round(cold_ttft, 3),
+            "warm_ttft_ms_mean": round(sum(warm_ttfts) / len(warm_ttfts), 3),
+            "saved_prefill_tokens": st["hit_tokens"] - base,
+        }
+
+        # multi-turn leg: the agent-loop shape — each turn re-sends history
+        history = list(system[: 2 * page_size])
+        turn_rows = []
+        for t in range(turns):
+            base = eng.radix_stats()["hit_tokens"]
+            history = history + [int(x) for x in
+                                 rng.integers(1, cfg.vocab_size - 1, 5)]
+            ttft, n = run_one(history, seed=100 + t)
+            saved = eng.radix_stats()["hit_tokens"] - base
+            turn_rows.append({"turn": t, "prompt_tokens": len(history),
+                              "saved_tokens": saved,
+                              "prefilled_tokens": len(history) - saved,
+                              "ttft_ms": round(ttft, 3)})
+            history += [7] * n  # fold the reply in, like a chat client
+        out = {
+            "page_size": page_size, "slots": n_slots, "chunk": chunk,
+            "shared_system": shared_leg,
+            "multi_turn": turn_rows,
+            "radix": eng.radix_stats(),
+        }
+        if cold_ttft and warm_ttfts:
+            out["warm_cold_ttft_ratio"] = round(
+                shared_leg["warm_ttft_ms_mean"] / cold_ttft, 4)
+        return out
+    finally:
+        if sched is not None:
+            sched.shutdown()
+
+
 def bench_slo(cfg, params, n_slots=8, chunk=4, steps=48, pf_chunk=64,
               slo_ttft_ms=5000.0, slo_itl_ms=500.0):
     """SLO & saturation record (ISSUE 7): serve a short mixed burst through
@@ -1463,6 +1557,20 @@ def worker():
         except Exception as e:
             paged_ab = {"error": repr(e)[:200]}
 
+    # radix prefix-cache chat replay (ISSUE 9): shared-system-prompt +
+    # multi-turn legs, cold-vs-warm TTFT and saved-prefill tokens with the
+    # cache on; BENCH_RADIX=0 skips
+    radix_rec = None
+    if (sweep_on and admit_params is not None
+            and os.environ.get("BENCH_RADIX") != "0"
+            and time.monotonic() < deadline - 120):
+        try:
+            radix_rec = bench_radix(
+                LlamaConfig(**PRESETS[sweep_on]), admit_params,
+                n_slots=min(4, min(s for s in slot_list) if slot_list else 4))
+        except Exception as e:
+            radix_rec = {"error": repr(e)[:200]}
+
     # paged-attention route A/B: jnp gather vs the fused flash-decode
     # kernel at 2-3 page sizes (ISSUE 8); BENCH_PAGED_KERNEL=0 skips
     paged_kernel_ab = None
@@ -1517,6 +1625,7 @@ def worker():
         "trace": trace_ab,
         "paged": paged_ab,
         "paged_kernel": paged_kernel_ab,
+        "radix": radix_rec,
         "slo": slo_rec,
         "kb_per_token_per_chip": kb_measured if kb_measured is not None else round(kb, 1),
         "kb_per_token_source": "measured_hlo" if kb_measured is not None else "analytic",

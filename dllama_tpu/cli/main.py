@@ -88,6 +88,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "capacity as dense). Smaller pools overcommit "
                         "capacity: more slots than HBM could densely hold, "
                         "admission-gated by actual page demand")
+    p.add_argument("--radix-cache", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="serve mode, needs --slots > 0: cross-request radix "
+                        "prefix cache over the paged KV pool — a global tree "
+                        "keyed on token ids whose nodes hold refcounted page "
+                        "references; admissions map the longest shared "
+                        "prefix for free (shared system prompts, few-shot "
+                        "templates, multi-turn chat become O(new tokens) "
+                        "prefill), LRU leaves are reclaimed under capacity "
+                        "pressure. 'auto' (default) = on whenever the KV "
+                        "layout is paged; token streams are bit-exact on or "
+                        "off. Telemetry: dllama_radix_* series, "
+                        "GET /debug/radix")
     p.add_argument("--max-prefill-chunk", type=int, default=256,
                    help="prefill chunk cap (pow-2 chunks; larger = better MXU "
                         "utilization, more HBM for activations)")
@@ -413,6 +426,7 @@ def cmd_serve(args) -> int:
         kv_layout=args.kv_layout,
         page_size=args.page_size,
         kv_pages=args.kv_pages,
+        radix_cache=args.radix_cache,
     )
 
 

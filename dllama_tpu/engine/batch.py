@@ -101,6 +101,11 @@ class PagePool:
         # leak fails at the release that caused it, not at drain)
         self.audit_on_release = (
             os.environ.get("DLLAMA_POOL_AUDIT", "") not in ("", "0"))
+        # radix prefix cache hook (engine/radix.RadixCache.audit_refs): a
+        # provider of per-page TREE reference counts, so audit() reconciles
+        # refcount == table refs + tree refs instead of flagging every
+        # cached prefix page as corruption
+        self.radix_refs = None
         self._publish()
 
     # ----------------------------------------------------------- accounting
@@ -154,6 +159,18 @@ class PagePool:
                         problems.append(
                             f"slot {s} block {b} references page {p} "
                             f"outside the pool [0, {self.n_pages})")
+            radix_pages = 0
+            if self.radix_refs is not None:
+                # radix prefix-cache reconciliation: tree refs + block-table
+                # refs must EXACTLY account for every refcount — a node ref
+                # the tree forgot (leak) or double-counted shows up as the
+                # same mismatch a corrupt table would
+                tree_refs, tree_problems = self.radix_refs()
+                problems.extend(tree_problems)
+                for p, c in tree_refs.items():
+                    if 0 <= p < self.n_pages:
+                        refs[p] += c
+                        radix_pages += c
             bad = np.flatnonzero(refs != self.refcount)
             for p in bad[:8]:
                 problems.append(
@@ -199,7 +216,8 @@ class PagePool:
             report = {"ok": not problems, "problems": problems,
                       "total": self.n_pages, "free": len(self._free),
                       "used": self.n_pages - len(self._free),
-                      "shared": shared, "page_size": self.page_size}
+                      "shared": shared, "page_size": self.page_size,
+                      "radix_pages": radix_pages}
         if problems:
             ins.KV_AUDIT_FAILURES.inc()
             if raise_on_fail:
@@ -320,6 +338,20 @@ class PagePool:
                 copy_fn(int(self.tables[src, full]), new)
                 self.tables[dst, full] = new
                 self.n_blocks[dst] = full + 1
+            self._publish()
+
+    def adopt_prefix(self, slot: int, pages: list[int]) -> None:
+        """Point `slot`'s first blocks at `pages` BY REFERENCE — the radix
+        prefix-cache mapping primitive: refcounts bump, zero device copies
+        (a shared partial boundary page among `pages` is copy-on-written
+        later by prepare_admission/ensure_writable when the divergent rows
+        are about to be rewritten). Drops whatever the slot held before."""
+        with self._mu:
+            self.free_tail(slot, 0)
+            for i, p in enumerate(pages):
+                self.refcount[p] += 1
+                self.tables[slot, i] = p
+            self.n_blocks[slot] = len(pages)
             self._publish()
 
     def prepare_admission(self, slot: int, start: int, end: int, copy_fn) -> None:
@@ -459,6 +491,11 @@ class BatchEngine:
         # Smaller pools overcommit: admission becomes capacity-aware in the
         # serving scheduler, and slots freeze per-row at their allocated
         # limit when the pool runs dry mid-decode.
+        radix_cache: str = "auto",  # 'auto' | 'on' | 'off' (--radix-cache):
+        # cross-request radix prefix tree over the page pool (engine/radix).
+        # auto = on whenever the layout is paged; the tree only acts through
+        # the radix_* methods the serving scheduler drives, so direct add/
+        # decode/release library use is unchanged either way.
     ):
         from dllama_tpu.ops.layers import build_rope_cache
 
@@ -503,6 +540,17 @@ class BatchEngine:
                 cfg, n_slots, n_pages, self.page_size, cache_dtype, max_blocks)
         else:
             self.cache = KVCache.create(cfg, n_slots, cache_dtype, self.seq_len)
+        if radix_cache not in ("auto", "on", "off"):
+            raise ValueError(
+                f"radix_cache must be auto|on|off, got {radix_cache!r}")
+        if radix_cache == "on" and self.pool is None:
+            raise ValueError("--radix-cache on requires the paged KV layout "
+                             "(the tree's nodes own page-pool references)")
+        self.radix = None
+        if self.pool is not None and radix_cache != "off":
+            from dllama_tpu.engine.radix import RadixCache
+
+            self.radix = RadixCache(self.pool)
         if shardings is not None:
             if shardings.mesh.shape["sp"] > 1 or shardings.mesh.shape["pp"] > 1:
                 # per-slot vector positions don't fit the sp shard_map masks or
@@ -575,8 +623,12 @@ class BatchEngine:
             self._col_fn = make_q80_col_matmul(shardings.mesh)
 
         # kernel selection shared with InferenceEngine (engine/kernel_select.py)
-        from dllama_tpu.engine.kernel_select import resolve_kernels
+        from dllama_tpu.engine.kernel_select import (
+            resolve_kernels,
+            resolve_moe_impl,
+        )
 
+        moe_impl = resolve_moe_impl(moe_impl, shardings)
         sel = resolve_kernels(cfg, self.seq_len, n_slots, kernels, attn_impl,
                               shardings, paged=self.pool is not None,
                               page_size=self.page_size,
@@ -964,6 +1016,75 @@ class BatchEngine:
         on the dense layout."""
         return None if self.pool is None else self.pool.stats()
 
+    # ------------------------------------------------------ radix prefix api
+    # (engine/radix.RadixCache over the page pool; the serving scheduler is
+    # the only driver — these are no-ops / zeros when the cache is off)
+
+    def radix_lookup(self, toks) -> tuple[int, object | None]:
+        """(reusable_rows, hit-handle) for `toks` against the global radix
+        tree; (0, None) when the cache is off."""
+        if self.radix is None:
+            return 0, None
+        hit = self.radix.lookup(toks)
+        return hit.rows, hit
+
+    def radix_map(self, slot: int, hit) -> None:
+        """Map a lookup hit into `slot`: the matched full pages land in its
+        block table BY REFERENCE (refcount bump, zero copies), a partial
+        boundary page is mapped shared too — the following add_begin's
+        prepare_admission copy-on-writes it via the existing
+        ensure_writable before any divergent row is rewritten. Positions
+        the slot at the reused row count like copy_prefix_rows does."""
+        assert not self.active[slot], f"slot {slot} is busy"
+        pages = list(hit.pages)
+        if hit.part:
+            pages.append(hit.boundary)
+        self.pool.adopt_prefix(slot, pages)
+        self.pos[slot] = hit.rows
+        if self.spec_k and hit.rows:
+            # the mapped prefix's token ids feed the n-gram proposer, same
+            # as the cross-slot copy path did
+            self.history = self._hist_write(
+                self.history, jnp.int32(slot), jnp.int32(0),
+                jnp.asarray(np.asarray(hit.tokens, np.int32)))
+        self._vec_dirty = True
+
+    def radix_insert(self, slot: int, toks) -> int:
+        """Insert the full-page prefix of `toks` (rows already written in
+        `slot` — the prompt at commit, the emitted prefix at release) into
+        the tree; adopted pages gain a tree reference that outlives the
+        slot. Returns pages adopted (0 when off / nothing new)."""
+        if self.radix is None or not len(toks):
+            return 0
+        full = min(len(toks) // self.page_size, int(self.pool.n_blocks[slot]))
+        if full <= 0:
+            return 0
+        return self.radix.insert(list(toks)[: full * self.page_size],
+                                 self.pool.tables[slot, :full])
+
+    def radix_evict(self, need: int, protect=None) -> int:
+        """Reclaim up to `need` pool pages from the tree (LRU leaves,
+        coldest first); `protect` pins an in-progress admission's matched
+        path. Returns pages actually freed."""
+        return 0 if self.radix is None else self.radix.evict(need, protect)
+
+    def radix_admission_deficit(self, total_rows: int, reuse_rows: int) -> int:
+        """Pages SHORT for a radix admission of `total_rows` rows with
+        `reuse_rows` already mapped from the tree — the radix analog of
+        admission_deficit (slots are always empty at admission here: the
+        tree, not idle slots, holds the cache). Includes the one-page
+        decode reserve; the boundary COW clone and the suffix pages cost
+        the same whether the boundary is shared or freshly grown."""
+        pool = self.pool
+        with pool._mu:
+            full = int(reuse_rows) // self.page_size
+            return max(0, pool.blocks_for(total_rows) + 1 - full
+                       - pool.free_count)
+
+    def radix_stats(self) -> dict | None:
+        """Tree occupancy + cumulative hit accounting; None when off."""
+        return None if self.radix is None else self.radix.stats()
+
     def chunk_cost_model(self):
         """Frozen obs/perf.ChunkCostModel pricing THIS engine's decode
         steps (the scheduler's roofline-attainment feed): the same per-op
@@ -1011,6 +1132,13 @@ class BatchEngine:
             self.cache = PagedKVCache.create(
                 self.cfg, self.n_slots, self.pool.n_pages, self.page_size,
                 self.cache_dtype, max_blocks)
+            if self.radix is not None:
+                # the radix tree's page ids died with the pool: rebuild it
+                # EMPTY against the fresh allocator (never stale page refs);
+                # cumulative hit accounting carries over
+                from dllama_tpu.engine.radix import RadixCache
+
+                self.radix = RadixCache(self.pool, carry_from=self.radix)
         else:
             self.cache = KVCache.create(self.cfg, self.n_slots,
                                         self.cache_dtype, self.seq_len)

@@ -122,8 +122,12 @@ class InferenceEngine:
         # matmul + attention kernels resolved ONCE at construction (per-engine,
         # not a process-global read at trace time); gating rules shared with
         # BatchEngine via engine/kernel_select.py.
-        from dllama_tpu.engine.kernel_select import resolve_kernels
+        from dllama_tpu.engine.kernel_select import (
+            resolve_kernels,
+            resolve_moe_impl,
+        )
 
+        moe_impl = resolve_moe_impl(moe_impl, shardings)
         sel = resolve_kernels(cfg, self.seq_len, batch, kernels, attn_impl, shardings)
         mm, mm_in, attn_fn = sel.mm, sel.mm_in, sel.attn_fn
         self.backend = sel.backend

@@ -40,6 +40,28 @@ class KernelSelection:
     # paged bytes differ by the whole re-materialized view)
 
 
+def resolve_moe_impl(moe_impl: str, shardings=None) -> str:
+    """MoE compute-scheme resolution shared by both engines. On an
+    expert-parallel mesh (ep > 1) the 'sort' scheme is OFF the table:
+    jax.lax.ragged_dot has no correct GSPMD partitioning over a sharded
+    group (expert) axis on this backend — the partitioned lowering drifts
+    far beyond accumulation noise (~3e-2 on a 64-dim toy). The ep layout
+    was designed for the dense all-experts einsum (parallel/sharding.py:
+    "the all-experts einsum psums over ep under GSPMD"), so 'auto'
+    resolves to 'dense' there and an explicit 'sort' is rejected loudly
+    instead of serving wrong numerics."""
+    ep = shardings.mesh.shape.get("ep", 1) if shardings is not None else 1
+    if ep > 1:
+        if moe_impl == "sort":
+            raise ValueError(
+                "moe_impl='sort' is unsupported on ep>1 meshes: ragged_dot "
+                "partitions incorrectly over a sharded expert axis; use "
+                "'dense' (exact) or 'dispatch'")
+        if moe_impl == "auto":
+            return "dense"
+    return moe_impl
+
+
 def resolve_kernels(
     cfg: LlamaConfig,
     seq_len: int,

@@ -54,6 +54,28 @@ REUSED_PREFIX_TOKENS = metrics.counter(
     "dllama_reused_prefix_tokens_total",
     "Prompt tokens served from a cached KV prefix instead of prefill")
 
+# -------------------------------------------------- radix prefix cache
+
+RADIX_LOOKUPS = metrics.counter(
+    "dllama_radix_lookups_total",
+    "Radix prefix-tree walks at admission, by outcome (hit = at least one "
+    "reusable row; retried admissions of a capacity-deferred request count "
+    "each walk)",
+    ("outcome",))
+RADIX_HIT_TOKENS = metrics.counter(
+    "dllama_radix_hit_tokens_total",
+    "Prompt rows mapped from the radix prefix tree instead of prefilled "
+    "(saved-prefill tokens; counted at commit, so aborted admissions "
+    "never inflate it)")
+RADIX_NODES = metrics.gauge(
+    "dllama_radix_nodes",
+    "Radix prefix tree: live nodes (page-granular edges; 0 when the cache "
+    "is off or the layout is dense)")
+RADIX_PAGES = metrics.gauge(
+    "dllama_radix_pages",
+    "Radix prefix tree: KV pool pages the tree holds references to "
+    "(reclaimable by LRU eviction before admissions defer)")
+
 # ----------------------------------------------------------------- gauges
 
 BUILD_INFO = metrics.gauge(
@@ -80,8 +102,8 @@ KV_PAGES_USED = metrics.gauge(
     "Paged KV cache: pages currently referenced by at least one slot")
 KV_PAGES_SHARED = metrics.gauge(
     "dllama_kv_pages_shared",
-    "Paged KV cache: pages referenced by more than one slot "
-    "(copy-on-write prefix sharing)")
+    "Paged KV cache: pages with more than one referent — several slots, "
+    "or a slot plus the radix prefix tree (copy-on-write prefix sharing)")
 
 # ------------------------------------------------------------- histograms
 

@@ -688,6 +688,7 @@ _KNOWN_PATHS = {
     "/debug/profile": "/debug/profile",
     "/debug/kv": "/debug/kv",
     "/debug/perf": "/debug/perf",
+    "/debug/radix": "/debug/radix",
 }
 
 
@@ -804,7 +805,28 @@ class _Handler(BaseHTTPRequestHandler):
         report = pool.audit(raise_on_fail=False)
         self._send_json(200 if report["ok"] else 500,
                         {"layout": "paged", "page_size": pool.page_size,
-                         "pool": pool.stats(), "audit": report})
+                         "pool": pool.stats(), "audit": report,
+                         # radix prefix-tree occupancy rides the allocator
+                         # probe (the audit above already reconciled the
+                         # tree's page refs against the pool refcounts)
+                         "radix": sched.engine.radix_stats()
+                         if hasattr(sched.engine, "radix_stats") else None})
+
+    def _debug_radix(self) -> None:
+        """GET /debug/radix — the cross-request prefix tree: cumulative
+        hit/saved-token accounting plus a bounded dump of the live tree
+        (page-granular edges, page ids, last-use ages). enabled=false on
+        the dense layout, with --radix-cache off, or on the single-engine
+        tier. Works without the span tracer."""
+        sched = self.api.scheduler
+        radix = (getattr(sched.engine, "radix", None)
+                 if sched is not None else None)
+        if radix is None:
+            self._send_json(200, {"enabled": False, "stats": None,
+                                  "tree": None})
+            return
+        self._send_json(200, {"enabled": True, "page_size": radix.page,
+                              "stats": radix.stats(), "tree": radix.dump()})
 
     def _debug_perf(self) -> None:
         """GET /debug/perf — the ISSUE 7 join, one JSON document: sliding-
@@ -829,6 +851,11 @@ class _Handler(BaseHTTPRequestHandler):
             sched.perf.refresh_gauges()  # /metrics and this JSON agree
             payload["mode"] = "continuous"
             payload.update(sched.perf.snapshot(ledger=sched.ledger))
+            # saved-prefill accounting (radix prefix cache; None when off):
+            # hit_tokens are prompt rows that cost zero prefill FLOPs
+            payload["radix"] = (sched.engine.radix_stats()
+                                if hasattr(sched.engine, "radix_stats")
+                                else None)
         self._send_json(200, payload)
 
     def _debug_get(self) -> None:
@@ -841,6 +868,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path == "/debug/perf":
             self._debug_perf()  # also tracer-independent (registry + ledger)
+            return
+        if self.path == "/debug/radix":
+            self._debug_radix()  # tracer-independent (tree + counters)
             return
         tr = trace.TRACER
         if not tr.enabled:
@@ -1133,6 +1163,9 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
     if n_slots <= 0 and defaults.get("kv_layout") == "paged":
         log.warning("--kv-layout paged needs --slots > 0; the single-engine "
                     "tier keeps its dense per-sequence cache — ignored")
+    if n_slots <= 0 and defaults.get("radix_cache") == "on":
+        log.warning("--radix-cache on needs --slots > 0; the single-engine "
+                    "tier's NaiveCache has no page pool to share — ignored")
     if n_slots > 0:
         from dllama_tpu.engine.batch import BatchEngine
         from dllama_tpu.serve.scheduler import Scheduler
@@ -1186,6 +1219,16 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
                 if capable:
                     page_size = g
             log.info("kv-layout auto -> %s", kv_layout)
+        # cross-request radix prefix cache (--radix-cache, default auto = on
+        # whenever the layout resolved paged): an explicit 'on' against a
+        # dense resolution warns instead of failing startup — BatchEngine
+        # itself raises only on the direct-library misuse
+        radix_cache = defaults.get("radix_cache") or "auto"
+        if radix_cache == "on" and kv_layout == "dense":
+            log.warning("--radix-cache on requires the paged KV layout; this "
+                        "engine resolved dense — the per-slot prefix cache "
+                        "serves instead")
+            radix_cache = "off"
         be = BatchEngine(
             loaded.config,
             loaded.engine.params,
@@ -1198,6 +1241,7 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
             kv_layout=kv_layout,
             page_size=page_size,
             kv_pages=int(defaults.get("kv_pages") or 0),
+            radix_cache=radix_cache,
         )
         # admission pacing (serve/scheduler.py): budget bounds the decode
         # stall a joining prefill may insert per visit; the optional TTFT

@@ -29,14 +29,26 @@ running ones with ``finish_reason="timeout"`` at a chunk boundary; the
 decode NaN guard fails a request whose logits go non-finite without
 touching its batch-mates.
 
-**Per-slot prefix cache** (the batched-tier NaiveCache, dllama-api.cpp:264-309):
-released slots keep their KV rows and the token history that produced them.
-Admission matches a new request's prompt against every idle slot's history and
-prefills only the delta from the matched position (BatchEngine.add's
-start_pos) — the second turn of a conversation re-encodes the whole chat but
-only computes the new tokens. Matching is at the TOKEN level, which subsumes
-the reference's whole-message matching: any retokenization drift just means
-no reuse, never wrong output (rows past the matched position are rewritten).
+**Prefix reuse** comes in two flavors, selected by the engine:
+
+* **Radix prefix cache** (ISSUE 9, the paged default — engine/radix): a
+  GLOBAL radix tree over the KV page pool replaces the resident-slot scan as
+  the reuse mechanism. Admission walks the tree and maps the longest shared
+  prefix by page refcount (zero copies; a partial boundary page is
+  copy-on-written by the existing admission COW), commit/release insert the
+  request's own prefix back, and released slots hand every page to the tree —
+  so reuse survives slot churn and works across requests that never shared a
+  slot. Capacity pressure reclaims LRU tree leaves before a request defers.
+* **Per-slot prefix cache** (the batched-tier NaiveCache,
+  dllama-api.cpp:264-309 — dense layouts / --radix-cache off): released slots
+  keep their KV rows and the token history that produced them. Admission
+  matches a new request's prompt against every idle slot's history and
+  prefills only the delta from the matched position (BatchEngine.add's
+  start_pos).
+
+Either way, matching is at the TOKEN level, which subsumes the reference's
+whole-message matching: any retokenization drift just means no reuse, never
+wrong output (rows past the matched position are rewritten).
 """
 
 from __future__ import annotations
@@ -257,10 +269,18 @@ class Scheduler:
         # admissions being pumped chunk-by-chunk: [(req, Admission), ...];
         # their slots are reserved (not engine.active) until commit
         self._inflight: list = []
-        # per-slot token history whose KV rows are live (prefix-cache key);
+        # per-slot token history whose KV rows are live (prefix-cache key
+        # on the legacy path; resume-token record for warm restart on both);
         # len(slot_tokens[s]) always == engine.pos[s] for idle slots
         self.slot_tokens: dict[int, list[int]] = {}
         self.reused_prefix_tokens = 0  # total prompt tokens served from cache
+        # cross-request radix prefix cache (ISSUE 9, engine/radix): when the
+        # engine carries one, the GLOBAL tree replaces the resident-slot LCP
+        # scan as the reuse mechanism — admission walks the tree and maps the
+        # shared prefix by refcount, commit/release insert prefixes back, and
+        # released slots hand every page to the tree (idle slots stay empty).
+        # Dense layouts (no page pool) keep the legacy per-slot scan.
+        self._radix = getattr(engine, "radix", None)
         # decode-gap observability (VERDICT r3 #4): wall-time between
         # consecutive decode chunks whenever admission work ran in between —
         # the stall decoding slots actually experienced
@@ -531,6 +551,10 @@ class Scheduler:
             # numbers the dllama_kv_pages_{total,used,shared} gauges export
             "kv_pages": self.engine.kv_page_stats()
             if hasattr(self.engine, "kv_page_stats") else None,
+            # radix prefix-cache accounting (None when off/dense): hit_tokens
+            # is the saved-prefill-rows total the dllama_radix_* series export
+            "radix": self.engine.radix_stats()
+            if hasattr(self.engine, "radix_stats") else None,
         }
 
     def reset_latency_stats(self) -> None:
@@ -616,13 +640,26 @@ class Scheduler:
 
     def _finish(self, req: Request, reason: str, keep_rows: int | None = None) -> None:
         if req.slot >= 0:
-            self.engine.release(req.slot, keep_rows)
-            if keep_rows is not None:
-                # only the first keep_rows tokens have live KV rows (the last
-                # emitted token was sampled but never fed back)
-                self.slot_tokens[req.slot] = self.slot_tokens.get(req.slot, [])[:keep_rows]
+            if self._radix is not None:
+                # the tree is the cache: insert the trustworthy emitted
+                # prefix (full pages adopt a tree reference), then hand the
+                # slot's every page back — idle slots stay empty, and reuse
+                # for future requests comes from the tree, not the slot.
+                # keep_rows=None means the rows are unspecified (error/NaN/
+                # crash paths): nothing enters the tree.
+                if keep_rows:
+                    self.engine.radix_insert(
+                        req.slot, self.slot_tokens.get(req.slot, [])[:keep_rows])
+                self.engine.release(req.slot, None)
+                self.slot_tokens[req.slot] = []
             else:
-                self.slot_tokens[req.slot] = []  # unknown state: never reuse
+                self.engine.release(req.slot, keep_rows)
+                if keep_rows is not None:
+                    # only the first keep_rows tokens have live KV rows (the
+                    # last emitted token was sampled but never fed back)
+                    self.slot_tokens[req.slot] = self.slot_tokens.get(req.slot, [])[:keep_rows]
+                else:
+                    self.slot_tokens[req.slot] = []  # unknown state: never reuse
             self.slots.pop(req.slot, None)
             req.slot = -1
         req.finish_reason = req.finish_reason or reason
@@ -721,6 +758,14 @@ class Scheduler:
         the --max-queue shed bound — they must not disagree)."""
         return (self.pending.qsize() + (1 if self._deferred is not None else 0)
                 + len(self._recover))
+
+    def _reclaim_pages(self, needed: int) -> bool:
+        """Free KV pages for the all-starved decode rescue: LRU radix-tree
+        leaves when the tree is the cache, idle slots' retained pages on
+        the legacy path. Returns True when anything came free."""
+        if self._radix is not None:
+            return self.engine.radix_evict(needed) > 0
+        return self._evict_idle_pages(needed, set())
 
     def _evict_idle_pages(self, needed: int, exclude: set) -> bool:
         """Paged prefix-cache reclaim: drop idle slots' cached pages
@@ -861,31 +906,54 @@ class Scheduler:
                     f"{self.engine.min_pages_for(len(toks))} KV pages; "
                     f"the pool holds {pool.n_pages}"))
                 continue
-            slot, reuse, donor = self._pick_slot(toks)
-            cross = donor is not None and donor != slot and reuse > 0
-            deficit = self.engine.admission_deficit(slot, reuse,
-                                                    len(toks), cross)
+            rhit = None
+            if self._radix is not None:
+                # radix reuse: the GLOBAL tree, not resident slots, is the
+                # prefix cache — any idle slot serves (they are all empty),
+                # the walk finds the longest mappable prefix, and capacity
+                # shortfalls reclaim LRU tree leaves (the matched path is
+                # protected) before the request parks
+                taken = {adm.slot for _, adm, _ in self._inflight}
+                slot = next(s for s in range(self.engine.n_slots)
+                            if not self.engine.active[s] and s not in taken)
+                reuse, rhit = self.engine.radix_lookup(toks)
+                deficit = self.engine.radix_admission_deficit(len(toks), reuse)
+                if deficit > 0 and self.engine.radix_evict(deficit, rhit) > 0:
+                    deficit = self.engine.radix_admission_deficit(len(toks),
+                                                                  reuse)
+                cross = False
+            else:
+                slot, reuse, donor = self._pick_slot(toks)
+                cross = donor is not None and donor != slot and reuse > 0
+                deficit = self.engine.admission_deficit(slot, reuse,
+                                                        len(toks), cross)
+                if deficit > 0:
+                    # pool short: reclaim just enough idle cache (keeping the
+                    # destination and donor — their rows are this admission's
+                    # reuse), then re-pick (eviction may change the best donor)
+                    if self._evict_idle_pages(deficit, {slot, donor}):
+                        slot, reuse, donor = self._pick_slot(toks)
+                        cross = donor is not None and donor != slot and reuse > 0
+                    deficit = self.engine.admission_deficit(slot, reuse,
+                                                            len(toks), cross)
             if deficit > 0:
-                # pool short: reclaim just enough idle cache (keeping the
-                # destination and donor — their rows are this admission's
-                # reuse), then re-pick (eviction may change the best donor)
-                if self._evict_idle_pages(deficit, {slot, donor}):
-                    slot, reuse, donor = self._pick_slot(toks)
-                    cross = donor is not None and donor != slot and reuse > 0
-                if self.engine.admission_deficit(slot, reuse, len(toks),
-                                                 cross) > 0:
-                    # still short: every missing page is held by RUNNING
-                    # requests — park at the head until releases free them.
-                    # A recovered request parks back at the recover head
-                    # (the _deferred box may already hold the pre-crash
-                    # queue head — never overwrite it).
-                    if from_recover:
-                        self._recover.insert(0, req)
-                    else:
-                        self._deferred = req
-                    return
+                # still short: every missing page is held by RUNNING
+                # requests — park at the head until releases free them.
+                # A recovered request parks back at the recover head
+                # (the _deferred box may already hold the pre-crash
+                # queue head — never overwrite it).
+                if from_recover:
+                    self._recover.insert(0, req)
+                else:
+                    self._deferred = req
+                return
             try:
-                if cross:
+                if rhit is not None and reuse:
+                    # map the tree prefix into the slot by refcount: block
+                    # table written, zero copies; a partial boundary page is
+                    # copy-on-written inside add_begin's prepare_admission
+                    self.engine.radix_map(slot, rhit)
+                elif cross:
                     # cross-slot share: materialize the donor's prefix rows
                     # in the destination before the delta prefill
                     self.engine.copy_prefix_rows(donor, slot, reuse)
@@ -997,6 +1065,16 @@ class Scheduler:
                         self.slot_tokens[adm.slot] = (list(req.prompt)
                                                       + list(req.resume_tokens))
                         self.slots[adm.slot] = req
+                        if self._radix is not None:
+                            # resumed streams re-enter the tree too: rows
+                            # written = prompt + all but the unfed last
+                            # resume token (so a SECOND resume of a shared
+                            # prefix maps instead of re-prefilling)
+                            if reuse:
+                                self._radix.note_served(reuse)
+                            self.engine.radix_insert(
+                                adm.slot,
+                                list(req.prompt) + list(req.resume_tokens[:-1]))
                         trace.TRACER.req_prefill_done(
                             req.req_id, tokens=len(adm.toks) + reuse,
                             reused=reuse)
@@ -1011,6 +1089,14 @@ class Scheduler:
                         ins.REUSED_PREFIX_TOKENS.inc(reuse)
                         self.slot_tokens[adm.slot] = list(req.prompt)
                         self.slots[adm.slot] = req
+                        if self._radix is not None:
+                            # saved-prefill accounting at commit (rows REALLY
+                            # served), and the prompt's full pages enter the
+                            # tree NOW — concurrent requests sharing a system
+                            # prompt hit it while this one is still decoding
+                            if reuse:
+                                self._radix.note_served(reuse)
+                            self.engine.radix_insert(adm.slot, req.prompt)
                         trace.TRACER.req_prefill_done(
                             req.req_id, tokens=len(req.prompt), reused=reuse)
                         self._emit(req, first, int(self.engine.pos[adm.slot]))
@@ -1530,7 +1616,7 @@ class Scheduler:
                     starved[s] for s in self.slots
                     if self.engine.active[s]
                 ):
-                    if self._evict_idle_pages(len(self.slots), set()):
+                    if self._reclaim_pages(len(self.slots)):
                         pass  # reclaimed idle caches; next dispatch tops up
                     else:
                         victim = max(

@@ -12,9 +12,12 @@ What a passing soak proves, asserted at the end:
 * **100% terminal**: every submitted request reaches a terminal state
   (stop/length/timeout/error/cancelled — or a clean admission shed); no
   client queue ever hangs;
-* **allocator integrity**: ``PagePool.audit()`` is clean and, after idle
-  prefix caches are dropped, ZERO pages remain referenced (no leaks across
-  hundreds of crash/restart/timeout/error paths);
+* **allocator integrity**: ``PagePool.audit()`` is clean — including the
+  radix prefix tree's page references reconciling exactly against the pool
+  refcounts (the engine runs with the paged-default radix cache ON) — and,
+  after idle prefix caches and the tree are dropped, ZERO pages remain
+  referenced (no leaks across hundreds of crash/restart/timeout/error
+  paths);
 * **self-healing**: ``/health`` is back to live=true/ready=true once the
   fault schedule stops;
 * **counter/trace reconciliation**: dllama_engine_restarts_total,
@@ -252,15 +255,19 @@ def run_chaos(n_requests: int = 200, seed: int = 0, n_slots: int = 3,
                 problems.append(
                     f"post-chaos probe broken: {probe.finish_reason}/{got}")
 
-        # --- 3) allocator integrity: audit clean, zero pages leaked once
-        # idle prefix caches are dropped
+        # --- 3) allocator integrity: audit clean (incl. the radix prefix
+        # tree's page refs reconciling against the pool refcounts), zero
+        # pages leaked once idle prefix caches AND the tree are dropped
         audit = eng.pool.audit(raise_on_fail=False)
         report["audit"] = audit
         if not audit["ok"]:
             problems.append(f"pool audit failed: {audit['problems']}")
+        report["radix"] = eng.radix_stats()
         for s in range(n_slots):
             if not eng.active[s]:
                 eng.drop_slot_pages(s)
+        if eng.radix is not None:
+            eng.radix.clear()  # the tree's refs are cache, not leaks
         leaked = eng.pool.stats()["used"]
         report["pages_leaked"] = leaked
         if eng.active.any():

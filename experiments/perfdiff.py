@@ -54,6 +54,11 @@ RULES: list[tuple[str, str, float]] = [
     ("paged_kernel.pages.*.tok_s_ratio_kernel_gather", "higher", 0.50),
     ("batch.*.agg_tok_s", "higher", 0.20),
     ("admission.stall_reduction_x", "higher", 0.50),
+    # ISSUE 9 radix record: warm TTFT must stay collapsed relative to cold
+    # (ratio is normalized; loose tolerance — CPU hosts time compile-warm
+    # suffix prefills against a chunked cold prefill)
+    ("radix.warm_cold_ttft_ratio", "lower", 0.50),
+    ("radix.shared_system.saved_prefill_tokens", "higher", 0.50),
     # ISSUE 7 slo record: tail latency gates DOWN, attainment gates UP
     ("slo.ttft_ms_p95", "lower", 0.35),
     ("slo.itl_ms_p95", "lower", 0.35),

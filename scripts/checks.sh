@@ -34,10 +34,14 @@ for name in metrics.REGISTRY.names():
     if name not in readme:
         missing.append(f"metric:{name}")
 # the paged-KV pool gauges are load-bearing for capacity operations (ISSUE 5
-# acceptance reads dllama_kv_pages_shared): their REMOVAL from the registry
-# must fail here too, not just their absence from the README
+# acceptance reads dllama_kv_pages_shared), and the radix prefix-cache
+# series are what scripts/radix_smoke.sh and the bench radix record assert
+# on (ISSUE 9): their REMOVAL from the registry must fail here too, not
+# just their absence from the README
 for name in ("dllama_kv_pages_total", "dllama_kv_pages_used",
-             "dllama_kv_pages_shared"):
+             "dllama_kv_pages_shared",
+             "dllama_radix_lookups_total", "dllama_radix_hit_tokens_total",
+             "dllama_radix_nodes", "dllama_radix_pages"):
     if name not in metrics.REGISTRY.names():
         missing.append(f"unregistered:{name}")
 for name in sorted(trace.SPAN_CATALOG):

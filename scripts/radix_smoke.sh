@@ -1,0 +1,130 @@
+#!/usr/bin/env bash
+# radix_smoke.sh — end-to-end radix prefix-cache smoke target (ISSUE 9).
+#
+# Boots `python -m dllama_tpu serve` (the real CLI, not an in-process
+# server) on a freshly generated tiny fixture model with the paged layout
+# (where --radix-cache auto resolves ON), runs TWO chat completions that
+# share a long system prompt, and asserts:
+#
+#   * the second completion HIT the tree: dllama_radix_hit_tokens_total
+#     advanced and dllama_radix_lookups_total{outcome="hit"} is live;
+#   * GET /debug/radix shows an enabled cache with live nodes/pages;
+#   * GET /debug/kv answers 200 with a CLEAN audit — the tree's page refs
+#     reconcile exactly against the pool refcounts through the real
+#     serving surface.
+#
+# Finishes with a SIGTERM drain. SMOKE TARGET, not a pytest test (lives
+# outside tests/, exempt from the tier-1 run). CPU-only, ~1 min. Exit 0 = PASS.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import http.client
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.getcwd())
+from tests.test_serve import make_tiny_files  # the tier-1 fixture model
+
+tmp = tempfile.mkdtemp(prefix="dllama_radix_smoke_")
+mpath, tpath, _cfg = make_tiny_files(__import__("pathlib").Path(tmp))
+
+with socket.socket() as s:  # pick a free port
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+
+proc = subprocess.Popen(
+    [sys.executable, "-m", "dllama_tpu", "serve", "--model", mpath,
+     "--tokenizer", tpath, "--slots", "2", "--port", str(port),
+     "--kv-layout", "paged", "--page-size", "8", "--radix-cache", "auto"],
+    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+)
+
+
+def get(path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read().decode()
+    conn.close()
+    return r.status, body
+
+
+def metric(text, name):
+    m = re.search(rf"^{name} ([0-9.e+-]+)$", text, re.M)
+    return float(m.group(1)) if m else 0.0
+
+
+def complete(user):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", "/v1/chat/completions",
+                 json.dumps({"messages": [
+                     {"role": "system", "content":
+                      "You are a careful, thorough assistant who always "
+                      "answers in complete sentences and cites sources "
+                      "whenever they are available to you."},
+                     {"role": "user", "content": user}],
+                     "max_tokens": 6, "temperature": 0.0}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    conn.close()
+    assert resp.status == 200, f"completion -> {resp.status}: {body}"
+    assert body["usage"]["completion_tokens"] > 0
+    return body
+
+
+try:
+    deadline = time.time() + 120  # first-boot XLA compiles on CPU are slow
+    while True:
+        try:
+            if get("/health/ready")[0] == 200:
+                break
+        except OSError:
+            pass
+        if proc.poll() is not None:
+            sys.exit("FAIL: server exited before becoming ready")
+        if time.time() > deadline:
+            sys.exit("FAIL: server never became ready")
+        time.sleep(0.25)
+
+    complete("hello there")  # cold: prefills + inserts the shared prefix
+    st, m0 = get("/metrics")
+    assert st == 200
+    hits0 = metric(m0, "dllama_radix_hit_tokens_total")
+
+    complete("different question")  # warm: must map the system prefix
+    st, m1 = get("/metrics")
+    hits1 = metric(m1, "dllama_radix_hit_tokens_total")
+    assert hits1 > hits0, (
+        f"radix hit counter never advanced ({hits0} -> {hits1}); the "
+        "second completion should have mapped the shared system prompt")
+    assert re.search(r'dllama_radix_lookups_total\{outcome="hit"\} [1-9]',
+                     m1), "no hit-labelled lookup in /metrics"
+
+    st, radix = get("/debug/radix")
+    radix = json.loads(radix)
+    assert st == 200 and radix["enabled"], f"/debug/radix: {radix}"
+    assert radix["stats"]["nodes"] > 0 and radix["stats"]["pages"] > 0
+
+    st, kv = get("/debug/kv")
+    kv = json.loads(kv)
+    assert st == 200 and kv["audit"]["ok"], f"/debug/kv audit: {kv}"
+    assert kv["audit"]["radix_pages"] > 0, (
+        "audit reconciled without any tree refs — radix not live?")
+    print(f"PASS: radix serve OK — saved {hits1 - hits0:.0f} prefill tokens "
+          f"on the warm request; tree nodes={radix['stats']['nodes']} "
+          f"pages={radix['stats']['pages']}; /debug/kv audit clean")
+finally:
+    proc.send_signal(signal.SIGTERM)  # exercises the graceful drain path
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+PY

@@ -341,6 +341,10 @@ def test_deferred_request_cut_cleanly_at_drain():
         for s in range(sched.engine.n_slots):
             if not sched.engine.active[s]:
                 sched.engine.drop_slot_pages(s)
+        if sched.engine.radix is not None:
+            # the radix tree's page refs are cache (committed prompts),
+            # not leaks — drop them before the zero-leak assertion
+            sched.engine.radix.clear()
         assert pool.stats()["used"] == 0, "drain leaked pages"
     finally:
         faults.clear()
@@ -387,6 +391,8 @@ def test_deferred_request_survives_restart():
         for s in range(sched.engine.n_slots):
             if not sched.engine.active[s]:
                 sched.engine.drop_slot_pages(s)
+        if sched.engine.radix is not None:
+            sched.engine.radix.clear()  # tree refs are cache, not leaks
         assert pool.stats()["used"] == 0, "restart recovery leaked pages"
     finally:
         faults.clear()
