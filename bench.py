@@ -57,6 +57,10 @@ Env knobs:
   BENCH_SLO            '0': skip the SLO/saturation snapshot record (windowed
                        percentiles + scheduler time ledger + roofline
                        attainment — the fields scripts/perf_gate.sh diffs)
+  BENCH_SPEC_BATCH     '0': skip the speculative continuous-batching A/B
+                       (scheduler-level spec-on vs spec-off on repetitive
+                       text + a mixed spec/non-spec leg with per-class
+                       tok/s and bit-exactness checks)
 """
 
 import json
@@ -511,6 +515,159 @@ def bench_batched_spec(cfg, params, slots, k=8, kernels=None, cache_dtype=None):
         "step_ms": round(1000.0 * t / cycles, 2),
         "compile_s": round(t_compile, 1),
     }
+
+
+def bench_spec_batch(cfg, params, n_slots=4, chunk=4, steps=144, k=8,
+                     pf_chunk=64):
+    """Speculative continuous batching A/B through the REAL scheduler
+    (ISSUE 11) — unlike bench_batched_spec (the raw-engine acceptance
+    ceiling), this record drives Scheduler end to end, so admission,
+    overlap composition, and per-request spec_k are all on the measured
+    path. Two legs:
+
+    1. repetitive: all slots greedy on periodic (draft-friendly) prompts,
+       spec-on (per-request spec_k=k) vs spec-off (a spec=0 engine) —
+       `tok_s_ratio_spec_plain` is the serving-tier speculation win the
+       perfdiff gate tracks (acceptance: >= 2x on this leg);
+    2. mixed: half the slots speculate, half are SAMPLED spec_k=0 traffic —
+       the non-spec slots' per-class tok/s vs the same workload on the
+       spec-off engine (`nonspec_tok_s_ratio`, gate: no collapse) plus a
+       bit-exactness check that a spec neighbor never perturbs a sampled
+       stream (`nonspec_exact`).
+    """
+    import numpy as np
+
+    from dllama_tpu.engine.batch import BatchEngine
+    from dllama_tpu.serve.scheduler import Scheduler
+
+    rng = np.random.default_rng(0)
+    # "repetitive text" = text the model itself predicts: probe each slot's
+    # own greedy continuation once and use seed+continuation as the prompt,
+    # so the sequence's n-gram statistics really do predict what greedy
+    # decoding emits next — the core speculative-decoding workload
+    # (boilerplate, code, templated text), not an artificial token loop
+    probe = BatchEngine(cfg, params, n_slots=n_slots,
+                        cache_dtype=_cache_dtype(), max_prefill_chunk=pf_chunk,
+                        attn_impl=os.environ.get("BENCH_ATTN", "auto"))
+    seeds = [[int(x) for x in rng.integers(1, cfg.vocab_size, 4)]
+             for _ in range(n_slots)]
+    conts = {s: [probe.add(s, seeds[s], temperature=0.0, seed=s)]
+             for s in range(n_slots)}
+    for _ in range(12):
+        toks = probe.decode(4)
+        for s in range(n_slots):
+            conts[s] += [int(t) for t in toks[:, s]]
+    del probe
+    rep_prompts = [seeds[s] + conts[s][:48] for s in range(n_slots)]
+    mix_prompts = [[int(x) for x in rng.integers(1, cfg.vocab_size, 8)]
+                   for _ in range(n_slots)]
+    out = {"slots": n_slots, "chunk": chunk, "steps": steps, "spec_k": k,
+           # honesty note for off-TPU readers: a verify forward is K+1 q
+           # rows wide, so on a compute-bound host (CPU fallback) non-spec
+           # batch-mates pay a real FLOP tax per cycle; on the HBM-bound
+           # TPU decode path the wide forward streams the same bytes as a
+           # 1-wide one and that tax ~vanishes
+           "timing": "decode-phase (clock starts after every stream's "
+                     "first token)"}
+
+    def drive(spec_engine, leg):
+        """-> (per-request token lists by class, decode_s, spec stats).
+        The clock starts once EVERY stream has its first token (prompts and
+        compile are identical across legs — including prefill would dilute
+        the decode-path ratio this record exists to gate) and stops when
+        the last stream drains."""
+        eng = BatchEngine(cfg, params, n_slots=n_slots,
+                          cache_dtype=_cache_dtype(),
+                          max_prefill_chunk=pf_chunk,
+                          spec=k if spec_engine else 0,
+                          attn_impl=os.environ.get("BENCH_ATTN", "auto"))
+        sched = Scheduler(eng, chunk=chunk)
+        try:
+            # warm EVERY compiled path out of the measured window: a greedy
+            # spec request long enough to hit both fused-scan shapes (the
+            # chunk-sized launch and the tail-clamped single cycle), then a
+            # sampled spec_k=0 one so the plain decode scan compiles too
+            # (the mixed leg switches modes mid-run)
+            warm = sched.submit(rep_prompts[0], 0.0, 0.9, 2 * (k + 1),
+                                frozenset(), seed=99,
+                                spec_k=k if spec_engine else 0)
+            list(warm.tokens())
+            warm2 = sched.submit(mix_prompts[0], 0.9, 0.9, 2 * chunk,
+                                 frozenset(), seed=98, spec_k=0)
+            list(warm2.tokens())
+            sched.reset_latency_stats()
+            # engine spec totals are LIFETIME counters: snapshot after the
+            # warm requests so the recorded acceptance stats describe the
+            # measured leg only, not the warmup's high-acceptance tokens
+            spec_base = dict(getattr(eng, "_spec_totals", {}))
+            if leg == "repetitive":
+                reqs = [(sched.submit(rep_prompts[s], 0.0, 0.9, steps,
+                                      frozenset(), seed=s,
+                                      spec_k=k if spec_engine else 0),
+                         "spec")
+                        for s in range(n_slots)]
+            else:  # mixed: even slots greedy+spec, odd slots sampled spec_k=0
+                reqs = []
+                for s in range(n_slots):
+                    if s % 2 == 0:
+                        reqs.append((sched.submit(
+                            rep_prompts[s], 0.0, 0.9, steps, frozenset(),
+                            seed=s, spec_k=k if spec_engine else 0), "spec"))
+                    else:
+                        reqs.append((sched.submit(
+                            mix_prompts[s], 0.9, 0.9, steps, frozenset(),
+                            seed=1000 + s, spec_k=0), "nonspec"))
+            its = [(r.tokens(), cls, r) for r, cls in reqs]
+            heads = [(next(it), cls) for it, cls, _ in its]
+            t0 = time.perf_counter()
+            toks = {"spec": [], "nonspec": []}
+            for (it, cls, _r), (head, _) in zip(its, heads):
+                toks[cls].append([head] + list(it))
+            dt = time.perf_counter() - t0
+            stats = sched.latency_summary().get("spec")
+            if stats:
+                # warmup-corrected leg stats (see spec_base above)
+                for key in ("cycles", "drafted", "accepted", "emitted"):
+                    stats[key] -= spec_base.get(key, 0)
+                stats["tokens_per_cycle"] = (
+                    round(stats["emitted"] / stats["cycles"], 3)
+                    if stats["cycles"] else None)
+                stats["accept_mean"] = (
+                    round(stats["accepted"] / stats["drafted"], 3)
+                    if stats["drafted"] else None)
+            return toks, dt, stats
+        finally:
+            sched.shutdown()
+
+    for leg in ("repetitive", "mixed"):
+        try:
+            on_toks, on_dt, on_stats = drive(True, leg)
+            off_toks, off_dt, _ = drive(False, leg)
+            total_on = sum(len(t) for ts in on_toks.values() for t in ts)
+            total_off = sum(len(t) for ts in off_toks.values() for t in ts)
+            rec = {
+                "spec_tok_s": round(total_on / on_dt, 1),
+                "plain_tok_s": round(total_off / off_dt, 1),
+                "tok_s_ratio_spec_plain": round(
+                    (total_on / on_dt) / (total_off / off_dt), 3),
+                "exact": on_toks == off_toks,  # bit-exactness, both classes
+                "tokens_per_cycle": (on_stats or {}).get("tokens_per_cycle"),
+                "accept_mean": (on_stats or {}).get("accept_mean"),
+            }
+            if leg == "mixed":
+                ns_on = sum(len(t) for t in on_toks["nonspec"])
+                ns_off = sum(len(t) for t in off_toks["nonspec"])
+                # per-class rate: the sampled slots' share of the leg's
+                # wall time is the whole leg (they run start to finish)
+                rec["nonspec_tok_s"] = round(ns_on / on_dt, 1)
+                rec["nonspec_plain_tok_s"] = round(ns_off / off_dt, 1)
+                rec["nonspec_tok_s_ratio"] = round(
+                    (ns_on / on_dt) / (ns_off / off_dt), 3)
+                rec["nonspec_exact"] = on_toks["nonspec"] == off_toks["nonspec"]
+            out[leg] = rec
+        except Exception as e:
+            out[leg] = {"error": repr(e)[:160]}
+    return out
 
 
 def _widen_scales(params):
@@ -1571,6 +1728,20 @@ def worker():
         except Exception as e:
             radix_rec = {"error": repr(e)[:200]}
 
+    # speculative continuous batching A/B (ISSUE 11): scheduler-level
+    # spec-on vs spec-off on repetitive text + the mixed spec/non-spec leg;
+    # BENCH_SPEC_BATCH=0 skips
+    spec_batch_rec = None
+    if (sweep_on and admit_params is not None
+            and os.environ.get("BENCH_SPEC_BATCH") != "0"
+            and time.monotonic() < deadline - 120):
+        try:
+            spec_batch_rec = bench_spec_batch(
+                LlamaConfig(**PRESETS[sweep_on]), admit_params,
+                n_slots=min(4, min(s for s in slot_list) if slot_list else 4))
+        except Exception as e:
+            spec_batch_rec = {"error": repr(e)[:200]}
+
     # paged-attention route A/B: jnp gather vs the fused flash-decode
     # kernel at 2-3 page sizes (ISSUE 8); BENCH_PAGED_KERNEL=0 skips
     paged_kernel_ab = None
@@ -1627,6 +1798,7 @@ def worker():
         "paged_kernel": paged_kernel_ab,
         "radix": radix_rec,
         "slo": slo_rec,
+        "spec_batch": spec_batch_rec,
         "kb_per_token_per_chip": kb_measured if kb_measured is not None else round(kb, 1),
         "kb_per_token_source": "measured_hlo" if kb_measured is not None else "analytic",
     }

@@ -49,7 +49,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--spec", type=int, default=0, metavar="K",
                    help="prompt-lookup speculative decoding with K-token drafts "
                         "(greedy runs only — bit-identical output, fewer forwards "
-                        "on repetitive text; 0 = off)")
+                        "on repetitive text; 0 = off). In serve mode this is the "
+                        "legacy alias for --spec-k")
+    p.add_argument("--spec-k", type=int, default=None, metavar="K",
+                   help="serve mode, needs --slots > 0: per-request speculative "
+                        "decoding capacity AND default — the engine compiles a "
+                        "K-draft verify cycle, every request speculates at K "
+                        "unless its body passes its own spec_k (0..K; 0 opts "
+                        "out). Greedy token streams are BIT-IDENTICAL spec on "
+                        "or off; sampled/penalized requests ride the cycles "
+                        "one exact token at a time, so mixed traffic batches "
+                        "together. Telemetry: dllama_spec_* series, spec "
+                        "objects in timings//debug/perf (default: --spec, "
+                        "else 0 = off)")
     p.add_argument("--max-seq-len", type=int, default=None, help="clamp context length (RAM cap)")
     p.add_argument(
         "--mesh",
@@ -411,7 +423,9 @@ def cmd_serve(args) -> int:
         n_slots=args.slots,
         default_temperature=args.temperature,
         default_topp=args.topp,
-        spec=args.spec,
+        # --spec-k is the serving-tier knob; --spec remains the legacy
+        # alias (and the single-engine tier's greedy spec toggle)
+        spec=args.spec_k if args.spec_k is not None else args.spec,
         default_seed=args.seed,
         admit_stall_budget_ms=args.admit_budget_ms,
         admit_ttft_deadline_ms=args.admit_ttft_deadline_ms,

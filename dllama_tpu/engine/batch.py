@@ -24,6 +24,7 @@ the scheduling core of continuous batching. Mechanics:
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -41,6 +42,8 @@ from dllama_tpu.models.llama import KVCache, PagedKVCache, forward
 from dllama_tpu.obs import instruments as ins
 from dllama_tpu.obs import trace
 from dllama_tpu.utils import faults
+
+log = logging.getLogger("dllama_tpu.engine")
 
 
 class AdmissionAborted(RuntimeError):
@@ -106,6 +109,15 @@ class PagePool:
         # refcount == table refs + tree refs instead of flagging every
         # cached prefix page as corruption
         self.radix_refs = None
+        # write-horizon hook (BatchEngine._write_horizons): a provider of
+        # (slot, first_writable_row) pairs for ACTIVE slots, so audit()
+        # can enforce the draft-write safety invariant — every allocated
+        # block covering rows a decode or spec-verify step may write must
+        # be EXCLUSIVELY owned (refcount 1, no tree refs). Spec verify
+        # writes K+1 draft rows past the live position; a shared page in
+        # that range would leak draft garbage into a radix- or
+        # sibling-shared prefix.
+        self.write_horizons = None
         self._publish()
 
     # ----------------------------------------------------------- accounting
@@ -179,6 +191,23 @@ class PagePool:
             if len(bad) > 8:
                 problems.append(f"... and {len(bad) - 8} more refcount "
                                 "mismatches")
+            if self.write_horizons is not None:
+                # draft-write safety: blocks at/above an active slot's next
+                # write row (decode feeds one row; spec verify feeds K+1,
+                # incl. rejected drafts) must be exclusively owned —
+                # cow_writable() splits them before a dispatch, so a shared
+                # page here means a write path skipped the COW
+                for s, row in self.write_horizons():
+                    first = int(row) // self.page_size
+                    for b in range(first, int(self.n_blocks[s])):
+                        p = int(self.tables[s, b])
+                        if 0 <= p < self.n_pages and self.refcount[p] > 1:
+                            problems.append(
+                                f"active slot {s} block {b} (page {p}, "
+                                f"refcount {int(self.refcount[p])}) is "
+                                f"shared inside the writable range (row "
+                                f">= {int(row)}): decode/spec draft "
+                                "writes would leak into a shared page")
             neg = np.flatnonzero(self.refcount < 0)
             if neg.size:
                 problems.append(
@@ -320,6 +349,29 @@ class PagePool:
             self.tables[slot, b] = new
             self._publish()
 
+    def cow_writable(self, slot: int, start_row: int, end_row: int,
+                     copy_fn) -> bool:
+        """Copy-on-write every SHARED allocated block of `slot` covering
+        rows [start_row, end_row) — the pre-dispatch guarantee behind the
+        audit's write-horizon invariant: a decode chunk writes one row per
+        step and a spec verify writes K+1 draft rows past the live
+        position, and none of those writes may land in a page another slot
+        or the radix tree still references. By construction (admission
+        COW + fresh grow pages + full-page-only prefix shares) the range
+        is normally exclusive already; this is the enforcement point that
+        keeps it so under every composition. Returns True when any page
+        was split (block tables changed — callers must refresh the device
+        copy)."""
+        with self._mu:
+            first = int(start_row) // self.page_size
+            last = min(self.blocks_for(end_row), int(self.n_blocks[slot]))
+            changed = False
+            for b in range(first, last):
+                if self.refcount[int(self.tables[slot, b])] > 1:
+                    self.ensure_writable(slot, b * self.page_size, copy_fn)
+                    changed = True
+            return changed
+
     def share_prefix(self, src: int, dst: int, rows: int, copy_fn) -> None:
         """Make dst's first `rows` rows alias src's pages: full pages are
         refcounted (zero copy), a partial boundary page is cloned into a
@@ -444,6 +496,23 @@ class DecodeChunk:
     # (same clock as DECODE_CHUNK_SECONDS: starts at the later of this
     # chunk's dispatch and the previous chunk's consumption) — what the
     # roofline-attainment gauge divides priced HBM bytes by
+    spec: bool = False  # this chunk is a fused spec chunk of `n` verify
+    # cycles: `toks` is the stacked per-cycle emit tensor [n, B, K+1]
+    # (decode_consume flattens each slot's accepted runs into the plain
+    # [rows, B] layout), `advance` holds a HOST LOWER BOUND at dispatch
+    # (emit counts are data-dependent) and is overwritten with the real
+    # per-slot totals when decode_consume materializes `adv_dev`
+    adv_dev: jax.Array | None = None  # i32[m, B] real per-cycle emitted
+    # counts (spec); decode_consume sums them into `advance`
+    adv_cycles: np.ndarray | None = None  # host copy of adv_dev after
+    # consumption — the scheduler's per-request participation record
+    start_dev: jax.Array | None = None  # i32[B] the cycle's TRUE start
+    # positions (the device pos carry captured at dispatch — under the
+    # overlapped pipeline the host mirror may lag the in-flight
+    # predecessor); decode_consume overwrites start_pos with it
+    drafted_dev: jax.Array | None = None  # i32[B] draft tokens verified per
+    # row this cycle (0 for sampled/non-spec/frozen rows) — the acceptance
+    # telemetry's denominator, materialized alongside adv_dev at consume
 
     def nonfinite(self) -> np.ndarray | None:
         """bool[B] rows whose logits went non-finite during this chunk
@@ -536,6 +605,7 @@ class BatchEngine:
             max_blocks = self.seq_len // self.page_size
             n_pages = int(kv_pages) or max_blocks * n_slots
             self.pool = PagePool(n_pages, self.page_size, n_slots, max_blocks)
+            self.pool.write_horizons = self._write_horizons
             self.cache = PagedKVCache.create(
                 cfg, n_slots, n_pages, self.page_size, cache_dtype, max_blocks)
         else:
@@ -564,6 +634,12 @@ class BatchEngine:
         self.last_token = np.zeros(n_slots, np.int32)
         self.temperature = np.zeros(n_slots, np.float32)
         self.topp = np.full(n_slots, 0.9, np.float32)
+        # per-request speculation (ISSUE 11): each slot carries its OWN
+        # draft length, set at add_commit from the request's spec_k (clamped
+        # to the engine's compile-time K). 0 = the slot rides spec cycles as
+        # a plain one-token-per-forward row (sampled rows always do), so
+        # mixed spec/non-spec traffic batches together without freezing.
+        self.spec_k_slot = np.zeros(n_slots, np.int32)
         # OpenAI repetition penalties, per slot; counts ([B, V] sampled-token
         # occurrences) allocate lazily on the first penalized request
         self.presence = np.zeros(n_slots, np.float32)
@@ -598,12 +674,21 @@ class BatchEngine:
         self._vec_dirty = True
         self._last_dev = jnp.zeros(n_slots, jnp.int32)
         self._keys_dev = jnp.asarray(self.keys.copy())
-        self._pos_dev = None
+        # pos is DEVICE-authoritative like last_token/keys (since ISSUE 11):
+        # a speculative cycle advances it by a data-dependent count the host
+        # cannot mirror until consumption, so under the overlapped pipeline
+        # a bulk host re-upload could clobber an in-flight cycle's carry.
+        # Host mutation sites (admission/commit/release/copy/map) write
+        # their slot's row surgically instead; the host `self.pos` stays
+        # the scheduler-facing mirror (exact at boundaries, arithmetically
+        # advanced for plain chunks, fixed up at spec consumption).
+        self._pos_dev = jnp.zeros(n_slots, jnp.int32)
         self._active_dev = None
         self._temps_dev = None
         self._topp_dev = None
         self._pres_dev = None
         self._freq_dev = None
+        self._speck_dev = None  # i32[B] per-slot draft length (spec_k_slot)
         self._limit_dev = None  # i32[B] per-slot decode row limit: seq_len
         # on dense, min(seq_len, allocated pages * page_size) on paged —
         # the scans freeze rows at it exactly like the old seq_len edge
@@ -673,18 +758,49 @@ class BatchEngine:
 
         # batched speculative decoding (see spec_step): per-slot on-device
         # token history feeds the n-gram proposer; one verify forward per
-        # cycle serves every slot
+        # cycle serves every slot. `spec` is the COMPILE-TIME draft width K
+        # (the verify forward is K+1 wide); each slot's effective draft
+        # length is its own spec_k_slot row, clamped to K — so one compile
+        # serves per-request speculation.
         self.spec_k = int(spec)
+        # cumulative acceptance accounting (spec_stats): fed by
+        # decode_consume for spec chunks, mirrors the dllama_spec_* series
+        self._spec_totals = {"cycles": 0, "drafted": 0, "accepted": 0,
+                             "emitted": 0}
+        # dispatched-but-unconsumed spec chunks (0 or 1 under the
+        # depth-one pipeline): while nonzero the host pos mirror lags the
+        # device carry, so the next dispatch's page top-up covers the
+        # in-flight rows too
+        self._spec_inflight = 0
         if self.spec_k:
             if shardings is not None and shardings.mesh.shape["dp"] > 1:
                 # history rows are slot-indexed on the host admission path;
                 # a dp mesh shards the slot axis
                 raise ValueError("spec batching supports unsharded/tp engines")
+            cap = sel.fused_scatter_max_t
+            if cap is not None and self.spec_k + 1 > cap:
+                # routing note, not an error: verify forwards wider than
+                # the paged kernel's fused-scatter cap pre-scatter their
+                # new KV rows via one XLA scatter per layer per cycle —
+                # identical results, one extra dispatch per layer
+                log.info(
+                    "spec_k=%d verify chunks (t=%d) exceed the paged "
+                    "kernel's fused-scatter cap (%d rows); new-KV rows "
+                    "pre-scatter via XLA per layer", self.spec_k,
+                    self.spec_k + 1, cap)
             self.history = jnp.full((n_slots, self.seq_len + 1), -1, jnp.int32)
             self._spec_step = jax.jit(
                 partial(self._spec_step_impl, cfg, attn_fn, self._col_fn, mm,
                         mm_in, moe_impl, self.spec_k, spec_ngram),
-                donate_argnums=(1, 2),
+                static_argnums=(12,), donate_argnums=(1, 2),
+            )
+            # penalized traffic rides its own jit (counts in the cycle
+            # carry) so penalty-free serving pays nothing — same split as
+            # _decode vs _decode_pen
+            self._spec_step_pen = jax.jit(
+                partial(self._spec_step_pen_impl, cfg, attn_fn, self._col_fn,
+                        mm, mm_in, moe_impl, self.spec_k, spec_ngram),
+                static_argnums=(15,), donate_argnums=(1, 2, 12),
             )
             self._hist_write = jax.jit(self._hist_write_impl, donate_argnums=(0,))
 
@@ -834,42 +950,70 @@ class BatchEngine:
         return toks, cache, keys, pos2, last[:, 0], counts, bad
 
     @staticmethod
-    def _spec_step_impl(cfg, attn_fn, col_fn, mm, mm_in, moe_impl, k, ngram,
-                        params, cache, history, cur, pos_vec, active, keys,
-                        temps, topps, rope):
-        """One batched propose/verify cycle (engine/speculative.py, lifted to
-        per-slot vectors). Greedy slots (temperature == 0) draft k tokens by
-        prompt lookup over their own history row and emit the longest
-        model-agreed prefix + bonus — bit-identical to fused greedy decode —
-        while sampled slots advance exactly 1 token from their offset-0
-        logits with their own PRNG key (exact sampling semantics; the
-        (k+1)-wide forward costs them nothing extra since decode is
-        HBM-bound). Rejected drafts leave stale KV rows past each slot's live
-        position; the per-row causal mask never reads them."""
+    def _spec_cycle_core(cfg, attn_fn, col_fn, mm, mm_in, moe_impl, k, ngram,
+                         params, cache, history, cur, pos_vec, active, speck,
+                         keys, temps, topps, rope, limit, accept_mask,
+                         sample_fn):
+        """Shared body of one batched propose/verify cycle with PER-SLOT
+        draft lengths (ISSUE 11). Eligibility is resolved ON DEVICE from the
+        carried position (`eff`), so a cycle dispatched off an in-flight
+        predecessor's carry (the overlapped pipeline) freezes exactly the
+        rows whose REAL position lacks the K+1-row verify window — the
+        host's possibly-stale view only gates heuristics, never writes.
+
+        Per-slot semantics: greedy rows accept up to min(spec_k_slot, K)
+        drafts (spec_k_slot == 0 makes a greedy row a plain
+        one-token-per-forward participant, bit-identical to fused decode);
+        sampled rows advance exactly 1 token from their offset-0 logits via
+        `sample_fn` (which the penalized variant points at the
+        counts-carrying sampler). Rejected drafts leave stale KV rows past
+        each slot's live position; the per-row causal mask never reads
+        them, and the pre-dispatch `cow_writable` guarantees those writes
+        never land in a shared page."""
         from dllama_tpu.engine.speculative import propose_ngram
 
+        active = jnp.asarray(active)
+        # device-side eligibility: the verify forward writes K+1 rows for
+        # every participating slot, so participation needs K+1 backed rows
+        # below the slot's limit (context edge / allocated-page horizon)
+        eff = active & (pos_vec + k + 1 <= limit)
+        # rows that ride the argmax-sequence (draft-accepting) path; the
+        # penalized variant excludes penalized rows from it (their token
+        # must come from the PENALIZED sampler even at temperature 0)
+        accept = accept_mask & eff
+        k_eff = jnp.clip(jnp.minimum(speck, limit - pos_vec - 1), 0, k)
+        k_eff = jnp.where(accept, k_eff, 0)
         draft = jax.vmap(
             lambda h, ln: propose_ngram(h, ln, k, ngram)[0]
         )(history, pos_vec + 1)  # [B, k]
         toks = jnp.concatenate([cur[:, None], draft], axis=1)  # [B, k+1]
-        logits, cache = forward(cfg, params, toks, pos_vec, cache, rope, attn_fn,
-                                active=active, col_fn=col_fn, mm=mm, mm_in=mm_in,
+        # frozen rows still flow through the forward (masked writes); clamp
+        # their rope/cache indexing so the whole K+1 window stays in range
+        p_clamped = jnp.minimum(pos_vec, jnp.maximum(limit - (k + 1), 0))
+        logits, cache = forward(cfg, params, toks, p_clamped, cache, rope,
+                                attn_fn, active=eff, col_fn=col_fn,
+                                mm=mm, mm_in=mm_in,
                                 moe_impl=moe_impl, last_only=False)
         g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, k+1]
         agree = jnp.cumprod((draft == g[:, :k]).astype(jnp.int32), axis=1)
-        a = jnp.sum(agree, axis=1)  # [B] accepted draft prefix length
+        # accepted draft prefix, clamped to the slot's OWN draft length —
+        # a spec_k_slot=0 greedy row emits exactly its bonus token g[0]
+        a = jnp.minimum(jnp.sum(agree, axis=1), k_eff)
 
-        greedy_slot = temps == 0.0
+        # NaN guard (device half, mirrors the decode scans): any
+        # non-finite logit of a PARTICIPATING row flags it for the
+        # scheduler's per-request failure path
+        bad = eff & ~jnp.isfinite(logits).all(axis=(1, 2))
+
         splits = jax.vmap(jax.random.split)(keys)
         keys_next, subs = splits[:, 0], splits[:, 1]
-        samp = _sample_rows(logits[:, 0], subs, temps, topps)  # [B]
-        a = jnp.where(greedy_slot, a, 0)
+        samp, extras = sample_fn(logits, subs, cur, eff)  # [B]
         # only slots that actually consumed a sample advance their key:
-        # greedy slots never touch theirs, and a frozen slot (inactive this
-        # cycle — e.g. near seq_len) must keep its seed-pinned stream intact
-        # for the decode() that finishes it
-        keys = jnp.where((greedy_slot | ~active)[:, None], keys, keys_next)
-        emit = jnp.where(greedy_slot[:, None], g,
+        # argmax-path rows never touch theirs, and a frozen slot
+        # (ineligible this cycle — e.g. near seq_len) must keep its
+        # seed-pinned stream intact for the cycle/chunk that finishes it
+        keys = jnp.where((accept | ~eff)[:, None], keys, keys_next)
+        emit = jnp.where(accept[:, None], g,
                          jnp.concatenate([samp[:, None], g[:, 1:]], axis=1))
 
         # the emitted tokens are ALSO the history entries at pos+1..pos+k+1
@@ -878,15 +1022,104 @@ class BatchEngine:
         hist2 = jax.vmap(
             lambda h, e, p: jax.lax.dynamic_update_slice(h, e, (p,))
         )(history, emit, pos_vec + 1)
-        history = jnp.where(active[:, None], hist2, history)
+        history = jnp.where(eff[:, None], hist2, history)
 
-        adv = jnp.where(active, a + 1, 0)  # tokens each slot emitted
+        adv = jnp.where(eff, a + 1, 0)  # tokens each slot emitted
         nxt = jnp.take_along_axis(emit, a[:, None], axis=1)[:, 0]
-        nxt = jnp.where(active, nxt, cur)
+        nxt = jnp.where(eff, nxt, cur)
+        drafted = jnp.where(eff, k_eff, 0)  # telemetry: drafts verified
         # pos_vec + adv keeps the device-resident position carry current
-        # without a host round-trip (spec_step threads it chunk-to-chunk
+        # without a host round-trip (the cycle threads it chunk-to-chunk
         # like decode does)
-        return emit, adv, nxt, cache, history, keys, pos_vec + adv
+        return (emit, adv, nxt, cache, history, keys, pos_vec + adv,
+                drafted, bad, extras)
+
+    @classmethod
+    def _spec_step_impl(cls, cfg, attn_fn, col_fn, mm, mm_in, moe_impl, k,
+                        ngram, params, cache, history, cur, pos_vec, active,
+                        speck, keys, temps, topps, rope, limit, m):
+        """Penalty-free fused spec chunk: m verify cycles in ONE
+        lax.scan'd dispatch (see _spec_cycle_core for one cycle's
+        semantics) — the speculation analog of the fused n-step decode
+        scan, so a spec chunk amortizes host dispatch overhead exactly
+        like a decode chunk does. Greedy rows ride the argmax-sequence
+        path cycle after cycle; sampled rows take one exactly-sampled
+        token per cycle from their offset-0 logits. Returns stacked
+        per-cycle (emit [m, B, k+1], adv [m, B], drafted [m, B]) plus the
+        threaded carry; `bad` is sticky across the chunk like the decode
+        scans' NaN flag."""
+        greedy = temps == 0.0
+
+        def body(carry, _):
+            cache, history, cur, pos, keys, bad = carry
+
+            def sample_fn(logits, subs, cur, eff):
+                return _sample_rows(logits[:, 0], subs, temps, topps), None
+
+            (emit, adv, nxt, cache, history, keys, pos2, drafted, bad1,
+             _extras) = cls._spec_cycle_core(
+                cfg, attn_fn, col_fn, mm, mm_in, moe_impl, k, ngram, params,
+                cache, history, cur, pos, active, speck, keys, temps, topps,
+                rope, limit, greedy, sample_fn)
+            return ((cache, history, nxt, pos2, keys, bad | bad1),
+                    (emit, adv, drafted))
+
+        bad0 = jnp.zeros(cur.shape[0], bool)
+        (cache, history, nxt, pos2, keys, bad), (emits, advs, drafts) = \
+            jax.lax.scan(body, (cache, history, cur, pos_vec, keys, bad0),
+                         None, length=m)
+        return emits, advs, nxt, cache, history, keys, pos2, drafts, bad
+
+    @classmethod
+    def _spec_step_pen_impl(cls, cfg, attn_fn, col_fn, mm, mm_in, moe_impl,
+                            k, ngram, params, cache, history, cur, pos_vec,
+                            active, speck, keys, temps, topps, rope, limit,
+                            counts, presence, frequency, m):
+        """Fused spec chunk with OpenAI repetition penalties in the scan
+        carry: a penalized row (which can never accept drafts — acceptance
+        compares raw argmax, penalized sampling needs the counts) advances
+        exactly 1 token per cycle from its PENALIZED offset-0 logits, with
+        its fed token counted first — bit-identical to the penalized
+        decode scan's steps, so penalized traffic rides spec chunks
+        instead of freezing behind the old _spec_tick alternation. Rows
+        without penalties pay `logits - 0.0` (bitwise identity), the same
+        mixed-batch contract the penalized decode scan already has; a
+        penalized GREEDY row is excluded from the argmax path so its token
+        comes from the penalized sampler (temperature 0 = penalized
+        argmax)."""
+        from dllama_tpu.engine.sampling import apply_penalties
+
+        b = cur.shape[0]
+        pen = (presence != 0.0) | (frequency != 0.0)
+        accept_mask = (temps == 0.0) & ~pen
+
+        def body(carry, _):
+            cache, history, cur, pos, keys, bad, counts = carry
+
+            def sample_fn(logits, subs, cur, eff):
+                # fed token counted for participating rows before its
+                # successor is sampled (ordering matches the decode scan)
+                cnt = counts.at[jnp.arange(b), cur].add(eff.astype(jnp.int32))
+                penalized = apply_penalties(logits[:, 0], cnt, presence,
+                                            frequency)
+                return _sample_rows(penalized, subs, temps, topps), cnt
+
+            (emit, adv, nxt, cache, history, keys, pos2, drafted, bad1,
+             cnt) = cls._spec_cycle_core(
+                cfg, attn_fn, col_fn, mm, mm_in, moe_impl, k, ngram, params,
+                cache, history, cur, pos, active, speck, keys, temps, topps,
+                rope, limit, accept_mask, sample_fn)
+            return ((cache, history, nxt, pos2, keys, bad | bad1, cnt),
+                    (emit, adv, drafted))
+
+        bad0 = jnp.zeros(b, bool)
+        (cache, history, nxt, pos2, keys, bad, counts), (emits, advs,
+                                                         drafts) = \
+            jax.lax.scan(body,
+                         (cache, history, cur, pos_vec, keys, bad0, counts),
+                         None, length=m)
+        return (emits, advs, nxt, cache, history, keys, pos2, drafts, bad,
+                counts)
 
     @staticmethod
     def _hist_write_impl(history, slot, pos, toks):
@@ -962,15 +1195,30 @@ class BatchEngine:
         """Paged: best-effort top-up before a decode/spec dispatch — extend
         each active slot's table to cover n more rows (clamped at seq_len).
         Slots the pool cannot serve keep their current limit and freeze
-        per-row in the scan; pages freed by later releases un-freeze them."""
+        per-row in the scan; pages freed by later releases un-freeze them.
+
+        Also the draft-write COW gate: any SHARED allocated page covering
+        the slot's writable rows [pos, pos+n) is copy-on-written first, so
+        neither a decode row nor a spec cycle's k+1 draft rows (rejected
+        drafts included) can ever land in a page the radix tree or a
+        sibling slot still references — the invariant PagePool.audit()'s
+        write-horizon check enforces."""
         if self.pool is None:
             return
         changed = False
         for s in np.flatnonzero(self.active):
             want = min(self.seq_len, int(self.pos[s]) + n)
             changed |= self.pool.grow(int(s), want, best_effort=True)
+            changed |= self.pool.cow_writable(int(s), int(self.pos[s]), want,
+                                              self._pool_page_copy)
         if changed:
             self._vec_dirty = True
+
+    def _write_horizons(self) -> list[tuple[int, int]]:
+        """PagePool.audit() provider: (slot, first_writable_row) for every
+        active slot — rows at/above it may be written by the next decode
+        chunk or spec verify cycle, so their pages must be exclusive."""
+        return [(int(s), int(self.pos[s])) for s in np.flatnonzero(self.active)]
 
     def page_starved(self) -> np.ndarray:
         """bool[B]: active slots whose next decode row has no backing page
@@ -1008,6 +1256,7 @@ class BatchEngine:
             return 0
         freed = self.pool.free_tail(slot, 0)
         self.pos[slot] = 0
+        self._pos_dev = self._pos_dev.at[slot].set(0)
         self._vec_dirty = True
         return freed
 
@@ -1041,6 +1290,7 @@ class BatchEngine:
             pages.append(hit.boundary)
         self.pool.adopt_prefix(slot, pages)
         self.pos[slot] = hit.rows
+        self._pos_dev = self._pos_dev.at[slot].set(int(hit.rows))
         if self.spec_k and hit.rows:
             # the mapped prefix's token ids feed the n-gram proposer, same
             # as the cross-slot copy path did
@@ -1129,6 +1379,7 @@ class BatchEngine:
             self.pool = PagePool(self.pool.n_pages, self.page_size,
                                  self.n_slots, max_blocks)
             self.pool.audit_on_release = audit_flag
+            self.pool.write_horizons = self._write_horizons
             self.cache = PagedKVCache.create(
                 self.cfg, self.n_slots, self.pool.n_pages, self.page_size,
                 self.cache_dtype, max_blocks)
@@ -1151,9 +1402,12 @@ class BatchEngine:
         self.topp[:] = 0.9
         self.presence[:] = 0.0
         self.frequency[:] = 0.0
+        self.spec_k_slot[:] = 0
         self._counts = None
         self._last_dev = jnp.zeros(self.n_slots, jnp.int32)
         self._keys_dev = jnp.asarray(self.keys.copy())
+        self._pos_dev = jnp.zeros(self.n_slots, jnp.int32)
+        self._spec_inflight = 0  # any unconsumed chunk died with the crash
         self._t_last_consume = None
         if self.spec_k:
             self.history = jnp.full((self.n_slots, self.seq_len + 1), -1,
@@ -1189,6 +1443,7 @@ class BatchEngine:
                 self.history, jnp.int32(src_slot), jnp.int32(dst_slot),
                 jnp.int32(rows))
         self.pos[dst_slot] = rows
+        self._pos_dev = self._pos_dev.at[dst_slot].set(int(rows))
         self._vec_dirty = True
 
     # ------------------------------------------------------------------- api
@@ -1220,6 +1475,7 @@ class BatchEngine:
             self.pool.prepare_admission(slot, start_pos, start_pos + n,
                                         self._pool_page_copy)
         self.pos[slot] = start_pos
+        self._pos_dev = self._pos_dev.at[slot].set(int(start_pos))
         self._vec_dirty = True
         return Admission(slot=slot, toks=np.asarray(prompt_tokens, np.int32),
                          req_id=req_id)
@@ -1285,9 +1541,13 @@ class BatchEngine:
 
     def add_commit(self, adm: "Admission", temperature: float = 0.8,
                    topp: float = 0.9, seed: int | None = None,
-                   presence: float = 0.0, frequency: float = 0.0) -> int:
+                   presence: float = 0.0, frequency: float = 0.0,
+                   spec_k: int | None = None) -> int:
         """Sample the first token from the finished admission and activate
-        the slot. Must follow add_step returning True."""
+        the slot. Must follow add_step returning True. `spec_k` is the
+        slot's PER-REQUEST draft length for batched speculation (clamped to
+        the engine's compile-time K; None keeps the engine default — the
+        pre-ISSUE-11 engine-global behavior; 0 opts this slot out)."""
         assert adm.off >= len(adm.toks) and adm.logits is not None, "admission not pumped"
         slot = adm.slot
         if seed is not None:
@@ -1308,12 +1568,16 @@ class BatchEngine:
         self.presence[slot] = presence
         self.frequency[slot] = frequency
         # device carry: the host-auth vectors re-upload at the next dispatch,
-        # but last_token/keys are device-authoritative (the scan mutates them
-        # with values the host can't mirror mid-flight), so the commit writes
-        # just this slot's rows in place — other slots' carries stay intact
+        # but last_token/keys/pos are device-authoritative (the scans mutate
+        # them with values the host can't mirror mid-flight), so the commit
+        # writes just this slot's rows in place — other slots' carries stay
+        # intact
         self._vec_dirty = True
         self._last_dev = self._last_dev.at[slot].set(first)
         self._keys_dev = self._keys_dev.at[slot].set(key)
+        self._pos_dev = self._pos_dev.at[slot].set(int(self.pos[slot]))
+        self.spec_k_slot[slot] = (min(int(spec_k), self.spec_k)
+                                  if spec_k is not None else self.spec_k)
         if presence or frequency:
             if self._counts is None:
                 self._counts = jnp.zeros((self.n_slots, self.cfg.vocab_size),
@@ -1334,7 +1598,7 @@ class BatchEngine:
     def resume_commit(self, adm: "Admission", last_token: int, key,
                       temperature: float = 0.8, topp: float = 0.9,
                       presence: float = 0.0, frequency: float = 0.0,
-                      counted=None) -> None:
+                      counted=None, spec_k: int | None = None) -> None:
         """Activate a slot from warm-restart recovery. The admission
         re-prefilled prompt + already-emitted tokens EXCEPT the last one
         (a sampled token's KV row only exists once it is fed back); this
@@ -1355,6 +1619,9 @@ class BatchEngine:
         self._vec_dirty = True
         self._last_dev = self._last_dev.at[slot].set(int(last_token))
         self._keys_dev = self._keys_dev.at[slot].set(jnp.asarray(self.keys[slot]))
+        self._pos_dev = self._pos_dev.at[slot].set(int(self.pos[slot]))
+        self.spec_k_slot[slot] = (min(int(spec_k), self.spec_k)
+                                  if spec_k is not None else self.spec_k)
         if presence or frequency:
             if self._counts is None:
                 self._counts = jnp.zeros((self.n_slots, self.cfg.vocab_size),
@@ -1408,12 +1675,17 @@ class BatchEngine:
         aliasing would turn that into a read/write race."""
         if not self._vec_dirty:
             return
-        self._pos_dev = jnp.asarray(self.pos.copy(), jnp.int32)
+        # NOTE pos is NOT uploaded here: like last_token/keys it is
+        # device-authoritative (spec cycles advance it by data-dependent
+        # counts), so host mutation sites write their slot's _pos_dev row
+        # surgically instead — a bulk upload could clobber the carry of an
+        # in-flight overlapped spec cycle
         self._active_dev = jnp.asarray(self.active.copy())
         self._temps_dev = jnp.asarray(self.temperature.copy())
         self._topp_dev = jnp.asarray(self.topp.copy())
         self._pres_dev = jnp.asarray(self.presence.copy())
         self._freq_dev = jnp.asarray(self.frequency.copy())
+        self._speck_dev = jnp.asarray(self.spec_k_slot.copy())
         self._limit_dev = jnp.asarray(self._row_limit())
         if self.pool is not None:
             # block tables are host-authoritative like pos/active: refresh the
@@ -1424,7 +1696,7 @@ class BatchEngine:
                 jnp.asarray(self.pool.tables.copy(), jnp.int32))
         self._vec_dirty = False
 
-    def decode_dispatch(self, n: int) -> DecodeChunk:
+    def decode_dispatch(self, n: int, spec: bool = False) -> DecodeChunk:
         """Dispatch one fused n-step decode chunk WITHOUT waiting for its
         tokens. The jitted scan threads the device-resident carry (cache,
         last_token, pos, PRNG keys) to itself, so in steady state this
@@ -1432,11 +1704,29 @@ class BatchEngine:
         is async) — the caller overlaps host scheduling work with the
         chunk's device compute and blocks only in decode_consume.
 
+        ``spec=True`` dispatches a fused spec CHUNK of n verify cycles in
+        one lax.scan'd launch instead (ISSUE 11): the returned chunk's
+        `toks` is the stacked per-cycle emit tensor [n, B, K+1] and its
+        per-slot counts materialize at decode_consume (which flattens the
+        accepted runs to the plain [rows, B] layout) — so the serving
+        scheduler's overlapped pipeline composes with speculation (chunk
+        N+1's propose/verify launches off chunk N's device carry). A
+        successor dispatched off an in-flight spec chunk must itself be
+        spec (the host position mirror lags the data-dependent advance
+        until consumption; the scheduler drains the pipeline on mode
+        switches).
+
         Slots whose cache fills mid-chunk freeze per-row at seq_len (token
         repeats, no advance) instead of clamping the whole batch's chunk to
         the fullest slot's room; `DecodeChunk.advance` records each slot's
         true row count. Raises only when no active slot has any room."""
         faults.fire("engine.decode")
+        if spec:
+            if not self.spec_k:
+                raise ValueError("engine built with spec=0")
+            if not self.active.any():
+                raise ValueError("no active slots")
+            return self._spec_dispatch(max(1, int(n)))
         if not self.active.any():
             raise ValueError("no active slots")
         self._alloc_decode_rows(n)
@@ -1503,11 +1793,88 @@ class BatchEngine:
                            advance=advance, t0=t0, seq=self.chunk_seq,
                            t_disp=t_disp, bad=bad, bad_inject=bad_inject)
 
+    def _spec_dispatch(self, n_cycles: int) -> DecodeChunk:
+        """Dispatch one fused spec CHUNK (decode_dispatch's spec=True
+        body): n_cycles propose/verify cycles in a single lax.scan'd
+        launch — the speculation analog of the fused n-step decode chunk,
+        amortizing host dispatch overhead identically — and return WITHOUT
+        waiting: the emitted tokens and per-slot counts are data-dependent
+        device values that materialize in decode_consume. Eligibility,
+        per-slot draft clamps, and the write mask are all resolved on
+        device from the carried position EVERY cycle, so a chunk pipelined
+        off an in-flight predecessor stays exact even though the host
+        mirrors lag it."""
+        k = self.spec_k
+        # page top-up + shared-page COW for this chunk — doubled ONLY when
+        # a predecessor spec chunk is still unconsumed (then the host pos
+        # mirror lags the device carry by up to its rows; an under-backed
+        # row merely freezes per-row on device, this keeps that the rare
+        # case). Boundary/lockstep dispatches have an exact mirror and
+        # must not double the pool pressure.
+        lag = 2 if self._spec_inflight else 1
+        self._alloc_decode_rows(lag * n_cycles * (k + 1))
+        if not self.spec_eligible().any():
+            raise ValueError(
+                "no active slot is spec-eligible (needs room for K+1 "
+                "rows); use decode() or release the full slots")
+        self._sync_vectors()
+        start_dev = self._pos_dev
+        t0 = time.perf_counter()
+        t_disp = time.monotonic()
+        args = (
+            self.params, self.cache, self.history,
+            self._last_dev,
+            self._pos_dev,
+            self._active_dev,
+            self._speck_dev,
+            self._keys_dev,
+            self._temps_dev,
+            self._topp_dev,
+            self.rope_cache,
+            self._limit_dev,
+        )
+        if self._counts is not None and (
+            (self.presence[self.active] != 0).any()
+            or (self.frequency[self.active] != 0).any()
+        ):
+            (emits, advs, nxt, self.cache, self.history, self._keys_dev,
+             self._pos_dev, drafts, bad, self._counts) = self._spec_step_pen(
+                *args, self._counts, self._pres_dev, self._freq_dev, n_cycles)
+        else:
+            (emits, advs, nxt, self.cache, self.history, self._keys_dev,
+             self._pos_dev, drafts, bad) = self._spec_step(*args, n_cycles)
+        self._last_dev = nxt
+        self._spec_inflight += 1
+        active = self.active.copy()
+        bad_inject = None
+        if faults.flag("decode.nan"):
+            bad_inject = np.zeros(self.n_slots, bool)
+            bad_inject[int(np.flatnonzero(active)[0])] = True
+        self.chunk_seq += 1
+        # start_pos/advance are host ESTIMATES until consumption (the chunk
+        # in flight below us decides the truth): advance's lower bound — one
+        # bonus token per active row — feeds the scheduler's conservative
+        # budget check, and both are overwritten in decode_consume
+        return DecodeChunk(toks=emits, n=n_cycles,
+                           start_pos=self.pos.copy(), active=active,
+                           advance=np.where(active, 1, 0).astype(np.int32),
+                           t0=t0, seq=self.chunk_seq, t_disp=t_disp, bad=bad,
+                           bad_inject=bad_inject, spec=True, adv_dev=advs,
+                           drafted_dev=drafts, start_dev=start_dev)
+
     def decode_consume(self, chunk: DecodeChunk) -> np.ndarray:
         """Block until the chunk's tokens are on host; fold them into the
         host mirrors and the chunk-timing metrics. Returns tokens [n, B]
         (frozen/mid-chunk-frozen slots repeat their last token — callers use
-        chunk.advance for per-slot counts)."""
+        chunk.advance for per-slot counts).
+
+        Spec chunks (decode_dispatch(spec=True)) additionally materialize
+        their data-dependent per-slot counts here: `chunk.advance` and
+        `chunk.start_pos` are overwritten with the real values, the host
+        pos/last_token mirrors are fixed up (slots released while the cycle
+        was in flight keep their rewound state — their rows here are the
+        one-chunk stop overrun), and the acceptance telemetry
+        (dllama_spec_* series) is recorded."""
         toks = np.asarray(chunk.toks)
         # the transfer above is the device sync: observing here (not at
         # dispatch) keeps DECODE_CHUNK_SECONDS device-real under overlapped
@@ -1521,8 +1888,65 @@ class BatchEngine:
         ins.DECODE_CHUNK_SECONDS.observe(now - start)
         chunk.device_s = now - start  # the roofline gauge's denominator
         self._t_last_consume = now
-        ins.BATCH_OCCUPANCY.observe(int(chunk.active.sum()))
         tr = trace.TRACER
+        if chunk.spec:
+            # toks here is the stacked per-cycle emit [m, B, k+1]; flatten
+            # each slot's accepted runs (cycle-major) into the same
+            # [rows, B] layout a decode chunk returns, so the scheduler's
+            # emit loop serves both chunk kinds unchanged
+            self._spec_inflight = max(0, self._spec_inflight - 1)
+            emits = toks
+            advs = np.asarray(chunk.adv_dev).astype(np.int32)  # [m, B]
+            drafted = np.asarray(chunk.drafted_dev).astype(np.int32)
+            total = advs.sum(axis=0).astype(np.int32)  # [B]
+            chunk.advance = total
+            chunk.adv_cycles = advs
+            chunk.start_pos = np.asarray(chunk.start_dev).astype(np.int32)
+            m_cycles, b = advs.shape
+            # flatten each slot's accepted runs (cycle-major) with one
+            # boolean-mask gather per emitting slot — C-speed, not an
+            # O(cycles x slots) Python concat loop on the consume hot path
+            keep = (np.arange(emits.shape[2])[None, None, :]
+                    < advs[:, :, None])  # [m, B, k+1]
+            out = np.zeros((max(1, int(total.max(initial=0))), b), np.int32)
+            for s in np.flatnonzero(total):
+                out[: total[s], s] = emits[:, s, :][keep[:, s, :]]
+            # host mirror fixup: the chunk's advance was data-dependent, so
+            # the mirrors could not move at dispatch. Slots released while
+            # it was in flight (EOS found consuming the predecessor) keep
+            # their rewound pos — their rows here are discarded overrun.
+            upd = chunk.active & self.active
+            self.pos[upd] = chunk.start_pos[upd] + total[upd]
+            emitted = np.flatnonzero(upd & (total > 0))
+            if emitted.size:
+                self.last_token[emitted] = out[total[emitted] - 1, emitted]
+            # acceptance telemetry, single-site: every consumed verify
+            # cycle lands in the dllama_spec_* series AND the engine totals
+            acc = advs - 1
+            msk = drafted > 0
+            n_drafted, n_acc = int(drafted.sum()), int(acc[msk].sum())
+            n_emit = int(total.sum())
+            self._spec_totals["cycles"] += m_cycles
+            self._spec_totals["drafted"] += n_drafted
+            self._spec_totals["accepted"] += n_acc
+            self._spec_totals["emitted"] += n_emit
+            ins.SPEC_CYCLES.inc(m_cycles)
+            ins.SPEC_TOKENS.labels(kind="drafted").inc(n_drafted)
+            ins.SPEC_TOKENS.labels(kind="accepted").inc(n_acc)
+            ins.SPEC_TOKENS.labels(kind="emitted").inc(n_emit)
+            # one bulk histogram update per distinct accepted length, not a
+            # Python observe() per (cycle, row) sample
+            for val, cnt in enumerate(np.bincount(acc[msk])):
+                ins.SPEC_ACCEPTED_LENGTH.observe_n(val, int(cnt))
+            ins.BATCH_OCCUPANCY.observe(int((total > 0).sum()))
+            if tr.enabled:
+                tr.span_at("decode.spec", chunk.t_disp, tr.now(),
+                           cat="decode", track="device", chunk=chunk.seq,
+                           cycles=m_cycles,
+                           occupancy=int((total > 0).sum()),
+                           emitted=n_emit, accepted=n_acc)
+            return out
+        ins.BATCH_OCCUPANCY.observe(int(chunk.active.sum()))
         if tr.enabled:
             # the chunk's device-side window: dispatch -> tokens on host.
             # Under the overlapped pipeline this span brackets the NEXT
@@ -1542,77 +1966,69 @@ class BatchEngine:
         return self.decode_consume(self.decode_dispatch(n))
 
     def spec_eligible(self) -> np.ndarray:
-        """bool[B]: slots the next spec_step cycle will serve rather than
-        freeze — active, K+1 rows of cache room, no repetition penalties.
-        THE freeze rule: spec_step uses this mask verbatim, and the serving
-        scheduler keys its spec/decode alternation off it, so a new freeze
-        condition added here reaches both automatically. On the paged
-        layout "room" means BACKED rows (spec_step tops the pool up first),
-        so a dry pool freezes a slot here exactly like the context edge."""
+        """bool[B], host view: slots the next spec cycle will ADVANCE —
+        active with K+1 backed rows below their row limit. Repetition
+        penalties no longer freeze a slot (the counts-carrying
+        _spec_step_pen variant serves them a bit-exact penalized token per
+        cycle), and sampled / spec_k_slot==0 rows advance exactly 1 token
+        per cycle — only rows at the context edge or an exhausted page
+        pool freeze, and the scheduler alternates plain decode chunks in
+        for exactly those. The authoritative per-row freeze is recomputed
+        ON DEVICE from the carried position inside the cycle (this host
+        view is exact at chunk boundaries, a gating heuristic while a
+        cycle is in flight)."""
         room_ok = self.pos + self.spec_k + 1 <= self._row_limit()
+        return self.active & room_ok
+
+    def spec_draft_k(self) -> np.ndarray:
+        """i32[B], host view: each slot's effective draft length for the
+        next cycle — 0 for sampled, penalized, spec_k_slot==0, and
+        ineligible rows. The serving scheduler speculates only while some
+        live slot can actually accept drafts (any entry > 0); everyone
+        else just rides the cycle one token at a time."""
         pen = (self.presence != 0) | (self.frequency != 0)
-        return self.active & room_ok & ~pen
+        return np.where(
+            self.spec_eligible() & (self.temperature == 0.0) & ~pen,
+            np.minimum(self.spec_k_slot, self.spec_k), 0).astype(np.int32)
+
+    def spec_stats(self) -> dict | None:
+        """Cumulative acceptance accounting (None when the engine was built
+        spec=0) — the host-side mirror of the dllama_spec_* series:
+        cycles/drafted/accepted/emitted plus the derived tokens-per-cycle
+        speedup and mean accepted draft length."""
+        if not self.spec_k:
+            return None
+        t = dict(self._spec_totals)
+        t["k"] = self.spec_k
+        cycles = t["cycles"]
+        t["tokens_per_cycle"] = (round(t["emitted"] / cycles, 3)
+                                 if cycles else None)
+        t["accept_mean"] = (round(t["accepted"] / t["drafted"], 3)
+                            if t["drafted"] else None)
+        return t
 
     def spec_step(self) -> tuple[np.ndarray, np.ndarray]:
-        """One speculative verify cycle across the batch: returns
+        """One speculative verify cycle across the batch, LOCKSTEP (the
+        dispatch + consume of decode_dispatch(spec=True) in place): returns
         (tokens [B, K+1], counts [B]) where each active slot emitted
-        tokens[i, :counts[i]] this cycle — 1..K+1 exact-greedy tokens for
-        temperature==0 slots, exactly 1 exactly-sampled token otherwise.
-        Costs ~one decode step (the forward is HBM-bound; K+1 rows ride the
-        same weight stream), so greedy acceptance multiplies batch tok/s.
-
-        Slots within K+1 rows of seq_len — and slots with repetition
-        penalties, whose sampling needs the counts-carrying decode path (spec
-        acceptance compares raw argmax) — are frozen for the cycle: they emit
-        nothing and their PRNG/history/pos state is untouched. Advance them
-        with decode(); a caller serving a mixed batch alternates spec cycles
-        with decode chunks so frozen slots still reach their finish. The
-        reference decodes strictly one token per forward per request
-        (dllama.cpp:69-88) and its server has no batching at all — this is
-        both lifted to the serving tier at once."""
-        faults.fire("engine.decode")  # a spec cycle IS the decode chunk
-        t0 = time.perf_counter()
-        t_disp = time.monotonic()  # trace clock for the cycle's device span
-        if not self.spec_k:
-            raise ValueError("engine built with spec=0")
-        if not self.active.any():
-            raise ValueError("no active slots")
-        self._alloc_decode_rows(self.spec_k + 1)
-        eff = self.spec_eligible()
-        if not eff.any():
-            raise ValueError("no active slot is spec-eligible (needs room for "
-                             "K+1 rows and no repetition penalties); use "
-                             "decode() or release the full slots")
-        self._sync_vectors()
-        # the eligibility mask is the one per-cycle upload left: it encodes
-        # the host-side freeze rule, so it is inherently host-born
-        (emit, adv, nxt, self.cache, self.history, self._keys_dev,
-         self._pos_dev) = self._spec_step(
-            self.params, self.cache, self.history,
-            self._last_dev,
-            self._pos_dev,
-            jnp.asarray(eff.copy()),
-            self._keys_dev,
-            self._temps_dev,
-            self._topp_dev,
-            self.rope_cache,
-        )
-        self._last_dev = nxt
-        emit, adv = np.asarray(emit), np.asarray(adv)
-        ins.DECODE_CHUNK_SECONDS.observe(time.perf_counter() - t0)
-        self._t_last_consume = time.perf_counter()
-        ins.BATCH_OCCUPANCY.observe(int(eff.sum()))
-        self.chunk_seq += 1
-        tr = trace.TRACER
-        if tr.enabled:
-            # a spec cycle is dispatched AND consumed in place (emit counts
-            # are data-dependent), so one span covers its whole device window
-            tr.span_at("decode.spec", t_disp, tr.now(), cat="decode",
-                       track="device", chunk=self.chunk_seq,
-                       occupancy=int(eff.sum()))
-        self.pos += adv
-        self.last_token = np.array(nxt)
-        return emit, adv
+        tokens[i, :counts[i]] this cycle — 1..K+1 exact-greedy tokens for a
+        temperature==0 slot up to its own spec_k_slot draft length, exactly
+        1 exactly-sampled (or penalized) token otherwise. Costs ~one decode
+        step (the forward is HBM-bound; K+1 rows ride the same weight
+        stream), so greedy acceptance multiplies batch tok/s. Only slots
+        without a K+1-row window below their limit freeze (advance them
+        with decode()); sampled, penalized, and spec_k_slot==0 slots all
+        ride the cycle one token at a time. The serving scheduler uses the
+        split dispatch/consume form directly so cycles compose with the
+        overlapped pipeline; this wrapper serves direct library callers and
+        the bench. The reference decodes strictly one token per forward per
+        request (dllama.cpp:69-88) and its server has no batching at all —
+        this is both lifted to the serving tier at once."""
+        chunk = self.decode_dispatch(1, spec=True)
+        toks = self.decode_consume(chunk)  # [rows, B], rows = max advance
+        emit = np.zeros((self.n_slots, self.spec_k + 1), np.int32)
+        emit[:, : toks.shape[0]] = toks.T
+        return emit, chunk.advance
 
     def release(self, slot: int, keep_rows: int | None = None) -> None:
         """Free a slot. keep_rows rewinds pos to the valid prefix (mid-chunk
@@ -1625,6 +2041,7 @@ class BatchEngine:
         rows are unspecified — every page goes back."""
         self.active[slot] = False
         self.presence[slot] = self.frequency[slot] = 0.0
+        self.spec_k_slot[slot] = 0
         if keep_rows is not None:
             self.pos[slot] = keep_rows
             if self.pool is not None:
@@ -1632,6 +2049,7 @@ class BatchEngine:
         elif self.pool is not None:
             self.pool.free_tail(slot, 0)
             self.pos[slot] = 0
+        self._pos_dev = self._pos_dev.at[slot].set(int(self.pos[slot]))
         if self.pool is not None and self.pool.audit_on_release:
             # DLLAMA_POOL_AUDIT=1 (armed suite-wide by tests/conftest.py):
             # any refcount/free-list corruption fails AT the release that

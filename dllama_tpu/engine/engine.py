@@ -425,7 +425,15 @@ class InferenceEngine:
         (emitted/cycles = realized speedup). Consecutive calls that continue
         exactly where the last one stopped reuse the on-device history — no
         per-chunk host rebuild (generate's chunked loop hits this path)."""
-        assert self.batch == 1, "speculative decode drives a single sequence"
+        if self.batch != 1:
+            # a clean, actionable error instead of the old bare assert: the
+            # batched serving tier has its own speculation (per-slot
+            # accept/reject vectors, per-request spec_k) — point there
+            raise ValueError(
+                f"decode_spec_greedy_n drives a single sequence (batch==1, "
+                f"got batch={self.batch}); for batched speculation use "
+                "BatchEngine(spec=K) — its spec cycles serve every slot "
+                "with per-request spec_k (serve --spec-k / body spec_k)")
         if self.pos + n > self.seq_len:
             raise ValueError(f"position {self.pos}+{n} exceeds seq_len {self.seq_len}")
         key = (k, ngram)

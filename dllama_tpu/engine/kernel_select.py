@@ -38,6 +38,12 @@ class KernelSelection:
     # 'paged_gather' — the single string obs/bench/README quote for "what
     # actually runs", and what chunk_cost_model prices (kernel vs gather
     # paged bytes differ by the whole re-materialized view)
+    fused_scatter_max_t: int | None = None  # paged_kernel route only: the
+    # widest chunk (query rows per slot) whose new-KV scatter stays fused
+    # inside the kernel launch. A speculative verify forward is spec_k+1
+    # rows wide, so engines log when their K rides the per-layer
+    # pre-scatter path instead (still correct — one XLA scatter per layer
+    # per cycle — just not the zero-extra-dispatch fused write)
 
 
 def resolve_moe_impl(moe_impl: str, shardings=None) -> str:
@@ -115,6 +121,7 @@ def resolve_kernels(
         # CAPABILITY check — dtype/head-dim/page-geometry, ANY page size —
         # not the old whole-64-row-tile gate.
         from dllama_tpu.ops.pallas.paged_attention import (
+            FUSED_SCATTER_MAX_T,
             paged_decode_attention,
             paged_decode_supported,
         )
@@ -123,6 +130,7 @@ def resolve_kernels(
 
         attn_fn = None
         route = "paged_gather"
+        fused_cap = None
         if attn_impl != "jnp" and paged_decode_supported(
             (cfg.n_heads, cfg.head_size), page_size,
             kv_dtype=cache_dtype if cache_dtype is not None else jnp.bfloat16,
@@ -135,11 +143,17 @@ def resolve_kernels(
                     interpret=interp)
 
             # models/llama._layer hands the new KV rows to the kernel
-            # instead of paying a separate scatter dispatch per layer
+            # instead of paying a separate scatter dispatch per layer; the
+            # fused write serves chunks up to FUSED_SCATTER_MAX_T rows —
+            # decode (t=1) and spec verify (t=spec_k+1) both ride it as
+            # long as spec_k+1 fits (wider verifies pre-scatter via XLA,
+            # identical results)
             attn_fn.fused_kv_scatter = True
             route = "paged_kernel"
+            fused_cap = FUSED_SCATTER_MAX_T
         return KernelSelection(mm=mm, mm_in=mm_in, attn_fn=attn_fn,
-                               backend=backend, attn_route=route)
+                               backend=backend, attn_route=route,
+                               fused_scatter_max_t=fused_cap)
 
     attn_fn = shardings.attn_fn(batch) if shardings is not None else None
     route = "ring" if attn_fn is not None else "jnp"

@@ -25,6 +25,14 @@ Rejected drafts leave stale KV rows past the live position; attention masks
 rows ``> pos`` so they are never read and are overwritten when those
 positions are really decoded — the same invariant behind the engine's
 mid-chunk rewind (engine.generate).
+
+This module is the single-sequence (batch=1) tier. The SERVING tier lifts
+the same propose/verify scheme into continuous batching
+(engine/batch.BatchEngine._spec_cycle_core): per-slot accept/reject
+vectors inside a fused multi-cycle scan, per-request ``spec_k`` admission,
+overlap-pipeline composition, and paged draft-write COW safety — see
+ISSUE 11 / the README "Speculative decoding" section. ``propose_ngram``
+below is shared by both tiers.
 """
 
 from __future__ import annotations

@@ -54,6 +54,26 @@ REUSED_PREFIX_TOKENS = metrics.counter(
     "dllama_reused_prefix_tokens_total",
     "Prompt tokens served from a cached KV prefix instead of prefill")
 
+# ------------------------------------------------ speculative decoding
+
+SPEC_CYCLES = metrics.counter(
+    "dllama_spec_cycles_total",
+    "Batched speculative verify cycles consumed by the serving tier (one "
+    "K+1-wide forward each; emitted/cycles is the realized speedup)")
+SPEC_TOKENS = metrics.counter(
+    "dllama_spec_tokens_total",
+    "Speculative-decoding token flow, by kind: drafted = n-gram draft "
+    "tokens verified, accepted = drafts the model agreed with, emitted = "
+    "all tokens spec cycles produced (incl. the bonus token and non-spec "
+    "rows' single tokens)",
+    ("kind",))
+SPEC_ACCEPTED_LENGTH = metrics.histogram(
+    "dllama_spec_accepted_length",
+    "Accepted draft-prefix length per greedy speculative row per verify "
+    "cycle (0 = only the bonus token emitted; mean = _sum/_count is the "
+    "acceptance rate the spec speedup multiplies from)",
+    buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16))
+
 # -------------------------------------------------- radix prefix cache
 
 RADIX_LOOKUPS = metrics.counter(

@@ -181,6 +181,18 @@ class _HistogramChild:
             # that boundary's own bucket
             self.counts[bisect.bisect_left(self.buckets, v)] += 1
 
+    def observe_n(self, v: float, n: int) -> None:
+        """Record the same value n times in one locked update — for hot
+        paths that fold a batch of identical observations (e.g. a spec
+        chunk's accepted-length counts via bincount) instead of paying a
+        Python call per sample."""
+        if n <= 0:
+            return
+        v = float(v)
+        with self._lock:
+            self.sum += v * n
+            self.counts[bisect.bisect_left(self.buckets, v)] += n
+
     def count(self) -> int:
         with self._lock:
             return sum(self.counts)
@@ -241,6 +253,9 @@ class Histogram(_Family):
 
     def observe(self, v: float) -> None:
         self.labels().observe(v)
+
+    def observe_n(self, v: float, n: int) -> None:
+        self.labels().observe_n(v, n)
 
     def _render_child(self, out, values, child) -> None:
         cum = 0

@@ -90,6 +90,24 @@ def _parse_timeout(body: dict) -> float | None:
     return v
 
 
+def _parse_spec_k(body: dict) -> int | None:
+    """Per-request speculation: `spec_k` in the request body — the draft
+    length this request's slot runs at (0 disables speculation for this
+    request even while batch-mates speculate; values above the serving
+    --spec-k capacity clamp down to it; greedy output is bit-identical
+    either way). None/absent = the CLI default."""
+    v = body.get("spec_k")
+    if v is None:
+        return None
+    try:
+        v = int(v)
+    except (TypeError, ValueError):
+        raise ApiError(400, "spec_k must be an integer >= 0") from None
+    if v < 0:
+        raise ApiError(400, "spec_k must be an integer >= 0")
+    return v
+
+
 @dataclass
 class PrefixCache:
     """NaiveCache equivalent: remember the last conversation's messages and
@@ -235,6 +253,7 @@ class ApiServer:
         seed = body.get("seed", self.defaults["seed"])
         max_tokens = int(body.get("max_tokens") or body.get("max_completion_tokens") or 0)
         timeout_s = _parse_timeout(body)
+        spec_k = _parse_spec_k(body)
         extra_stops = body.get("stop") or []
         if isinstance(extra_stops, str):
             extra_stops = [extra_stops]
@@ -243,7 +262,7 @@ class ApiServer:
             return self._complete_batched(
                 body, messages, temperature, topp, max_tokens, extra_stops, emit,
                 seed=seed, presence=presence, frequency=frequency, probe=probe,
-                req_id=req_id, timeout_s=timeout_s,
+                req_id=req_id, timeout_s=timeout_s, spec_k=spec_k,
             )
 
         self._trace_single_submit(req_id, t_submit)
@@ -263,7 +282,8 @@ class ApiServer:
             content, finish, n_generated, t_first = self._run_single(
                 prompt_tokens, budget, sampler,
                 self.stops + list(extra_stops), emit, probe=probe,
-                deadline=None if timeout_s is None else t_submit + timeout_s)
+                deadline=None if timeout_s is None else t_submit + timeout_s,
+                spec_k=spec_k)
             if finish == "timeout" and n_generated == 0:
                 # expired on the engine lock: _run_single returned before
                 # ANY engine work, so the pre-call cache state is still the
@@ -320,6 +340,7 @@ class ApiServer:
         stream). Deeper failures (context window) still surface as HTTP 4xx
         on the non-streaming path."""
         _parse_timeout(body)  # a malformed timeout_s is a clean 400 too
+        _parse_spec_k(body)  # ...and a malformed spec_k
         if legacy:
             self._normalize_legacy_prompt(body)
             return
@@ -392,7 +413,8 @@ class ApiServer:
         return timings
 
     def _run_single(self, prompt_tokens, budget, sampler, stops, emit,
-                    probe=None, deadline=None) -> tuple[str, str, int, float | None]:
+                    probe=None, deadline=None,
+                    spec_k=None) -> tuple[str, str, int, float | None]:
         """Token loop of a single-engine completion (generate + EOS/stop
         detection + held-prefix flush) -> (content, finish_reason, n_tokens,
         first_token_monotonic_or_None — the TTFT mark of the `timings`
@@ -417,8 +439,12 @@ class ApiServer:
         t_first = None
         timed_out = False
         probe_at = time.monotonic() + 0.25
+        # per-request spec_k on this tier clamps to the CLI --spec
+        # capacity, same contract as the batched tier (the engine caches
+        # one compiled decoder per distinct k, bounded by --spec values)
+        spec = self.spec if spec_k is None else min(int(spec_k), self.spec)
         for t in self.engine.generate(prompt_tokens, budget, sampler,
-                                      spec=self.spec):
+                                      spec=spec):
             if t_first is None:
                 t_first = time.monotonic()
             if probe is not None and time.monotonic() >= probe_at:
@@ -462,7 +488,7 @@ class ApiServer:
     def _complete_batched(self, body, messages, temperature, topp, max_tokens,
                           extra_stops, emit, seed=None, presence=0.0,
                           frequency=0.0, probe=None, req_id: str = "",
-                          timeout_s=None) -> dict:
+                          timeout_s=None, spec_k=None) -> dict:
         """Continuous-batching completion: submit to the scheduler, stream from
         the per-request queue. Per-request `seed` pins the slot's own PRNG
         stream (reproducible regardless of batch-mates). Prefix reuse lives in
@@ -477,7 +503,7 @@ class ApiServer:
             prompt_tokens, temperature, topp, max_tokens,
             self.stops + list(extra_stops), emit,
             seed=seed, presence=presence, frequency=frequency, probe=probe,
-            req_id=req_id, timeout_s=timeout_s)
+            req_id=req_id, timeout_s=timeout_s, spec_k=spec_k)
         return {
             "timings": timings,
             "id": f"chatcmpl-{uuid.uuid4().hex[:16]}",
@@ -501,7 +527,7 @@ class ApiServer:
     def _run_batched(self, prompt_tokens, temperature, topp, max_tokens,
                      stops, emit, seed=None, presence=0.0,
                      frequency=0.0, probe=None, req_id: str = "",
-                     timeout_s=None) -> tuple[str, str, int, dict]:
+                     timeout_s=None, spec_k=None) -> tuple[str, str, int, dict]:
         """Token-level core of a batched completion: submit, stream-decode
         with EOS/stop detection, return (content, finish_reason, n_tokens,
         timings) — `timings` is the request's span-sourced latency object
@@ -528,6 +554,9 @@ class ApiServer:
             presence=presence, frequency=frequency,
             seed=int(seed) if seed is not None else None,
             req_id=req_id, timeout_s=timeout_s,
+            # None = the --spec-k serving default (the engine's compiled K);
+            # the scheduler clamps explicit values to that capacity
+            spec_k=spec_k,
         )
         parts: list[str] = []
         n_generated = 0
@@ -603,6 +632,7 @@ class ApiServer:
         seed = body.get("seed", self.defaults["seed"])
         max_tokens = int(body.get("max_tokens") or 16)  # OpenAI legacy default
         timeout_s = _parse_timeout(body)
+        spec_k = _parse_spec_k(body)
         extra_stops = body.get("stop") or []
         if isinstance(extra_stops, str):
             extra_stops = [extra_stops]
@@ -613,7 +643,8 @@ class ApiServer:
                 prompt_tokens, temperature, topp, max_tokens,
                 list(extra_stops),  # raw prompt: no chat-template stops
                 emit, seed=seed, presence=presence, frequency=frequency,
-                probe=probe, req_id=req_id, timeout_s=timeout_s)
+                probe=probe, req_id=req_id, timeout_s=timeout_s,
+                spec_k=spec_k)
         else:
             self._trace_single_submit(req_id, t_submit)
             with self.lock:
@@ -629,7 +660,8 @@ class ApiServer:
                     prompt_tokens, budget, sampler, list(extra_stops), emit,
                     probe=probe,
                     deadline=(None if timeout_s is None
-                              else t_submit + timeout_s))
+                              else t_submit + timeout_s),
+                    spec_k=spec_k)
             timings = self._single_tier_timings(
                 req_id, t_submit, t_admit, t_first, n_generated,
                 len(prompt_tokens), 0, finish, timeout_s=timeout_s)
@@ -856,6 +888,11 @@ class _Handler(BaseHTTPRequestHandler):
             payload["radix"] = (sched.engine.radix_stats()
                                 if hasattr(sched.engine, "radix_stats")
                                 else None)
+            # speculative-decoding acceptance record (None when --spec-k
+            # 0): tokens_per_cycle = realized tokens per verify forward
+            payload["spec"] = (sched.engine.spec_stats()
+                               if hasattr(sched.engine, "spec_stats")
+                               else None)
         self._send_json(200, payload)
 
     def _debug_get(self) -> None:

@@ -108,6 +108,15 @@ class Request:
     seed: int | None = None
     presence: float = 0.0
     frequency: float = 0.0
+    # per-request speculation (ISSUE 11): draft length this request's slot
+    # runs at (body `spec_k` / --spec-k serving default, clamped to the
+    # engine's compile-time K at submit; 0 = plain decode for this request
+    # even while batch-mates speculate). spec_cycles/spec_tokens accumulate
+    # the request's own acceptance record — `timings()` derives its
+    # realized per-request speedup (tokens per verify forward) from them.
+    spec_k: int = 0
+    spec_cycles: int = 0
+    spec_tokens: int = 0
     out: queue.Queue = field(default_factory=queue.Queue)
     produced: int = 0
     slot: int = -1
@@ -185,6 +194,17 @@ class Request:
             # and whether the deadline (not EOS/budget) ended the request
             out["timeout_s"] = self.timeout_s
             out["deadline_exceeded"] = self.finish_reason == "timeout"
+        if self.spec_k > 0:
+            # per-request speculation record: tokens per verify forward IS
+            # the realized speedup over one-token-per-forward decoding
+            out["spec"] = {
+                "spec_k": self.spec_k,
+                "cycles": self.spec_cycles,
+                "tokens": self.spec_tokens,
+                "tokens_per_cycle": (round(self.spec_tokens
+                                           / self.spec_cycles, 3)
+                                     if self.spec_cycles else None),
+            }
         return out
 
     def tokens(self, poll=None, poll_s: float = 0.25):
@@ -234,10 +254,11 @@ class Scheduler:
         # have above — with overrun tokens discarded and release(keep_rows=)
         # rewound to the truly-emitted prefix. False restores the lockstep
         # loop (dispatch+consume per iteration); token streams are
-        # bit-identical either way. Spec engines always run lockstep: a spec
-        # cycle's emit counts are data-dependent, so there is nothing to
-        # dispatch ahead.
-        self.overlap = bool(overlap) and not getattr(engine, "spec_k", 0)
+        # bit-identical either way. Speculative cycles compose (ISSUE 11):
+        # they dispatch/consume through the same split — cycle N+1's
+        # propose/verify launches off cycle N's device carry, and the
+        # data-dependent emit counts materialize at consumption.
+        self.overlap = bool(overlap)
         # bounded admission (load shedding): submit() raises QueueFull once
         # the pending queue holds this many requests — the API tier turns it
         # into 429 + Retry-After. 0 = unbounded (the pre-supervision behavior).
@@ -293,9 +314,12 @@ class Scheduler:
         self._host_gap_ms: list[float] = []
         self._t_consumed: float | None = None
         self._last_gap_ms: float | None = None  # latest host gap (trace arg)
-        # mixed-batch speculation: when some active slot is spec-ineligible
-        # (near seq_len or penalized), spec cycles freeze it — alternate spec
-        # with plain decode chunks so it still advances (toggle state)
+        # gated spec/decode alternation: per-slot eligibility (ISSUE 11)
+        # lets sampled, penalized, and non-spec traffic ride spec cycles
+        # one token at a time, so the only slots a cycle still freezes are
+        # those WITHOUT a K+1-row verify window (context edge, exhausted
+        # page pool). While one of those is live, spec cycles alternate
+        # with plain decode chunks (toggle state) so it still advances.
         self._spec_tick = False
         self._completed: list[Request] = []  # ring of recent requests (metrics)
         self._metrics_lock = threading.Lock()
@@ -363,12 +387,19 @@ class Scheduler:
     def submit(self, prompt, temperature, topp, max_tokens, eos_ids,
                seed: int | None = None, presence: float = 0.0,
                frequency: float = 0.0, req_id: str = "",
-               timeout_s: float | None = None) -> Request:
+               timeout_s: float | None = None,
+               spec_k: int | None = None) -> Request:
         self.check_admission()
+        # per-request speculation: None keeps the engine default (every
+        # greedy request speculates at the engine's K — the pre-ISSUE-11
+        # behavior and the --spec-k serving default); explicit values clamp
+        # to the compile-time K, 0 opts this request out entirely
+        cap = int(getattr(self.engine, "spec_k", 0))
+        spec_k = cap if spec_k is None else max(0, min(int(spec_k), cap))
         req = Request(list(prompt), float(temperature), float(topp), int(max_tokens),
                       frozenset(eos_ids), seed=seed, presence=float(presence),
                       frequency=float(frequency), submitted_at=time.monotonic(),
-                      req_id=req_id)
+                      req_id=req_id, spec_k=spec_k)
         if timeout_s is not None and timeout_s > 0:
             req.timeout_s = float(timeout_s)
             req.deadline_at = req.submitted_at + req.timeout_s
@@ -555,6 +586,11 @@ class Scheduler:
             # is the saved-prefill-rows total the dllama_radix_* series export
             "radix": self.engine.radix_stats()
             if hasattr(self.engine, "radix_stats") else None,
+            # speculative-decoding acceptance record (None when the engine
+            # was built spec=0) — the dllama_spec_* series' host-side view:
+            # tokens_per_cycle is the realized batch speedup per forward
+            "spec": self.engine.spec_stats()
+            if hasattr(self.engine, "spec_stats") else None,
         }
 
     def reset_latency_stats(self) -> None:
@@ -1060,7 +1096,8 @@ class Scheduler:
                             presence=req.presence, frequency=req.frequency,
                             counted=(req.resume_tokens[:-1]
                                      if (req.presence or req.frequency)
-                                     else None))
+                                     else None),
+                            spec_k=req.spec_k)
                         self._inflight.pop(0)
                         self.slot_tokens[adm.slot] = (list(req.prompt)
                                                       + list(req.resume_tokens))
@@ -1083,7 +1120,8 @@ class Scheduler:
                                                        req.topp,
                                                        seed=req.seed,
                                                        presence=req.presence,
-                                                       frequency=req.frequency)
+                                                       frequency=req.frequency,
+                                                       spec_k=req.spec_k)
                         self._inflight.pop(0)
                         self.reused_prefix_tokens += reuse  # rows really served
                         ins.REUSED_PREFIX_TOKENS.inc(reuse)
@@ -1362,12 +1400,14 @@ class Scheduler:
         """True when the next chunk must wait for a fully-consumed pipeline:
         admission work (a prefill must not race the in-flight chunk's
         donated cache, and commit/release need settled host mirrors), a
-        pending cancel, a slot at the cache edge, spec alternation, or an
-        emptied batch. The overlapped loop then consumes its in-flight chunk
-        WITHOUT dispatching a successor, and the next iteration runs the
-        boundary work on settled state — admission pumps are serialized at
-        chunk consumption points."""
-        if self._stop.is_set() or getattr(self.engine, "spec_k", 0):
+        pending cancel, a slot at the cache edge, or an emptied batch.
+        Speculative cycles pipeline like plain chunks (their data-dependent
+        counts materialize at consumption; _dispatch_chunk drains the
+        pipeline itself on a spec<->plain mode switch). The overlapped loop
+        then consumes its in-flight chunk WITHOUT dispatching a successor,
+        and the next iteration runs the boundary work on settled state —
+        admission pumps are serialized at chunk consumption points."""
+        if self._stop.is_set():
             return True
         if (not self.slots or self._inflight or self._deferred is not None
                 or self._recover or not self.pending.empty()):
@@ -1394,7 +1434,15 @@ class Scheduler:
             # live request exhausts max_tokens within the chunk already in
             # flight, a successor would be pure discarded overrun — don't
             # burn a device chunk on it (a fixed-budget batch would pay one
-            # wasted chunk per drain otherwise)
+            # wasted chunk per drain otherwise). For a spec chunk the real
+            # counts are still on device, so use the OPTIMISTIC per-slot
+            # bound (n cycles x K+1): skipping a successor that turns out
+            # needed costs one boundary trip; dispatching a pure-overrun
+            # chunk costs a whole wasted device launch.
+            if inflight_chunk.spec:
+                bound = inflight_chunk.n * (int(self.engine.spec_k) + 1)
+                return all(req.produced + bound >= req.max_tokens
+                           for req in self.slots.values())
             return all(
                 req.produced + int(inflight_chunk.advance[slot]) >= req.max_tokens
                 for slot, req in self.slots.items()
@@ -1425,11 +1473,19 @@ class Scheduler:
             del self._host_gap_ms[:-256]
 
     def _dispatch_chunk(self, pipeline_empty: bool = True,
-                        exclude_gap_s: float = 0.0):
-        """Start the next device chunk. Returns (chunk, slots snapshot) for
-        an async decode dispatch, or None when a spec cycle ran instead —
-        spec emit counts are data-dependent, so the cycle is dispatched AND
-        consumed in place (nothing to overlap).
+                        exclude_gap_s: float = 0.0, inflight=None):
+        """Start the next device chunk — a plain fused decode chunk, or
+        ONE speculative verify cycle when some live slot can accept drafts
+        (per-request spec_k > 0, greedy, a K+1-row verify window). Spec
+        cycles flow through the same decode_dispatch/decode_consume split
+        as plain chunks (ISSUE 11), so the overlapped pipeline composes
+        with speculation: cycle N+1's propose/verify launches off cycle
+        N's device carry while the host emits N's tokens. Returns (chunk,
+        slots snapshot); or None when `inflight` (the unconsumed
+        predecessor) is of the OTHER mode — the host position mirror only
+        settles when a spec cycle is consumed, so a spec<->plain switch
+        drains the pipeline for one iteration instead of dispatching off
+        unsettled state.
 
         A decode/spec failure here is NOT a per-request problem: the jitted
         step donates the KV cache, so an exception mid-chunk leaves the
@@ -1438,56 +1494,61 @@ class Scheduler:
         tokens ride the unconsumed chunk) fails fast with
         finish_reason='error' and /health goes unhealthy (the process
         supervisor owns the restart)."""
-        # speculative cycle when some slot can profit: greedy (sampled
-        # slots never accept drafts), K+1 rows of cache room, and no
-        # repetition penalties (spec acceptance compares raw argmax;
-        # penalized sampling rides the counts-carrying decode path).
-        # Ineligible slots are frozen by spec_step, not poisoned — a
-        # mixed batch alternates spec cycles with plain decode chunks so
-        # frozen slots still advance to their finish (no livelock) while
-        # eligible ones keep multi-token acceptance on their cycles.
         self.ledger.transition("decode_dispatch")
         use_spec = False
+        alternating = False
         if getattr(self.engine, "spec_k", 0):
-            elig = self.engine.spec_eligible()  # the engine's freeze rule
-            use_spec = any(
-                elig[s] and float(self.engine.temperature[s]) == 0.0
-                for s in self.slots
-            )
+            # speculate while some live slot can actually accept drafts;
+            # sampled, penalized, and spec_k=0 traffic rides the cycles one
+            # token at a time (per-slot eligibility, resolved on device)
+            draft = self.engine.spec_draft_k()
+            elig = self.engine.spec_eligible()
+            use_spec = any(draft[s] > 0 for s in self.slots)
             if use_spec and not all(elig[s] for s in self.slots):
-                self._spec_tick = not self._spec_tick
-                use_spec = self._spec_tick
+                # gated alternation — the one case per-slot eligibility
+                # cannot absorb: a live slot WITHOUT a K+1-row verify
+                # window (context edge, exhausted page pool) freezes in
+                # spec cycles, so plain decode chunks alternate in until
+                # it finishes. Everything else rides the cycles.
+                alternating = True
+                use_spec = not self._spec_tick
+        if inflight is not None and bool(inflight.spec) != use_spec:
+            # mode switch: consume the in-flight chunk first. Crucially the
+            # alternation toggle is NOT consumed here — an aborted
+            # dispatch must not eat the plain-decode turn, or under
+            # overlap every launched chunk would be spec and the frozen
+            # slot would starve (the exact livelock alternation prevents)
+            return None
+        if alternating:
+            self._spec_tick = use_spec  # turn consumed by a real dispatch
+        n_disp = self.chunk
+        if use_spec:
+            # tail clamp: a chunk-sized spec launch can overshoot a
+            # finishing request by up to chunk x (K+1) tokens of discarded
+            # device work — when every live request fits inside ONE cycle's
+            # ceiling, dispatch a single cycle instead (quantized to
+            # {1, chunk} so the fused scan compiles exactly twice)
+            k1 = int(self.engine.spec_k) + 1
+            if all(req.max_tokens - req.produced <= k1
+                   for req in self.slots.values()):
+                n_disp = 1
         self._observe_host_gap(pipeline_empty, exclude_gap_s)
         tr = trace.TRACER
-        if use_spec:
-            start_rows = {s: int(self.engine.pos[s]) for s in self.slots}
-            self.ledger.transition("decode_wait")  # spec consumes in place
-            emit_toks, adv = self.engine.spec_step()  # records decode.spec
-            self._t_dec_end = self._t_consumed = time.monotonic()
-            self.ledger.transition("emit")
-            for slot, req in list(self.slots.items()):
-                if tr.enabled and adv[slot]:
-                    tr.req_chunk(req.req_id, self.engine.chunk_seq,
-                                 int(adv[slot]))
-                for i in range(int(adv[slot])):
-                    # row written when sampling token i: start + i (+1 = prefix len)
-                    if self._emit(req, emit_toks[slot, i], start_rows[slot] + i + 1):
-                        break
-            return None
         if tr.enabled:
             t0 = tr.now()
-            chunk = self.engine.decode_dispatch(self.chunk)
+            chunk = self.engine.decode_dispatch(n_disp, spec=use_spec)
             # the dispatch span: pure host work. Under overlap it lands
             # INSIDE the previous chunk's decode.device span — the
             # interleaving scripts/trace_smoke.sh asserts on.
             tr.span_at("decode.dispatch", t0, tr.now(), cat="decode",
                        track="scheduler", chunk=chunk.seq, n=chunk.n,
-                       occupancy=len(self.slots),
+                       occupancy=len(self.slots), spec=use_spec,
                        pipelined=not pipeline_empty,
                        host_gap_ms=(None if self._last_gap_ms is None
                                     else round(self._last_gap_ms, 3)))
             return chunk, dict(self.slots)
-        return self.engine.decode_dispatch(self.chunk), dict(self.slots)
+        return (self.engine.decode_dispatch(n_disp, spec=use_spec),
+                dict(self.slots))
 
     def _consume_chunk(self, chunk, snapshot) -> None:
         """Block on a dispatched chunk's tokens and emit them to the
@@ -1506,7 +1567,11 @@ class Scheduler:
         if chunk.active.any():
             # roofline/goodput feed: price this chunk's HBM traffic at its
             # dispatch-time occupancy and mean live-KV horizon against the
-            # exclusive device window decode_consume just measured
+            # exclusive device window decode_consume just measured. For a
+            # spec chunk `n` is the number of verify cycles — each one
+            # weight/KV sweep like a decode step — however many tokens the
+            # cycles emitted (that gap IS the speculation win the goodput
+            # series shows).
             self.perf.observe_chunk(
                 occupancy=int(chunk.active.sum()),
                 live_rows=float(chunk.start_pos[chunk.active].mean())
@@ -1536,6 +1601,11 @@ class Scheduler:
                     "request failed (engine healthy)"))
                 self._finish(req, "error")
                 continue
+            if chunk.spec and chunk.advance[slot]:
+                # per-request acceptance record (timings()'s spec object):
+                # cycles this request participated in, and tokens they gave
+                req.spec_cycles += int((chunk.adv_cycles[:, slot] > 0).sum())
+                req.spec_tokens += int(chunk.advance[slot])
             if tr.enabled and chunk.advance[slot]:
                 # flight-recorder chunk entry BEFORE the tokens reach the
                 # client queue: a response never races its own record
@@ -1571,7 +1641,8 @@ class Scheduler:
                 # device compute — unless boundary work needs the settled,
                 # fully-consumed state first.
                 nxt = (None if self._needs_boundary(pending[0])
-                       else self._dispatch_chunk(pipeline_empty=False))
+                       else self._dispatch_chunk(pipeline_empty=False,
+                                                 inflight=pending[0]))
                 self._consume_chunk(*pending)
                 pending = nxt
                 continue
@@ -1644,8 +1715,6 @@ class Scheduler:
                 ins.ADMISSION_STALL_SECONDS.observe(gap_ms / 1000.0)
             chunk = self._dispatch_chunk(
                 exclude_gap_s=time.monotonic() - t_boundary)
-            if chunk is None:
-                continue  # spec cycle: already consumed in place
             if self.overlap:
                 pending = chunk
             else:

@@ -100,7 +100,7 @@ def run_chaos(n_requests: int = 200, seed: int = 0, n_slots: int = 3,
 
     eng = BatchEngine(cfg, params, n_slots=n_slots, cache_dtype=jnp.float32,
                       kv_layout="paged", page_size=page_size,
-                      kv_pages=kv_pages)
+                      kv_pages=kv_pages, spec=4)
     eng.pool.audit_on_release = True  # every release audited, crash-adjacent
     sched = Scheduler(eng, chunk=chunk, restart_max=1_000_000,
                       restart_window_s=2.0, restart_backoff_s=0.005)
@@ -133,6 +133,12 @@ def run_chaos(n_requests: int = 200, seed: int = 0, n_slots: int = 3,
             frequency=0.25 if rng.random() < 0.10 else 0.0,
             timeout_s=(float(rng.uniform(0.05, 0.5))
                        if rng.random() < timeout_frac else None),
+            # per-request speculation (ISSUE 11): roughly half the greedy
+            # band speculates, so spec cycles + plain chunks + restarts +
+            # deadlines + penalties all interleave under fault injection —
+            # and the release-time pool audits run with draft rows landing
+            # k+1 past live positions the whole soak
+            spec_k=(4 if greedy and rng.random() < 0.5 else 0),
         ))
 
     results: list[dict] = [None] * n_requests  # type: ignore[list-item]
@@ -165,7 +171,8 @@ def run_chaos(n_requests: int = 200, seed: int = 0, n_slots: int = 3,
                                    seed=s["seed"], presence=s["presence"],
                                    frequency=s["frequency"],
                                    req_id=f"req_chaos{i:05d}",
-                                   timeout_s=s["timeout_s"])
+                                   timeout_s=s["timeout_s"],
+                                   spec_k=s["spec_k"])
             except SchedulerRejected as e:
                 # admission shed (injected queue overflow, restart-depth
                 # backpressure): a clean, client-visible terminal outcome
@@ -263,6 +270,8 @@ def run_chaos(n_requests: int = 200, seed: int = 0, n_slots: int = 3,
         if not audit["ok"]:
             problems.append(f"pool audit failed: {audit['problems']}")
         report["radix"] = eng.radix_stats()
+        report["spec"] = eng.spec_stats()  # acceptance record of the soak's
+        # speculative band (cycles > 0 proves spec ran under the faults)
         for s in range(n_slots):
             if not eng.active[s]:
                 eng.drop_slot_pages(s)

@@ -54,6 +54,14 @@ RULES: list[tuple[str, str, float]] = [
     ("paged_kernel.pages.*.tok_s_ratio_kernel_gather", "higher", 0.50),
     ("batch.*.agg_tok_s", "higher", 0.20),
     ("admission.stall_reduction_x", "higher", 0.50),
+    # ISSUE 11 speculative continuous batching: the serving tier must keep
+    # its spec-over-plain win on the draftable leg, and a spec neighbor
+    # must never collapse the non-spec slots' throughput on the mixed leg
+    # (ratios are normalized; loose tolerances — CPU-fallback hosts time
+    # tiny models where per-cycle host overhead dominates)
+    ("spec_batch.repetitive.tok_s_ratio_spec_plain", "higher", 0.50),
+    ("spec_batch.mixed.nonspec_tok_s_ratio", "higher", 0.50),
+    ("spec_batch.repetitive.tokens_per_cycle", "higher", 0.50),
     # ISSUE 9 radix record: warm TTFT must stay collapsed relative to cold
     # (ratio is normalized; loose tolerance — CPU hosts time compile-warm
     # suffix prefills against a chunked cold prefill)

@@ -38,10 +38,15 @@ for name in metrics.REGISTRY.names():
 # series are what scripts/radix_smoke.sh and the bench radix record assert
 # on (ISSUE 9): their REMOVAL from the registry must fail here too, not
 # just their absence from the README
+# ...the speculative-decoding acceptance series are what
+# scripts/spec_smoke.sh and the bench spec_batch record assert on
+# (ISSUE 11): removal from the registry must fail here too
 for name in ("dllama_kv_pages_total", "dllama_kv_pages_used",
              "dllama_kv_pages_shared",
              "dllama_radix_lookups_total", "dllama_radix_hit_tokens_total",
-             "dllama_radix_nodes", "dllama_radix_pages"):
+             "dllama_radix_nodes", "dllama_radix_pages",
+             "dllama_spec_cycles_total", "dllama_spec_tokens_total",
+             "dllama_spec_accepted_length"):
     if name not in metrics.REGISTRY.names():
         missing.append(f"unregistered:{name}")
 for name in sorted(trace.SPAN_CATALOG):

@@ -1,0 +1,138 @@
+#!/usr/bin/env bash
+# spec_smoke.sh — end-to-end speculative-serving smoke target (ISSUE 11).
+#
+# Boots `python -m dllama_tpu serve` (the real CLI, not an in-process
+# server) on a freshly generated tiny fixture model with --spec-k armed,
+# runs one SPECULATING greedy completion and one spec_k=0 sampled
+# completion CONCURRENTLY (mixed spec/non-spec traffic in one batch), and
+# asserts:
+#
+#   * the acceptance counters advanced: dllama_spec_cycles_total and
+#     dllama_spec_tokens_total{kind="emitted"} are live, and the greedy
+#     response's `timings.spec` object reports its per-request record;
+#   * the spec_k=0 request carries NO spec object (per-request opt-out);
+#   * GET /debug/kv answers 200 with a CLEAN audit — spec verify wrote
+#     k+1 draft rows past live positions all run long and no draft ever
+#     landed in a shared page (the write-horizon invariant, through the
+#     real serving surface with the paged default + radix cache ON).
+#
+# Finishes with a SIGTERM drain. SMOKE TARGET, not a pytest test (lives
+# outside tests/, exempt from the tier-1 run). CPU-only, ~1 min. Exit 0 = PASS.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import http.client
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.getcwd())
+from tests.test_serve import make_tiny_files  # the tier-1 fixture model
+
+tmp = tempfile.mkdtemp(prefix="dllama_spec_smoke_")
+mpath, tpath, _cfg = make_tiny_files(__import__("pathlib").Path(tmp))
+
+with socket.socket() as s:  # pick a free port
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+
+proc = subprocess.Popen(
+    [sys.executable, "-m", "dllama_tpu", "serve", "--model", mpath,
+     "--tokenizer", tpath, "--slots", "2", "--port", str(port),
+     "--kv-layout", "paged", "--page-size", "8", "--spec-k", "4"],
+    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+)
+
+
+def get(path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read().decode()
+    conn.close()
+    return r.status, body
+
+
+def metric(text, name):
+    m = re.search(rf"^{name} ([0-9.e+-]+)$", text, re.M)
+    return float(m.group(1)) if m else 0.0
+
+
+def complete(body, out):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=180)
+    conn.request("POST", "/v1/chat/completions", json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    payload = json.loads(resp.read())
+    conn.close()
+    assert resp.status == 200, f"completion -> {resp.status}: {payload}"
+    assert payload["usage"]["completion_tokens"] > 0
+    out.append(payload)
+
+
+try:
+    deadline = time.time() + 120  # first-boot XLA compiles on CPU are slow
+    while True:
+        try:
+            if get("/health/ready")[0] == 200:
+                break
+        except OSError:
+            pass
+        if proc.poll() is not None:
+            sys.exit("FAIL: server exited before becoming ready")
+        if time.time() > deadline:
+            sys.exit("FAIL: server never became ready")
+        time.sleep(0.25)
+
+    # concurrent mixed traffic: a greedy request speculating at the
+    # --spec-k default, and a sampled request opting out via body spec_k=0
+    spec_out, plain_out = [], []
+    t1 = threading.Thread(target=complete, args=(
+        {"messages": [{"role": "user",
+                       "content": "one two three one two three one two"}],
+         "max_tokens": 24, "temperature": 0.0}, spec_out))
+    t2 = threading.Thread(target=complete, args=(
+        {"messages": [{"role": "user", "content": "tell me something new"}],
+         "max_tokens": 16, "temperature": 0.9, "seed": 7, "spec_k": 0},
+        plain_out))
+    t1.start(); t2.start(); t1.join(); t2.join()
+
+    spec_t = spec_out[0]["timings"]
+    assert "spec" in spec_t and spec_t["spec"]["cycles"] > 0, (
+        f"greedy request carried no spec record: {spec_t}")
+    assert spec_t["spec"]["spec_k"] == 4
+    assert "spec" not in plain_out[0]["timings"], (
+        "spec_k=0 request must not carry a spec record")
+
+    st, m1 = get("/metrics")
+    assert st == 200
+    cycles = metric(m1, "dllama_spec_cycles_total")
+    assert cycles > 0, "dllama_spec_cycles_total never advanced"
+    assert re.search(r'dllama_spec_tokens_total\{kind="emitted"\} [1-9]',
+                     m1), "no emitted-labelled spec tokens in /metrics"
+
+    st, perf = get("/debug/perf")
+    perf = json.loads(perf)
+    assert st == 200 and perf.get("spec", {}).get("cycles", 0) > 0, (
+        f"/debug/perf spec record missing: {perf.get('spec')}")
+
+    st, kv = get("/debug/kv")
+    kv = json.loads(kv)
+    assert st == 200 and kv["audit"]["ok"], f"/debug/kv audit: {kv}"
+    print(f"PASS: spec serve OK — {cycles:.0f} verify cycles, per-request "
+          f"tokens/cycle={spec_t['spec']['tokens_per_cycle']}; "
+          f"/debug/kv audit clean with draft writes all run long")
+finally:
+    proc.send_signal(signal.SIGTERM)  # exercises the graceful drain path
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+PY

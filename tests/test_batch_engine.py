@@ -349,11 +349,12 @@ def test_spec_frozen_sampled_slot_keeps_seed_stream():
     assert tail(False) == tail(True)
 
 
-def test_spec_freezes_penalized_slot_not_batch():
-    """A penalized slot no longer disables speculation batch-wide: spec
-    cycles freeze it (adv == 0, state untouched) while greedy batch-mates
-    keep multi-token acceptance, and alternating spec with decode() yields
-    the penalized slot's exact counts-carrying stream (VERDICT r4 next #6)."""
+def test_spec_penalized_slot_rides_the_cycle():
+    """A penalized slot no longer freezes spec cycles (ISSUE 11): the
+    counts-carrying _spec_step_pen variant advances it exactly 1
+    bit-exact penalized token per cycle while greedy batch-mates keep
+    multi-token acceptance — no decode alternation needed (replaces the
+    old engine-global freeze of VERDICT r4 next #6)."""
     from dllama_tpu.engine.sampling import Sampler as _S
 
     p_g, p_p = [1, 2, 3, 1, 2, 3, 1, 2], [7, 8, 9]
@@ -366,18 +367,14 @@ def test_spec_freezes_penalized_slot_not_batch():
     be = BatchEngine(CFG, PARAMS, n_slots=2, cache_dtype=jnp.float32, spec=4)
     got_g = [be.add(0, p_g, temperature=0.0)]
     got_p = [be.add(1, p_p, temperature=0.0, presence=0.6, frequency=0.4)]
-    forwards = 0
+    cycles = 0
     while len(got_g) < n + 1 or len(got_p) < n + 1:
-        if len(got_g) < n + 1:  # spec only while the eligible slot needs it
-            emit, adv = be.spec_step()  # penalized slot frozen, no error
-            forwards += 1
-            assert adv[1] == 0
-            got_g += [int(t) for t in emit[0, : adv[0]]]
-        toks = be.decode(1)  # frozen slot advances on the decode ticks
-        forwards += 1
-        got_g += [int(toks[0, 0])]
-        got_p += [int(toks[0, 1])]
-        assert forwards < 20 * n, "not converging"
+        emit, adv = be.spec_step()
+        cycles += 1
+        assert adv[1] == 1  # penalized: exactly one penalized token
+        got_g += [int(t) for t in emit[0, : adv[0]]]
+        got_p += [int(emit[1, 0])]
+        assert cycles < 20 * n, "not converging"
     assert got_g[: n + 1] == want_g[: n + 1]
     assert got_p[: n + 1] == want_p[: n + 1]
 

@@ -1,0 +1,325 @@
+"""Speculative decoding as a first-class citizen of continuous batching
+(ISSUE 11): per-request spec_k, overlap-composed spec cycles, mixed-batch
+isolation, keep_rows rewind under over-acceptance, warm-restart resume of a
+spec stream, and the paged draft-write safety invariant.
+
+The central contract: with fixed prompts/seeds, greedy token streams are
+BIT-IDENTICAL spec-on vs spec-off through the scheduler, across
+--overlap {on,off} x {dense,paged} x radix {on,off} — speculation only
+changes how many verify forwards it takes to produce them. Sampled and
+penalized requests ride spec cycles one exactly-sampled token at a time, so
+a spec neighbor can never perturb their streams either.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dllama_tpu.engine.batch import BatchEngine, PoolAuditError
+from dllama_tpu.models.config import LlamaConfig
+from dllama_tpu.models.llama import random_params
+from dllama_tpu.serve.scheduler import Scheduler
+
+CFG = LlamaConfig(dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                  vocab_size=96, seq_len=64)
+PARAMS = random_params(CFG, seed=3, dtype=jnp.float32, quantize=False)
+
+#: a draftable prompt: the greedy continuation of a periodic pattern settles
+#: into its own loop, so the n-gram proposer gets real acceptance
+REP = [5, 6, 7, 5, 6, 7, 5, 6]
+
+
+def _make_sched(overlap=True, spec=0, kv_layout="dense", radix="off",
+                n_slots=3, chunk=3, **kw):
+    ekw = dict(kv_layout=kv_layout)
+    if kv_layout == "paged":
+        ekw.update(page_size=8, radix_cache=radix)
+    eng = BatchEngine(CFG, PARAMS, n_slots=n_slots, cache_dtype=jnp.float32,
+                      spec=spec, **ekw)
+    return Scheduler(eng, chunk=chunk, overlap=overlap, **kw)
+
+
+def _workload(sched):
+    """Mixed traffic: greedy draftable, sampled, penalized — staggered."""
+    r1 = sched.submit(REP, 0.0, 0.9, 14, frozenset(), seed=1)
+    it1 = r1.tokens()
+    head = [next(it1), next(it1)]  # r1 decodes before the others join
+    r2 = sched.submit([9, 8, 7], 1.1, 0.9, 10, frozenset(), seed=42)
+    r3 = sched.submit([4, 5], 0.9, 0.8, 8, frozenset(), seed=7,
+                      presence=0.5, frequency=0.3)
+    out2 = list(r2.tokens())
+    out3 = list(r3.tokens())
+    out1 = head + list(it1)
+    return [(out1, r1.finish_reason), (out2, r2.finish_reason),
+            (out3, r3.finish_reason)]
+
+
+_REF = None
+
+
+def _reference():
+    """The spec-off stream set every configuration must reproduce (dense,
+    overlap on, spec 0 — memoized: each engine costs a compile inside the
+    time-budgeted tier-1 window)."""
+    global _REF
+    if _REF is None:
+        sched = _make_sched(overlap=True, spec=0)
+        try:
+            _REF = _workload(sched)
+        finally:
+            sched.shutdown()
+    return _REF
+
+
+@pytest.mark.parametrize("overlap,kv_layout,radix", [
+    (True, "dense", "off"),
+    (False, "dense", "off"),
+    (True, "paged", "on"),
+    (False, "paged", "off"),
+])
+def test_greedy_parity_spec_on_vs_off(overlap, kv_layout, radix):
+    """BIT-EXACT streams and finish reasons vs the spec-off reference,
+    with spec cycles verifiably running (acceptance criterion #3)."""
+    sched = _make_sched(overlap=overlap, spec=4, kv_layout=kv_layout,
+                        radix=radix)
+    try:
+        got = _workload(sched)
+        stats = sched.latency_summary()["spec"]
+    finally:
+        sched.shutdown()
+    assert got == _reference()
+    assert stats["cycles"] > 0 and stats["emitted"] > 0
+    if kv_layout == "paged":
+        # draft rows wrote k+1 rows past live positions all run long —
+        # DLLAMA_POOL_AUDIT=1 (armed suite-wide) already audited every
+        # release; one final explicit audit closes the drill
+        report = sched.engine.pool.audit(raise_on_fail=False)
+        assert report["ok"], report["problems"]
+
+
+def test_mixed_batch_isolation_sampled_stream_untouched():
+    """A sampled request's stream is identical whether its batch-mate
+    speculates or not (key-advance discipline: exactly one split per
+    emitted token on both paths)."""
+    ref = _reference()
+    sched = _make_sched(overlap=True, spec=4)
+    try:
+        got = _workload(sched)
+    finally:
+        sched.shutdown()
+    assert got[1] == ref[1]  # sampled
+    assert got[2] == ref[2]  # penalized (rides the counts-carrying cycle)
+
+
+def test_per_request_spec_k_mixes_and_clamps():
+    """spec_k is per-request: a spec_k=0 greedy request next to a spec_k=4
+    one gets the same stream as the all-plain run; explicit values clamp
+    to the engine's compile-time capacity."""
+    sched = _make_sched(overlap=True, spec=4, n_slots=2, chunk=4)
+    try:
+        r1 = sched.submit(REP, 0.0, 0.9, 12, frozenset(), seed=1, spec_k=4)
+        r2 = sched.submit(list(REP), 0.0, 0.9, 12, frozenset(), seed=2,
+                          spec_k=0)
+        assert r1.spec_k == 4 and r2.spec_k == 0
+        out1, out2 = list(r1.tokens()), list(r2.tokens())
+        # same prompt, both greedy => identical streams regardless of who
+        # speculated; r1 carries a per-request acceptance record, r2 none
+        assert out1 == out2
+        t1, t2 = r1.timings(), r2.timings()
+        assert t1["spec"]["cycles"] > 0 and t1["spec"]["tokens"] > 0
+        assert "spec" not in t2
+        # clamping: above-capacity asks fold down, None means the default
+        r3 = sched.submit([1, 2], 0.0, 0.9, 2, frozenset(), seed=3,
+                          spec_k=99)
+        assert r3.spec_k == 4
+        list(r3.tokens())
+        r4 = sched.submit([1, 2], 0.0, 0.9, 2, frozenset(), seed=3)
+        assert r4.spec_k == 4  # --spec-k serving default
+        list(r4.tokens())
+    finally:
+        sched.shutdown()
+
+
+def test_eos_overrun_rewinds_spec_acceptance():
+    """An EOS emitted mid-cycle (the model accepted drafts PAST the stop)
+    cuts the stream at the EOS token, and keep_rows/slot_tokens record only
+    the truly-emitted prefix — reused rows replay bit-exact."""
+    sched = _make_sched(overlap=True, spec=4, n_slots=2, chunk=4)
+    try:
+        probe = sched.submit(REP, 0.0, 0.9, 12, frozenset(), seed=0)
+        ref = list(probe.tokens())
+        # stop on a mid-stream token at its FIRST occurrence (so the ref
+        # prefix up to it is exactly what the stopped stream must emit)
+        cut = next(i for i, t in enumerate(ref) if i >= 2 and t not in ref[:i])
+        eos = ref[cut]
+        req = sched.submit(list(REP), 0.0, 0.9, 40, frozenset([eos]), seed=0)
+        got = list(req.tokens())
+        assert got == ref[: cut + 1] and req.finish_reason == "stop"
+        if sched._radix is None:
+            slot = [s for s, t in sched.slot_tokens.items() if t][0]
+            assert sched.slot_tokens[slot] == list(REP) + got[:-1]
+            assert int(sched.engine.pos[slot]) == len(REP) + len(got) - 1
+        follow = list(REP) + got + [11, 12]
+        r2 = sched.submit(follow, 0.0, 0.9, 6, frozenset(), seed=5)
+        warm = list(r2.tokens())
+    finally:
+        sched.shutdown()
+    cold = _make_sched(overlap=True, spec=0, n_slots=2, chunk=4)
+    try:
+        r3 = cold.submit(follow, 0.0, 0.9, 6, frozenset(), seed=5)
+        assert list(r3.tokens()) == warm, "reused overrun rows changed output"
+    finally:
+        cold.shutdown()
+
+
+def test_warm_restart_resumes_spec_streams():
+    """A worker crash mid-stream warm-restarts and resumes BOTH a greedy
+    spec stream and a sampled one bit-exact, with speculation still live
+    after the restart (the resumed slot keeps its per-request spec_k)."""
+    from dllama_tpu.utils import faults
+
+    def run(crash):
+        sched = _make_sched(overlap=True, spec=4, n_slots=2, chunk=3,
+                            restart_max=2)
+        sched.restart_backoff_s = 0.01
+        try:
+            r1 = sched.submit(REP, 0.0, 0.9, 16, frozenset(), seed=1)
+            r2 = sched.submit([9, 8, 7], 1.0, 0.9, 12, frozenset(), seed=9)
+            it1, it2 = r1.tokens(), r2.tokens()
+            head1 = [next(it1) for _ in range(3)]
+            head2 = [next(it2) for _ in range(2)]
+            if crash:
+                faults.install("engine.decode", "raise", times=1)
+            out1 = head1 + list(it1)
+            out2 = head2 + list(it2)
+            assert r1.finish_reason == "length"
+            assert r2.finish_reason == "length"
+            if crash:
+                assert sched.restart_count >= 1, "crash never fired"
+                stats = sched.latency_summary()["spec"]
+                assert stats["cycles"] > 0
+            return out1, out2
+        finally:
+            faults.clear()
+            sched.shutdown()
+
+    assert run(crash=True) == run(crash=False)
+
+
+def test_draft_writes_never_land_in_shared_pages():
+    """The paged draft-write safety drill (tentpole piece 3): spec verify
+    writes K+1 rows past the live position, so (a) the pre-dispatch
+    cow_writable splits any shared page covering the writable range, and
+    (b) PagePool.audit()'s write-horizon check catches the corruption when
+    that protection is bypassed."""
+    eng = BatchEngine(CFG, PARAMS, n_slots=2, cache_dtype=jnp.float32,
+                      spec=4, kv_layout="paged", page_size=8)
+    pool = eng.pool
+    eng.add(0, list(range(1, 11)), temperature=0.0)  # pos 10: mid-page
+    # manufacture the hazard: share slot 0's CURRENT boundary block (the
+    # page its next decode/spec rows land in) with slot 1's table — the
+    # state a missed admission-COW or a buggy prefix share would leave
+    blk = int(eng.pos[0]) // pool.page_size
+    page = int(pool.tables[0, blk])
+    with pool._mu:
+        pool.refcount[page] += 1
+        pool.tables[1, 0] = page
+        pool.n_blocks[1] = 1
+        pool._publish()
+    # (b) the audit names the violation while the share is in place
+    with pytest.raises(PoolAuditError, match="shared inside the writable"):
+        pool.audit()
+    # (a) a spec dispatch COWs the shared page BEFORE any draft write: the
+    # cycle runs clean and the writable range is exclusive again
+    emit, adv = eng.spec_step()
+    assert adv[0] >= 1
+    assert int(pool.tables[0, blk]) != page, "shared page was not split"
+    assert pool.audit()["ok"]
+    # slot 1's artificial claim still holds the ORIGINAL bytes' page
+    assert int(pool.tables[1, 0]) == page
+
+    # cleanup so the suite-wide release audit stays meaningful
+    with pool._mu:
+        pool._decref(page)
+        pool.tables[1, 0] = 0
+        pool.n_blocks[1] = 0
+        pool._publish()
+    eng.release(0)
+    assert pool.audit()["ok"]
+
+
+def test_overlap_alternation_advances_row_limit_frozen_slot():
+    """Regression (review finding): under overlap, the spec/plain
+    alternation toggle must only be consumed by a dispatch that actually
+    launches — an aborted pipelined mode-switch dispatch used to eat the
+    plain-decode turn, so every launched chunk was spec and a slot near
+    its row limit (frozen out of verify cycles) starved forever behind a
+    steady greedy spec batch-mate."""
+    import threading
+
+    sched = _make_sched(overlap=True, spec=4, n_slots=2, chunk=3)
+    try:
+        # near-limit request first: pos reaches seq_len-5 right after its
+        # commit, inside the K+1 no-verify window — spec cycles freeze it
+        near = sched.submit(list(range(1, CFG.seq_len - 5)), 0.0, 0.9, 40,
+                            frozenset(), seed=2, spec_k=0)
+        spec = sched.submit(REP, 0.0, 0.9, 24, frozenset(), seed=1, spec_k=4)
+        done = {}
+
+        def drain(name, req):
+            done[name] = (list(req.tokens()), req.finish_reason)
+
+        threads = [threading.Thread(target=drain, args=("near", near)),
+                   threading.Thread(target=drain, args=("spec", spec))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), (
+            "streams never finished: the frozen slot starved "
+            f"(finished: {sorted(done)})")
+        # the near-limit request reaches the context edge: 'length' with
+        # its full room emitted (the commit token + the 6 decode rows
+        # from pos=len(prompt) to seq_len)
+        toks, fin = done["near"]
+        assert fin == "length" and len(toks) == 7
+        assert done["spec"][1] == "length" and len(done["spec"][0]) == 24
+    finally:
+        sched.shutdown()
+
+
+def test_spec_acceptance_telemetry_counters():
+    """The dllama_spec_* series move when cycles run: cycles, drafted,
+    accepted, emitted, and the accepted-length histogram all advance, and
+    the engine's spec_stats() mirror agrees with the per-request records."""
+    from dllama_tpu.obs import instruments as ins
+
+    c0 = ins.SPEC_CYCLES.value()
+    e0 = ins.SPEC_TOKENS.labels(kind="emitted").value()
+    d0 = ins.SPEC_TOKENS.labels(kind="drafted").value()
+    sched = _make_sched(overlap=True, spec=4, n_slots=2, chunk=4)
+    try:
+        req = sched.submit(REP, 0.0, 0.9, 16, frozenset(), seed=1)
+        out = list(req.tokens())
+        stats = sched.latency_summary()["spec"]
+    finally:
+        sched.shutdown()
+    assert len(out) == 16
+    assert stats["cycles"] >= 1
+    assert ins.SPEC_CYCLES.value() - c0 == stats["cycles"]
+    assert ins.SPEC_TOKENS.labels(kind="emitted").value() - e0 == stats["emitted"]
+    assert ins.SPEC_TOKENS.labels(kind="drafted").value() - d0 == stats["drafted"]
+    # the request's own record covers every token it emitted via cycles
+    t = req.timings()
+    assert t["spec"]["tokens"] <= stats["emitted"]
+    assert t["spec"]["tokens_per_cycle"] is not None
+
+
+def test_single_engine_spec_guard_names_batched_alternative():
+    """decode_spec_greedy_n on a batch>1 engine raises a clean ValueError
+    pointing at the batched path (was a bare assert)."""
+    from dllama_tpu.engine.engine import InferenceEngine
+
+    eng = InferenceEngine(CFG, PARAMS, cache_dtype=jnp.float32, batch=2)
+    with pytest.raises(ValueError, match="BatchEngine"):
+        eng.decode_spec_greedy_n([1, 2, 3], 4, 4)
