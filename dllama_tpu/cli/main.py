@@ -130,9 +130,39 @@ def build_parser() -> argparse.ArgumentParser:
                         "tokens are discarded). 'off' restores the lockstep "
                         "loop for A/B — token streams are identical")
     p.add_argument("--admit-budget-ms", type=float, default=None,
-                   help="serve mode, needs --slots > 0: max decode stall (ms) a "
-                        "joining prompt's prefill may insert per visit (default "
-                        "250; 0 = strict one-chunk-per-decode interleaving)")
+                   help="serve mode, needs --slots > 0: LEGACY phase-split "
+                        "admission only (--prefill-budget 0): max decode "
+                        "stall (ms) a joining prompt's prefill may insert per "
+                        "visit (default 250; 0 = strict one-chunk-per-decode "
+                        "interleaving). With the hybrid step (the default) "
+                        "admissions ride the decode chunks and this knob is "
+                        "inert")
+    p.add_argument("--prefill-budget", default="auto", metavar="{auto,N,0}",
+                   help="serve mode, needs --slots > 0: hybrid chunked "
+                        "prefill — each fused decode chunk co-processes up "
+                        "to this many prompt tokens of an admitting request "
+                        "in the SAME device launch, so a long prompt never "
+                        "stalls running streams. 'auto' (default) steers the "
+                        "budget online from the windowed ITL headroom "
+                        "against --slo-itl-ms (holds 64 with no target); an "
+                        "integer pins it; 0 restores the legacy phase-split "
+                        "admission (the A/B baseline). Token streams are "
+                        "bit-exact across all settings")
+    p.add_argument("--preempt", choices=["auto", "on", "off"], default="auto",
+                   help="serve mode, needs --slots > 0: preempt-to-pages — "
+                        "a running lower-priority request may be suspended "
+                        "at a chunk boundary when a strictly higher-priority "
+                        "request is blocked (no free slot / KV capacity); "
+                        "its pages stay referenced (radix tree) and the "
+                        "stream later resumes byte-identical with near-zero "
+                        "recompute. auto = on (default)")
+    p.add_argument("--tenant-weight", action="append", default=None,
+                   metavar="NAME=W",
+                   help="serve mode, needs --slots > 0: weighted fair "
+                        "queueing across tenants (the `tenant` request body "
+                        "field) within each priority class — repeatable, "
+                        "e.g. --tenant-weight paid=4 --tenant-weight free=1; "
+                        "unlisted tenants weigh 1")
     p.add_argument("--admit-ttft-deadline-ms", type=float, default=None,
                    help="serve mode, needs --slots > 0: joiners older than this "
                         "pump their prefill to completion despite the stall "
@@ -409,6 +439,31 @@ def cmd_chat(args) -> int:
     return 0
 
 
+def _parse_tenant_weights(specs) -> dict[str, float] | None:
+    """--tenant-weight NAME=W (repeatable) -> {name: weight}; malformed
+    specs fail startup with a clear message instead of silently weighing 1."""
+    if not specs:
+        return None
+    import math
+
+    out: dict[str, float] = {}
+    for spec in specs:
+        name, sep, w = str(spec).partition("=")
+        try:
+            weight = float(w)
+        except ValueError:
+            weight = 0.0
+        # non-finite weights corrupt the fair queue silently (NaN poisons
+        # every tag comparison, inf zeroes a tenant's cost and starves the
+        # rest) — reject them with the same startup error as w <= 0
+        if not sep or not name or not math.isfinite(weight) or weight <= 0:
+            raise SystemExit(
+                f"--tenant-weight {spec!r}: expected NAME=W with finite "
+                "W > 0")
+        out[name] = weight
+    return out
+
+
 def cmd_serve(args) -> int:
     from dllama_tpu.serve.api import run_server
 
@@ -416,6 +471,17 @@ def cmd_serve(args) -> int:
     if m.tokenizer is None:
         print("serve mode requires --tokenizer", file=sys.stderr)
         return 1
+    prefill_budget = args.prefill_budget
+    if prefill_budget != "auto":
+        try:
+            prefill_budget = int(prefill_budget)
+        except ValueError:
+            print(f"--prefill-budget must be 'auto' or an integer, got "
+                  f"{prefill_budget!r}", file=sys.stderr)
+            return 1
+        if prefill_budget < 0:
+            print("--prefill-budget must be >= 0", file=sys.stderr)
+            return 1
     return run_server(
         m,
         host=args.host,
@@ -441,6 +507,9 @@ def cmd_serve(args) -> int:
         page_size=args.page_size,
         kv_pages=args.kv_pages,
         radix_cache=args.radix_cache,
+        prefill_budget=prefill_budget,
+        preempt=args.preempt,
+        tenant_weights=_parse_tenant_weights(args.tenant_weight),
     )
 
 

@@ -513,6 +513,9 @@ class DecodeChunk:
     drafted_dev: jax.Array | None = None  # i32[B] draft tokens verified per
     # row this cycle (0 for sampled/non-spec/frozen rows) — the acceptance
     # telemetry's denominator, materialized alongside adv_dev at consume
+    hybrid_slot: int = -1  # >= 0: this chunk also carried a fused prefill
+    # slice for that (inactive) admitting slot (hybrid_dispatch)
+    hybrid_tokens: int = 0  # prompt tokens the fused slice covered
 
     def nonfinite(self) -> np.ndarray | None:
         """bool[B] rows whose logits went non-finite during this chunk
@@ -753,6 +756,20 @@ class BatchEngine:
                     mm_in, moe_impl),
             static_argnums=(8,), donate_argnums=(1, 11),
         )
+        # fused hybrid step (ISSUE 12): a prefill slice + a decode chunk in
+        # ONE launch. Same single-slot prefill contract as _prefill_slot, so
+        # it needs an unsharded batch axis (dp meshes keep the phase-split
+        # path — the scheduler checks supports_hybrid).
+        self._hybrid = jax.jit(
+            partial(self._hybrid_impl, cfg, attn_fn, self._col_fn, mm, mm_in,
+                    moe_impl),
+            static_argnums=(11,), donate_argnums=(1,),
+        )
+        self._hybrid_pen = jax.jit(
+            partial(self._hybrid_pen_impl, cfg, attn_fn, self._col_fn, mm,
+                    mm_in, moe_impl),
+            static_argnums=(11,), donate_argnums=(1, 14),
+        )
         self._copy_rows = jax.jit(self._copy_rows_impl, donate_argnums=(0,))
         self._copy_page = jax.jit(self._copy_page_impl, donate_argnums=(0,))
 
@@ -948,6 +965,76 @@ class BatchEngine:
             body, (tokens, cache, pos_vec, keys, counts, bad0), None, length=n
         )
         return toks, cache, keys, pos2, last[:, 0], counts, bad
+
+    @classmethod
+    def _hybrid_prefill_part(cls, cfg, attn_fn, col_fn, mm, mm_in, moe_impl,
+                             params, cache, ptoks, slot, ppos, rope):
+        """The admission half of one fused hybrid step: prefill `ptoks`
+        ([1, P]) into `slot` at position `ppos` — the exact single-slot
+        B=1 forward add_step uses (dense: batch-axis slice/unslice; paged:
+        the slot's own block-table row over the global pool), just traced
+        INSIDE the same jit as the decode scan, so the admission slice and
+        the decode chunk are ONE device launch. The admitting slot is
+        inactive in the decode half's mask, and every attention read is
+        per-row (own slot / own table), so the decode rows' values are
+        bitwise independent of this write — which is what makes hybrid-on
+        token streams bit-exact vs the phase-split path. Returns
+        (last-token logits [1, V], updated cache)."""
+        if isinstance(cache, PagedKVCache):
+            row = jax.lax.dynamic_slice_in_dim(cache.tables, slot, 1, axis=0)
+            sub = PagedKVCache(cache.k, cache.v, row)
+            plog, sub = forward(cfg, params, ptoks, ppos, sub, rope, attn_fn,
+                                col_fn=col_fn, mm=mm, mm_in=mm_in,
+                                moe_impl=moe_impl, last_only=True)
+            return plog[:, -1], PagedKVCache(sub.k, sub.v, cache.tables)
+        sub = KVCache(
+            jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1),
+            jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1),
+        )
+        plog, sub = forward(cfg, params, ptoks, ppos, sub, rope, attn_fn,
+                            col_fn=col_fn, mm=mm, mm_in=mm_in,
+                            moe_impl=moe_impl, last_only=True)
+        return plog[:, -1], KVCache(
+            jax.lax.dynamic_update_slice_in_dim(cache.k, sub.k, slot, axis=1),
+            jax.lax.dynamic_update_slice_in_dim(cache.v, sub.v, slot, axis=1),
+        )
+
+    @classmethod
+    def _hybrid_impl(cls, cfg, attn_fn, col_fn, mm, mm_in, moe_impl, params,
+                     cache, ptoks, slot, ppos, tokens, pos_vec, active, keys,
+                     temps, topps, n, rope, limit):
+        """One fused hybrid step (ISSUE 12): a P-token prefill slice of an
+        admitting slot AND an n-step fused decode chunk in a single jitted
+        launch — a long prompt's admission rides the decode cadence as a
+        bounded per-chunk token budget instead of stalling every decoding
+        slot for a whole separate prefill dispatch. The prefill runs first
+        (its slot is frozen in the decode mask; ordering is value-neutral
+        by per-row isolation, but the threaded cache keeps the device
+        stream sequential either way)."""
+        plog, cache = cls._hybrid_prefill_part(
+            cfg, attn_fn, col_fn, mm, mm_in, moe_impl, params, cache, ptoks,
+            slot, ppos, rope)
+        toks, cache, keys, pos2, last, bad = cls._decode_impl(
+            cfg, attn_fn, col_fn, mm, mm_in, moe_impl, params, cache, tokens,
+            pos_vec, active, keys, temps, topps, n, rope, limit)
+        return plog, toks, cache, keys, pos2, last, bad
+
+    @classmethod
+    def _hybrid_pen_impl(cls, cfg, attn_fn, col_fn, mm, mm_in, moe_impl,
+                         params, cache, ptoks, slot, ppos, tokens, pos_vec,
+                         active, keys, temps, topps, n, rope, limit, counts,
+                         presence, frequency):
+        """Hybrid step over the penalized decode scan (mirrors the
+        _decode/_decode_pen split: penalty-free hybrid serving pays no
+        counts carry)."""
+        plog, cache = cls._hybrid_prefill_part(
+            cfg, attn_fn, col_fn, mm, mm_in, moe_impl, params, cache, ptoks,
+            slot, ppos, rope)
+        toks, cache, keys, pos2, last, counts, bad = cls._decode_penalized_impl(
+            cfg, attn_fn, col_fn, mm, mm_in, moe_impl, params, cache, tokens,
+            pos_vec, active, keys, temps, topps, n, rope, limit, counts,
+            presence, frequency)
+        return plog, toks, cache, keys, pos2, last, counts, bad
 
     @staticmethod
     def _spec_cycle_core(cfg, attn_fn, col_fn, mm, mm_in, moe_impl, k, ngram,
@@ -1792,6 +1879,114 @@ class BatchEngine:
         return DecodeChunk(toks=toks, n=n, start_pos=start_pos, active=active,
                            advance=advance, t0=t0, seq=self.chunk_seq,
                            t_disp=t_disp, bad=bad, bad_inject=bad_inject)
+
+    @property
+    def supports_hybrid(self) -> bool:
+        """Whether hybrid_dispatch can run: the fused step's prefill half
+        is the single-slot B=1 forward, which a dp-sharded batch axis
+        cannot slice (same gate as _use_slot_prefill)."""
+        return self._use_slot_prefill
+
+    def hybrid_dispatch(self, n: int, adm: "Admission",
+                        budget: int) -> DecodeChunk:
+        """Dispatch ONE fused hybrid step (ISSUE 12): an n-step decode
+        chunk for the active slots AND up to `budget` prompt tokens of the
+        in-flight admission `adm`, in a single device launch. The prefill
+        slice is pow2-quantized (same compile-set discipline as add_step)
+        and capped at max_prefill_chunk; `adm.off`/`adm.logits` advance
+        exactly as a same-sized add_step would, so add_commit /
+        resume_commit work unchanged once the admission is fully pumped.
+        Decode semantics are identical to decode_dispatch (per-row freeze,
+        NaN guard, overlap pipelining off the device carry) — the
+        admitting slot is inactive in the decode mask and every attention
+        read is per-slot, so batch-mates' token streams are BIT-EXACT vs
+        the phase-split path. Returns a DecodeChunk whose hybrid_slot /
+        hybrid_tokens record the fused admission work."""
+        faults.fire("engine.decode")
+        faults.fire("engine.prefill")
+        if not self.supports_hybrid:
+            raise ValueError("hybrid step needs an unsharded batch axis "
+                             "(dp meshes keep phase-split admission)")
+        slot = adm.slot
+        assert not self.active[slot], f"slot {slot} is busy"
+        if not self.active.any():
+            raise ValueError("no active slots to fuse with; pump the "
+                             "admission with add_step instead")
+        remaining = len(adm.toks) - adm.off
+        if remaining <= 0:
+            raise ValueError("admission already fully pumped")
+        c = pow2_chunk(min(max(1, int(budget)), remaining),
+                       self.max_prefill_chunk)
+        self._alloc_decode_rows(n)
+        limit = self._row_limit()
+        room = limit[self.active] - self.pos[self.active]
+        n = min(n, int(room.max()))
+        if n <= 0:
+            raise ValueError("every active slot is at its row limit "
+                             "(seq_len, or an exhausted page pool); "
+                             "release first")
+        ppos = int(self.pos[slot])
+        if self.spec_k:
+            # prompt tokens feed the n-gram proposer exactly like add_step
+            self.history = self._hist_write(
+                self.history, jnp.int32(slot), jnp.int32(ppos),
+                jnp.asarray(adm.toks[adm.off : adm.off + c]),
+            )
+        self._sync_vectors()
+        pos_before = self._pos_dev
+        args = (
+            self.params, self.cache,
+            jnp.asarray(adm.toks[adm.off : adm.off + c][None]),
+            jnp.int32(slot),
+            jnp.int32(ppos),
+            self._last_dev[:, None],
+            self._pos_dev,
+            self._active_dev,
+            self._keys_dev,
+            self._temps_dev,
+            self._topp_dev,
+            n,
+            self.rope_cache,
+            self._limit_dev,
+        )
+        t0 = time.perf_counter()
+        t_disp = time.monotonic()
+        if self._counts is not None and (
+            (self.presence[self.active] != 0).any()
+            or (self.frequency[self.active] != 0).any()
+        ):
+            (plog, toks, self.cache, self._keys_dev, self._pos_dev,
+             self._last_dev, self._counts, bad) = self._hybrid_pen(
+                *args, self._counts, self._pres_dev, self._freq_dev)
+        else:
+            (plog, toks, self.cache, self._keys_dev, self._pos_dev,
+             self._last_dev, bad) = self._hybrid(*args)
+        adm.logits = plog  # [1, V] — materializes with the chunk
+        adm.off += c
+        start_pos = self.pos.copy()
+        active = self.active.copy()
+        # the admitting slot's host pos advances with its slice (the device
+        # pos carry keeps its stale inactive row — add_commit/resume_commit
+        # write it surgically at activation, same contract as add_step)
+        self.pos[slot] += c
+        advance = np.where(
+            active, np.clip(limit - start_pos, 0, n), 0
+        ).astype(np.int32)
+        bad_inject = None
+        if faults.flag("decode.nan"):
+            bad_inject = np.zeros(self.n_slots, bool)
+            bad_inject[int(np.flatnonzero(active)[0])] = True
+        if self.spec_k:
+            fits = active & (start_pos + 1 + n <= self.seq_len + 1)
+            self.history = self._hist_write_batch(
+                self.history, toks.T, pos_before, jnp.asarray(fits))
+        self.pos += advance
+        self.chunk_seq += 1
+        ins.PREFILL_TOKENS.inc(c)
+        return DecodeChunk(toks=toks, n=n, start_pos=start_pos, active=active,
+                           advance=advance, t0=t0, seq=self.chunk_seq,
+                           t_disp=t_disp, bad=bad, bad_inject=bad_inject,
+                           hybrid_slot=slot, hybrid_tokens=c)
 
     def _spec_dispatch(self, n_cycles: int) -> DecodeChunk:
         """Dispatch one fused spec CHUNK (decode_dispatch's spec=True

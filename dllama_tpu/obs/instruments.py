@@ -74,6 +74,29 @@ SPEC_ACCEPTED_LENGTH = metrics.histogram(
     "acceptance rate the spec speedup multiplies from)",
     buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16))
 
+# ------------------------------------ hybrid prefill & preemption (ISSUE 12)
+
+PREFILL_BUDGET = metrics.gauge(
+    "dllama_prefill_budget_tokens",
+    "Hybrid chunked prefill: prompt tokens the next fused decode chunk may "
+    "co-process for an admitting request (--prefill-budget; 'auto' is "
+    "steered online by the windowed ITL headroom against --slo-itl-ms; "
+    "0 = legacy phase-split admission)")
+PREEMPTIONS = metrics.counter(
+    "dllama_preemptions_total",
+    "Running requests suspended at a chunk boundary to make room for "
+    "higher-priority work, by reason (slot = a higher-priority request "
+    "needed the slot, capacity = it needed KV pages). The victim's pages "
+    "stay referenced (radix tree / kept rows); it resumes later with its "
+    "recorded PRNG key — byte-identical continuation, near-zero recompute",
+    ("reason",))
+RESUMED = metrics.counter(
+    "dllama_resumed_total",
+    "Preempted requests that re-entered a slot and continued their stream "
+    "(companion of dllama_preemptions_total; a persistent gap between the "
+    "two means preempted work is parked behind sustained higher-priority "
+    "load)")
+
 # -------------------------------------------------- radix prefix cache
 
 RADIX_LOOKUPS = metrics.counter(

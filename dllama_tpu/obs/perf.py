@@ -55,8 +55,12 @@ PEAK_HBM_GBS = 819.0
 
 #: the exclusive states of the scheduler worker loop — the label set of
 #: dllama_scheduler_time_seconds_total{state} and the README ledger table
-#: (scripts/checks.sh asserts the two stay identical)
-LEDGER_STATES = ("idle", "admission", "prefill", "decode_dispatch",
+#: (scripts/checks.sh asserts the two stay identical). `hybrid` is the
+#: dispatch of a fused chunked-prefill+decode step (ISSUE 12): host work
+#: that launches BOTH a prefill slice and a decode chunk in one device
+#: call — neither pure `prefill` nor pure `decode_dispatch`, so it gets
+#: its own bucket instead of polluting either attribution.
+LEDGER_STATES = ("idle", "admission", "prefill", "hybrid", "decode_dispatch",
                  "decode_wait", "emit", "commit", "restart_backoff")
 
 
@@ -457,6 +461,69 @@ class SloPolicy:
         if itl is not None:
             out["itl_ms"] = round(itl, 3)
         return out
+
+
+class PrefillBudgetController:
+    """SLO-driven per-chunk prefill token budget (ISSUE 12): the online
+    controller behind ``--prefill-budget auto``. Each hybrid step fuses up
+    to ``current`` prompt tokens of an admitting request into the decode
+    chunk's device launch; this controller shrinks/grows that budget from
+    the windowed ITL headroom against ``SloPolicy.itl_ms``:
+
+    * p95 ITL over the target (headroom < 0) → HALVE the budget (down to
+      ``lo``): running streams are already missing their SLO, so admissions
+      must slow down, not the decoders.
+    * p95 ITL under ``grow_frac`` of the target (ample headroom) → DOUBLE
+      the budget (up to ``hi``): decoders are comfortably inside SLO, so
+      spend the slack on joiner TTFT.
+    * in between → hold.
+
+    With no ITL target (or an empty window) the controller holds ``start``
+    — auto then behaves as a fixed budget, which is what a server with no
+    SLO configured should do. Budgets move in powers of two so the fused
+    hybrid step's prefill-slice shapes stay in the same small compile set
+    as chunked admission always had (engine.pow2_chunk). Updates are
+    rate-limited to ``interval_s`` so the quantile merge never rides the
+    per-chunk hot path. The current budget is published as the
+    ``dllama_prefill_budget_tokens`` gauge."""
+
+    def __init__(self, slo: SloPolicy | None, *, lo: int = 16,
+                 hi: int = 256, start: int = 64, grow_frac: float = 0.6,
+                 interval_s: float = 0.25, now_fn=time.monotonic):
+        self.slo = slo or SloPolicy()
+        self.lo = max(1, int(lo))
+        self.hi = max(self.lo, int(hi))
+        self.current = min(max(int(start), self.lo), self.hi)
+        self.grow_frac = float(grow_frac)
+        self.interval_s = float(interval_s)
+        self._now = now_fn
+        self._t_last = None
+        ins.PREFILL_BUDGET.set(self.current)
+
+    def update(self, itl_window: "WindowQuantiles") -> int:
+        """Re-evaluate against the window's p95 ITL (seconds); returns the
+        (possibly unchanged) budget. Cheap no-op inside the rate limit."""
+        now = self._now()
+        if self._t_last is not None and now - self._t_last < self.interval_s:
+            return self.current
+        self._t_last = now
+        target = self.slo.itl_ms
+        if target is None:
+            return self.current
+        p95 = itl_window.quantile(0.95)
+        if p95 is None:
+            return self.current
+        p95_ms = p95 * 1000.0
+        if p95_ms > target:
+            nxt = max(self.lo, self.current // 2)
+        elif p95_ms < target * self.grow_frac:
+            nxt = min(self.hi, self.current * 2)
+        else:
+            nxt = self.current
+        if nxt != self.current:
+            self.current = nxt
+            ins.PREFILL_BUDGET.set(nxt)
+        return self.current
 
 
 # ------------------------------------------------------------- aggregator

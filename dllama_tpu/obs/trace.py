@@ -65,6 +65,8 @@ EVENT_CATALOG = {
     "engine.restart": "warm restart after a worker crash: decode state + page pool rebuilt, weights resident; args carry attempt/error (track: scheduler)",
     "request.recovered": "a request survived a warm restart and re-entered a slot; args carry resumed token count (track: requests)",
     "request.timeout": "a request hit its per-request deadline (timeout_s / X-Request-Timeout); args carry where (queued/prefill/decoding) (track: requests)",
+    "request.preempted": "a running request was suspended at a chunk boundary for higher-priority work; its pages stay referenced and it resumes byte-identical later; args carry reason (slot/capacity) + emitted tokens (track: requests)",
+    "request.resumed": "a preempted request re-entered a slot and its stream continued (track: requests)",
 }
 
 

@@ -108,6 +108,48 @@ def _parse_spec_k(body: dict) -> int | None:
     return v
 
 
+#: named priority classes the `priority` body field accepts alongside raw
+#: integers (0=low, 1=normal, 2=high) — the scheduler picks strictly
+#: between classes and may preempt a lower class for a higher one
+PRIORITY_NAMES = {"low": 0, "normal": 1, "high": 2}
+
+
+def _parse_priority(body: dict) -> int:
+    """Scheduling class: `priority` in the request body — 0/'low',
+    1/'normal' (the default), 2/'high'. Higher classes admit strictly
+    first and (with --preempt) may suspend a running lower-class request
+    at a chunk boundary; the suspended stream resumes byte-identical."""
+    v = body.get("priority")
+    if v is None:
+        return 1
+    if isinstance(v, str):
+        if v not in PRIORITY_NAMES:
+            raise ApiError(400, "priority must be an integer 0..2 or one of "
+                                "low|normal|high")
+        return PRIORITY_NAMES[v]
+    try:
+        v = int(v)
+    except (TypeError, ValueError):
+        raise ApiError(400, "priority must be an integer 0..2 or one of "
+                            "low|normal|high") from None
+    if not 0 <= v <= 2:
+        raise ApiError(400, "priority must be an integer 0..2 or one of "
+                            "low|normal|high")
+    return v
+
+
+def _parse_tenant(body: dict) -> str:
+    """Fair-queueing key: `tenant` in the request body — requests of the
+    same tenant share one weighted-fair-queue lane at admission ("" =
+    the anonymous shared tenant; weights via --tenant-weight)."""
+    v = body.get("tenant")
+    if v is None:
+        return ""
+    if not isinstance(v, str) or len(v) > 64:
+        raise ApiError(400, "tenant must be a string of at most 64 chars")
+    return v
+
+
 @dataclass
 class PrefixCache:
     """NaiveCache equivalent: remember the last conversation's messages and
@@ -254,6 +296,8 @@ class ApiServer:
         max_tokens = int(body.get("max_tokens") or body.get("max_completion_tokens") or 0)
         timeout_s = _parse_timeout(body)
         spec_k = _parse_spec_k(body)
+        priority = _parse_priority(body)
+        tenant = _parse_tenant(body)
         extra_stops = body.get("stop") or []
         if isinstance(extra_stops, str):
             extra_stops = [extra_stops]
@@ -263,6 +307,7 @@ class ApiServer:
                 body, messages, temperature, topp, max_tokens, extra_stops, emit,
                 seed=seed, presence=presence, frequency=frequency, probe=probe,
                 req_id=req_id, timeout_s=timeout_s, spec_k=spec_k,
+                priority=priority, tenant=tenant,
             )
 
         self._trace_single_submit(req_id, t_submit)
@@ -341,6 +386,8 @@ class ApiServer:
         on the non-streaming path."""
         _parse_timeout(body)  # a malformed timeout_s is a clean 400 too
         _parse_spec_k(body)  # ...and a malformed spec_k
+        _parse_priority(body)  # ...and a malformed priority
+        _parse_tenant(body)  # ...and a malformed tenant
         if legacy:
             self._normalize_legacy_prompt(body)
             return
@@ -488,7 +535,8 @@ class ApiServer:
     def _complete_batched(self, body, messages, temperature, topp, max_tokens,
                           extra_stops, emit, seed=None, presence=0.0,
                           frequency=0.0, probe=None, req_id: str = "",
-                          timeout_s=None, spec_k=None) -> dict:
+                          timeout_s=None, spec_k=None, priority=1,
+                          tenant="") -> dict:
         """Continuous-batching completion: submit to the scheduler, stream from
         the per-request queue. Per-request `seed` pins the slot's own PRNG
         stream (reproducible regardless of batch-mates). Prefix reuse lives in
@@ -503,7 +551,8 @@ class ApiServer:
             prompt_tokens, temperature, topp, max_tokens,
             self.stops + list(extra_stops), emit,
             seed=seed, presence=presence, frequency=frequency, probe=probe,
-            req_id=req_id, timeout_s=timeout_s, spec_k=spec_k)
+            req_id=req_id, timeout_s=timeout_s, spec_k=spec_k,
+            priority=priority, tenant=tenant)
         return {
             "timings": timings,
             "id": f"chatcmpl-{uuid.uuid4().hex[:16]}",
@@ -527,7 +576,8 @@ class ApiServer:
     def _run_batched(self, prompt_tokens, temperature, topp, max_tokens,
                      stops, emit, seed=None, presence=0.0,
                      frequency=0.0, probe=None, req_id: str = "",
-                     timeout_s=None, spec_k=None) -> tuple[str, str, int, dict]:
+                     timeout_s=None, spec_k=None, priority=1,
+                     tenant="") -> tuple[str, str, int, dict]:
         """Token-level core of a batched completion: submit, stream-decode
         with EOS/stop detection, return (content, finish_reason, n_tokens,
         timings) — `timings` is the request's span-sourced latency object
@@ -557,6 +607,9 @@ class ApiServer:
             # None = the --spec-k serving default (the engine's compiled K);
             # the scheduler clamps explicit values to that capacity
             spec_k=spec_k,
+            # scheduling class + fair-queue tenant (ISSUE 12): the
+            # scheduler's policy pick and preemption read these
+            priority=priority, tenant=tenant,
         )
         parts: list[str] = []
         n_generated = 0
@@ -633,6 +686,8 @@ class ApiServer:
         max_tokens = int(body.get("max_tokens") or 16)  # OpenAI legacy default
         timeout_s = _parse_timeout(body)
         spec_k = _parse_spec_k(body)
+        priority = _parse_priority(body)
+        tenant = _parse_tenant(body)
         extra_stops = body.get("stop") or []
         if isinstance(extra_stops, str):
             extra_stops = [extra_stops]
@@ -644,7 +699,7 @@ class ApiServer:
                 list(extra_stops),  # raw prompt: no chat-template stops
                 emit, seed=seed, presence=presence, frequency=frequency,
                 probe=probe, req_id=req_id, timeout_s=timeout_s,
-                spec_k=spec_k)
+                spec_k=spec_k, priority=priority, tenant=tenant)
         else:
             self._trace_single_submit(req_id, t_submit)
             with self.lock:
@@ -893,6 +948,13 @@ class _Handler(BaseHTTPRequestHandler):
             payload["spec"] = (sched.engine.spec_stats()
                                if hasattr(sched.engine, "spec_stats")
                                else None)
+            # hybrid chunked-prefill + preemption state (ISSUE 12): the
+            # live budget and the lifetime preempt/resume record
+            payload["hybrid"] = {
+                "prefill_budget": getattr(sched, "_budget_now", 0),
+                "preemptions": getattr(sched, "preempt_count", 0),
+                "resumed": getattr(sched, "resume_count", 0),
+            }
         self._send_json(200, payload)
 
     def _debug_get(self) -> None:
@@ -1203,6 +1265,13 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
     if n_slots <= 0 and defaults.get("radix_cache") == "on":
         log.warning("--radix-cache on needs --slots > 0; the single-engine "
                     "tier's NaiveCache has no page pool to share — ignored")
+    if n_slots <= 0 and (defaults.get("prefill_budget") not in (None, "auto")
+                         or defaults.get("preempt") not in (None, "auto")
+                         or defaults.get("tenant_weights")):
+        log.warning("--prefill-budget / --preempt / --tenant-weight need "
+                    "--slots > 0; the single-engine tier serves one request "
+                    "at a time — ignored (priority/tenant body fields are "
+                    "accepted but inert)")
     if n_slots > 0:
         from dllama_tpu.engine.batch import BatchEngine
         from dllama_tpu.serve.scheduler import Scheduler
@@ -1315,6 +1384,14 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
             sched_kw["slo_ttft_ms"] = float(defaults["slo_ttft_ms"])
         if defaults.get("slo_itl_ms") is not None:
             sched_kw["slo_itl_ms"] = float(defaults["slo_itl_ms"])
+        # hybrid chunked prefill (--prefill-budget: auto|N|0) + preemption
+        # (--preempt) + tenant fair-queue weights (--tenant-weight NAME=W)
+        if defaults.get("prefill_budget") is not None:
+            sched_kw["prefill_budget"] = defaults["prefill_budget"]
+        if defaults.get("preempt") is not None:
+            sched_kw["preempt"] = str(defaults["preempt"])
+        if defaults.get("tenant_weights"):
+            sched_kw["tenant_weights"] = dict(defaults["tenant_weights"])
         scheduler = Scheduler(be, **sched_kw)
     api = ApiServer(
         loaded,

@@ -136,6 +136,23 @@ class Request:
     # is the absolute monotonic deadline (submit time + timeout_s).
     timeout_s: float | None = None
     deadline_at: float | None = None
+    # scheduling class & tenant (ISSUE 12): `priority` (0=low, 1=normal,
+    # 2=high — body `priority` field) picks strictly between classes at
+    # admission AND marks a running low-priority request preemptible by a
+    # higher-priority waiter; `tenant` (body field) keys the weighted
+    # fair queue WITHIN a class ("" = the anonymous shared tenant).
+    priority: int = 1
+    tenant: str = ""
+    # preempt-to-pages (ISSUE 12): True between a chunk-boundary suspension
+    # and the re-commit that resumes the stream — the request sits in the
+    # backlog with resume_tokens/resume_key recorded (the same machinery
+    # warm-restart resume uses) while its KV pages stay referenced by the
+    # radix tree (paged) or its kept slot rows (dense)
+    preempted: bool = False
+    # WFQ billing latch: a request's (prompt + max_tokens)/weight cost is
+    # charged to its tenant's virtual time ONCE — resumes/rejoins after
+    # preemption or deferral must not pay again
+    wfq_charged: bool = False
     # warm-restart recovery (set by Scheduler._try_restart, consumed at
     # re-admission): resume_tokens are the tokens already emitted to the
     # client — all but the last are re-prefilled (teacher-forced), the last
@@ -242,7 +259,10 @@ class Scheduler:
                  restart_window_s: float = 60.0,
                  restart_backoff_s: float = 0.5,
                  slo_ttft_ms: float | None = None,
-                 slo_itl_ms: float | None = None):
+                 slo_itl_ms: float | None = None,
+                 prefill_budget: int | str = "auto",
+                 preempt: str = "auto",
+                 tenant_weights: dict[str, float] | None = None):
         self.engine = engine
         self.chunk = chunk
         self.admit_timeout = admit_timeout
@@ -281,10 +301,26 @@ class Scheduler:
         # stall rather than the joiner waiting forever behind a slow batch)
         self.admit_ttft_deadline_ms = admit_ttft_deadline_ms
         self.pending: queue.Queue[Request] = queue.Queue()
+        # scheduling backlog (ISSUE 12): the worker drains `pending` (the
+        # thread-safe intake) into this list at every boundary and picks by
+        # POLICY — priority classes strictly first, weighted fair queueing
+        # across tenants within a class (virtual finish times in
+        # `_tenant_vt`), FIFO within a tenant — instead of the old global
+        # FIFO pop. Preempted requests also park here until capacity and
+        # priority let them resume.
+        self._backlog: list[Request] = []
+        # per-tenant WFQ virtual finish tags + the global virtual clock
+        # (start-time fair queueing): each admission is charged
+        # (prompt + max_tokens) / weight from max(own tag, clock), and the
+        # clock advances to that start — idle time banks no credit
+        self._tenant_vt: dict[str, float] = {}
+        self._vt_now = 0.0
+        self.tenant_weights = dict(tenant_weights or {})
         # capacity-aware admission (paged KV layout): the head request the
-        # page pool cannot yet cover, parked here (NOT back in `pending` —
-        # FIFO order is preserved and later requests wait behind it). Retried
-        # every boundary; released pages / evicted idle caches un-defer it.
+        # page pool cannot yet cover, parked here (NOT back in the backlog —
+        # its admission was already selected by policy and later picks wait
+        # behind it). Retried every boundary; released pages / evicted idle
+        # caches un-defer it.
         self._deferred: Request | None = None
         self.slots: dict[int, Request] = {}
         # admissions being pumped chunk-by-chunk: [(req, Admission), ...];
@@ -364,6 +400,49 @@ class Scheduler:
                 None if slo_ttft_ms is None else float(slo_ttft_ms),
                 None if slo_itl_ms is None else float(slo_itl_ms)),
             cost_model=cost_model)
+        # ---- hybrid chunked prefill (ISSUE 12, --prefill-budget): when a
+        # request is admitting WHILE others decode, each device chunk is a
+        # FUSED hybrid step (engine.hybrid_dispatch) that co-processes up
+        # to `_budget_now` prompt tokens alongside the decode rows — one
+        # launch, no separate prefill dispatch stalling the decoders. This
+        # replaces the interleaved-admission pacing as the mechanism that
+        # protects decoders during a join ("auto"/N; the admit_interleave /
+        # admit_stall_budget_ms knobs now only govern the legacy
+        # prefill_budget=0 phase-split path, kept as the A/B baseline).
+        if prefill_budget is None:
+            prefill_budget = "auto"
+        if isinstance(prefill_budget, str) and prefill_budget != "auto":
+            prefill_budget = int(prefill_budget)
+        self.prefill_budget = prefill_budget  # "auto" | int (0 = legacy)
+        self._hybrid_on = (prefill_budget != 0
+                           and getattr(engine, "supports_hybrid", False))
+        self._budget_ctl = None
+        if not self._hybrid_on:
+            self._budget_now = 0
+            ins.PREFILL_BUDGET.set(0)
+        elif prefill_budget == "auto":
+            # SLO-driven: the windowed ITL headroom against --slo-itl-ms
+            # shrinks/grows the budget online (holds the start value when
+            # no ITL target is configured)
+            self._budget_ctl = perf.PrefillBudgetController(
+                self.perf.slo,
+                hi=max(64, int(getattr(engine, "max_prefill_chunk", 256))))
+            self._budget_now = self._budget_ctl.current
+        else:
+            self._budget_now = max(1, int(prefill_budget))
+            ins.PREFILL_BUDGET.set(self._budget_now)
+        # ---- preempt-to-pages (ISSUE 12, --preempt): a running request may
+        # be suspended at a chunk boundary when a STRICTLY higher-priority
+        # request is waiting and blocked (no free slot, or the deferred
+        # head is capacity-starved). Suspension releases the slot while the
+        # pages stay referenced — radix tree (paged) or kept rows (dense) —
+        # and the stream resumes byte-identical via the warm-restart resume
+        # machinery. "auto" = on; "off" disables.
+        if preempt not in ("auto", "on", "off"):
+            raise ValueError(f"preempt must be auto|on|off, got {preempt!r}")
+        self._preempt_on = preempt != "off"
+        self.preempt_count = 0  # lifetime totals (latency_summary/health)
+        self.resume_count = 0
         # worker heartbeat: stamped once per loop iteration. A device call
         # that hangs stops the heartbeat while work exists — which is exactly
         # the condition the watchdog turns into "stalled".
@@ -388,7 +467,8 @@ class Scheduler:
                seed: int | None = None, presence: float = 0.0,
                frequency: float = 0.0, req_id: str = "",
                timeout_s: float | None = None,
-               spec_k: int | None = None) -> Request:
+               spec_k: int | None = None,
+               priority: int = 1, tenant: str = "") -> Request:
         self.check_admission()
         # per-request speculation: None keeps the engine default (every
         # greedy request speculates at the engine's K — the pre-ISSUE-11
@@ -399,7 +479,8 @@ class Scheduler:
         req = Request(list(prompt), float(temperature), float(topp), int(max_tokens),
                       frozenset(eos_ids), seed=seed, presence=float(presence),
                       frequency=float(frequency), submitted_at=time.monotonic(),
-                      req_id=req_id, spec_k=spec_k)
+                      req_id=req_id, spec_k=spec_k,
+                      priority=int(priority), tenant=str(tenant))
         if timeout_s is not None and timeout_s > 0:
             req.timeout_s = float(timeout_s)
             req.deadline_at = req.submitted_at + req.timeout_s
@@ -460,7 +541,7 @@ class Scheduler:
         """Whether the worker owes anyone progress (watchdog gating: an idle
         worker parked on its wake event must never read as stalled)."""
         return (bool(self.slots) or bool(self._inflight)
-                or bool(self._recover)
+                or bool(self._recover) or bool(self._backlog)
                 or self._deferred is not None or not self.pending.empty())
 
     def health(self) -> dict:
@@ -501,6 +582,15 @@ class Scheduler:
             "restarts": self.restart_count,
             "restart_max": self.restart_max,
             "recovering": len(self._recover),
+            # hybrid chunked prefill + preemption (ISSUE 12): the live
+            # per-chunk budget (0 = legacy phase-split), lifetime
+            # preempt/resume totals, and how many suspended requests are
+            # parked in the backlog awaiting resume
+            "prefill_budget": self._budget_now,
+            "preemptions": self.preempt_count,
+            "resumed": self.resume_count,
+            "preempted_waiting": sum(
+                1 for r in list(self._backlog) if r.preempted),
         }
 
     def drain(self, timeout_s: float = 30.0) -> bool:
@@ -591,6 +681,18 @@ class Scheduler:
             # tokens_per_cycle is the realized batch speedup per forward
             "spec": self.engine.spec_stats()
             if hasattr(self.engine, "spec_stats") else None,
+            # hybrid chunked prefill + preemption (ISSUE 12): the live
+            # budget and the lifetime preempt/resume record — the host-side
+            # view of dllama_prefill_budget_tokens / dllama_preemptions_
+            # total / dllama_resumed_total
+            "hybrid": {
+                "prefill_budget": self._budget_now,
+                "mode": ("off" if not self._hybrid_on
+                         else ("auto" if self._budget_ctl is not None
+                               else "fixed")),
+                "preemptions": self.preempt_count,
+                "resumed": self.resume_count,
+            },
         }
 
     def reset_latency_stats(self) -> None:
@@ -788,11 +890,14 @@ class Scheduler:
         return {s: int(n) for s, n in zip(donors, lens)}
 
     def _queue_depth(self) -> int:
-        """Requests owed service but not yet admitted: the pending queue,
-        the capacity-deferred head, and any restart-recovered requests
-        awaiting re-admission (one definition for the gauge, /health, and
-        the --max-queue shed bound — they must not disagree)."""
-        return (self.pending.qsize() + (1 if self._deferred is not None else 0)
+        """Requests owed service but not yet admitted: the pending intake
+        queue, the policy backlog (incl. preempted requests awaiting
+        resume), the capacity-deferred head, and any restart-recovered
+        requests awaiting re-admission (one definition for the gauge,
+        /health, and the --max-queue shed bound — they must not
+        disagree)."""
+        return (self.pending.qsize() + len(self._backlog)
+                + (1 if self._deferred is not None else 0)
                 + len(self._recover))
 
     def _reclaim_pages(self, needed: int) -> bool:
@@ -859,6 +964,12 @@ class Scheduler:
                 keep = [r for r in q if not expired(r)]
                 q.clear()
                 q.extend(keep)
+        if any(expired(r) for r in self._backlog):
+            # the policy backlog too — incl. preempted requests whose
+            # deadline passed while suspended (a clean 'timeout' finish;
+            # their already-emitted tokens stand)
+            dead.extend(r for r in self._backlog if expired(r))
+            self._backlog = [r for r in self._backlog if not expired(r)]
         if self._deferred is not None and expired(self._deferred):
             dead.append(self._deferred)
             self._deferred = None
@@ -868,8 +979,172 @@ class Scheduler:
         for req in dead:
             self._shed_timeout(req)
 
-    def _admit_starts(self) -> None:
+    # --------------------------------------- scheduling policy (ISSUE 12)
+
+    def _drain_pending(self) -> None:
+        """Move intake-queue arrivals into the policy backlog (worker-side
+        only; submit() keeps the thread-safe Queue as its entry point)."""
+        while True:
+            try:
+                self._backlog.append(self.pending.get_nowait())
+            except queue.Empty:
+                return
+
+    def _tenant_weight(self, tenant: str) -> float:
+        return max(float(self.tenant_weights.get(tenant, 1.0)), 1e-6)
+
+    def _select_next(self) -> Request | None:
+        """Policy pick from the backlog: the highest priority class
+        present; within it the tenant with the smallest WFQ virtual time;
+        within a tenant, FIFO. Pops and returns the pick (None when the
+        backlog is empty). Cancelled/expired entries are popped too — the
+        caller's existing terminal handling covers them."""
+        if not self._backlog:
+            return None
+        best_i = 0
+        best_key = None
+        for i, r in enumerate(self._backlog):
+            key = (-int(r.priority), self._tenant_vt.get(r.tenant, 0.0), i)
+            if best_key is None or key < best_key:
+                best_key, best_i = key, i
+        return self._backlog.pop(best_i)
+
+    def _charge_tenant(self, req: Request) -> None:
+        """Start-time fair queueing charge at admission: the request's
+        start tag is max(its tenant's own finish tag, the global virtual
+        clock `_vt_now`), its tenant's finish tag advances by
+        (prompt + max_tokens) / weight from there, and the clock advances
+        to the start tag. A tenant returning from idle therefore gets one
+        immediate pick and then competes from 'now' — idle time banks no
+        credit, which is what bounds any backlogged tenant's wait to its
+        fair share (the starvation bound the tests drive). Charged ONCE
+        per request lifetime: a preempted request resuming (or a deferred
+        head rejoining the backlog) was already paid for; billing it again
+        would compound the very deprioritization that suspended it."""
+        if req.wfq_charged:
+            return
+        req.wfq_charged = True
+        # start-time fair queueing: the admission's start tag is
+        # max(tenant's own finish tag, the global virtual clock) and the
+        # clock advances to that start — a tenant returning from idle is
+        # snapped to 'now' (one immediate pick, then fair share; idle time
+        # banks no credit), while a fresh system stays at clock 0 so
+        # weights bite from the first admission
+        own = self._tenant_vt.get(req.tenant, 0.0)
+        start = max(own, self._vt_now)
+        cost = (len(req.prompt) + max(int(req.max_tokens), 1))
+        self._tenant_vt[req.tenant] = (
+            start + cost / self._tenant_weight(req.tenant))
+        self._vt_now = start
+
+    def _record_resume(self, req: Request, slot: int) -> bool:
+        """Stamp `req` with its bit-exact resume record off `slot`'s
+        settled state: the emitted tokens and the PRNG key advanced to the
+        interruption point — advanced by the tokens emitted SINCE the last
+        (re)commit only (after a prior resume, keys[slot] is already an
+        advanced key; replaying the cumulative produced-1 would
+        double-count and silently break sampled-stream resume). The ONE
+        definition site for the resume invariant, shared by preemption and
+        warm-restart recovery. Returns False when the emit records
+        disagree (no trustworthy resume exists)."""
+        emitted = self.slot_tokens.get(slot, [])[len(req.prompt):]
+        if req.produced < 1 or len(emitted) != req.produced:
+            return False
+        req.resume_tokens = list(emitted)
+        req.resume_key = self._advance_key(
+            self.engine.keys[slot], req.produced - 1 - req.key_advances)
+        req.key_advances = req.produced - 1
+        return True
+
+    def _preempt(self, req: Request, reason: str) -> bool:
+        """Suspend a RUNNING request at this (settled) chunk boundary:
+        record its resume point — emitted tokens + PRNG key advanced to the
+        interruption, exactly the warm-restart resume record — then release
+        the slot while the KV pages stay referenced: the radix tree adopts
+        the written prefix on the paged layout (resume later maps it back
+        by refcount, near-zero recompute — only a partial boundary page
+        re-prefills), the kept slot rows serve the same role on dense. The
+        request parks in the backlog; policy decides when it resumes.
+        Returns False when the request has no trustworthy resume record
+        (safer to let it run)."""
+        slot = req.slot
+        if not self._record_resume(req, slot):
+            return False
+        req.preempted = True
+        rows = int(self.engine.pos[slot])
+        if self._radix is not None:
+            self.engine.radix_insert(slot, self.slot_tokens[slot][:rows])
+            self.engine.release(slot, None)
+            self.slot_tokens[slot] = []
+        else:
+            self.engine.release(slot, rows)
+            self.slot_tokens[slot] = self.slot_tokens.get(slot, [])[:rows]
+        self.slots.pop(slot, None)
+        req.slot = -1
+        self._backlog.append(req)
+        self.preempt_count += 1
+        ins.BUSY_SLOTS.set(len(self.slots))
+        ins.PREEMPTIONS.labels(reason=reason).inc()
+        trace.TRACER.event("request.preempted", cat="scheduling",
+                           track="requests", req_id=req.req_id,
+                           reason=reason, tokens=req.produced)
+        log.info("preempted request (reason=%s, %d tokens emitted; pages "
+                 "stay referenced)", reason, req.produced,
+                 extra={"request_id": req.req_id})
+        return True
+
+    def _maybe_preempt(self) -> None:
+        """Boundary preemption check: when a STRICTLY higher-priority
+        request is waiting and blocked — no free slot (reason='slot'), or
+        the capacity-deferred head out-ranks a runner (reason='capacity') —
+        suspend the lowest-priority running request (most recently admitted
+        among ties: least sunk work lost). At most one preemption per
+        boundary; admission this same boundary reuses the freed slot and
+        pages."""
+        if not self._preempt_on or not self.slots:
+            return
+        now = time.monotonic()
+        waiting = [r for r in self._backlog + self._recover
+                   + ([self._deferred] if self._deferred is not None else [])
+                   if not r.cancelled.is_set()
+                   and (r.deadline_at is None or now < r.deadline_at)]
+        if not waiting:
+            return
+        top = max(waiting, key=lambda r: int(r.priority))
+        victims = [r for r in self.slots.values()
+                   if int(r.priority) < int(top.priority)
+                   and not r.cancelled.is_set()]
+        if not victims:
+            return
+        reserved = {adm.slot for _, adm, _ in self._inflight}
+        free_slots = sum(1 for s in range(self.engine.n_slots)
+                         if not self.engine.active[s] and s not in reserved)
+        if free_slots <= 0:
+            reason = "slot"
+        elif (self._deferred is not None
+              and int(self._deferred.priority) >= int(top.priority)):
+            # a slot is free but the highest-priority waiter is parked on
+            # KV-page capacity: freeing a low-priority runner's pages (its
+            # release hands them to the tree, where admission reclaim can
+            # evict them) is the only lever besides waiting
+            reason = "capacity"
+        else:
+            return
+        victim = min(victims,
+                     key=lambda r: (int(r.priority),
+                                    -(r.admitted_at or 0.0)))
+        self._preempt(victim, reason)
+
+    def _admit_starts(self, boundary: bool = True) -> None:
         """Pop pending requests into in-flight admissions while slots allow.
+
+        ``boundary=False`` is the overlapped-loop fast path (hybrid only):
+        admission STARTS are safe off an in-flight non-spec chunk —
+        add_begin's device work is surgical per-row/page updates composed
+        on the carry, and the admitting slot is inactive in the chunk — so
+        a new request's first hybrid slice dispatches as the very next
+        successor instead of draining the pipeline first. Preemption is
+        skipped there (releasing a RUNNING slot needs settled mirrors).
 
         Paged layout: admission capacity is FREE PAGES, not free slots — a
         request whose prompt (+ one decode page) the pool cannot cover first
@@ -877,10 +1152,22 @@ class Scheduler:
         `_deferred` (FIFO head; later requests wait behind it) until
         releases free capacity. Shedding still applies while it waits: the
         deferred request counts toward --max-queue depth."""
+        self._drain_pending()
         self._shed_expired_queued()
+        if boundary:
+            self._maybe_preempt()
+        if (self._deferred is not None and self._backlog
+                and max(int(r.priority) for r in self._backlog)
+                > int(self._deferred.priority)):
+            # priority-inversion guard: a capacity-parked lower-priority
+            # head must not gate a higher-priority arrival — it rejoins the
+            # policy backlog and competes from there (its pages were never
+            # held; deferral is a wait, not a reservation)
+            self._backlog.append(self._deferred)
+            self._deferred = None
         reserved = len(self._inflight)
         while (self._recover or self._deferred is not None
-               or not self.pending.empty()):
+               or self._backlog):
             if int((~self.engine.active).sum()) - reserved <= 0:
                 return
             from_recover = False
@@ -893,10 +1180,10 @@ class Scheduler:
             elif self._deferred is not None:
                 req, self._deferred = self._deferred, None
             else:
-                try:
-                    req = self.pending.get_nowait()
-                except queue.Empty:
+                req = self._select_next()
+                if req is None:
                     return
+                self._charge_tenant(req)
             if req.cancelled.is_set():
                 req.finish_reason = req.cancel_reason
                 req.finished_at = time.monotonic()
@@ -1035,12 +1322,138 @@ class Scheduler:
             reason = "error"
         self._finish(req, reason)
 
+    def _commit_admission(self, req: Request, adm, reuse: int) -> None:
+        """Commit the HEAD in-flight admission (fully pumped): activate the
+        slot, emit the first token (fresh admissions) or install the resume
+        carry (restart/preemption resumes), insert radix prefixes, and do
+        the recovery/resume accounting. Callable from the boundary pump AND
+        opportunistically from the overlapped loop while the admission's
+        last (non-spec) chunk is still in flight — the admitting slot is
+        inactive in that chunk and every commit-side device write is a
+        surgical per-row update off the carry, so committing early is
+        value-safe and saves a full pipeline drain (the joiner's first
+        token goes out as soon as its logits materialize, and running
+        streams never eat the boundary's idle window)."""
+        self.ledger.transition("commit")
+        # popped ONCE, up front: a failure anywhere below leaves the tuple
+        # in the CALLER's hands (its except aborts this request), never a
+        # second pop eating the NEXT admission's entry
+        assert self._inflight and self._inflight[0][1] is adm
+        self._inflight.pop(0)
+        if req.resume_tokens is not None:
+            # restart/preemption resume: install the last emitted token and
+            # the recorded PRNG key as the decode carry — no new token is
+            # sampled, so the client's stream continues exactly where it
+            # was cut
+            self.engine.resume_commit(
+                adm, req.resume_tokens[-1], req.resume_key,
+                req.temperature, req.topp,
+                presence=req.presence, frequency=req.frequency,
+                counted=(req.resume_tokens[:-1]
+                         if (req.presence or req.frequency)
+                         else None),
+                spec_k=req.spec_k)
+            self.slot_tokens[adm.slot] = (list(req.prompt)
+                                          + list(req.resume_tokens))
+            self.slots[adm.slot] = req
+            if self._radix is not None:
+                # resumed streams re-enter the tree too: rows written =
+                # prompt + all but the unfed last resume token (so a SECOND
+                # resume of a shared prefix maps instead of re-prefilling)
+                if reuse:
+                    self._radix.note_served(reuse)
+                self.engine.radix_insert(
+                    adm.slot,
+                    list(req.prompt) + list(req.resume_tokens[:-1]))
+            trace.TRACER.req_prefill_done(
+                req.req_id, tokens=len(adm.toks) + reuse,
+                reused=reuse)
+        else:
+            first = self.engine.add_commit(adm, req.temperature,
+                                           req.topp,
+                                           seed=req.seed,
+                                           presence=req.presence,
+                                           frequency=req.frequency,
+                                           spec_k=req.spec_k)
+            self.reused_prefix_tokens += reuse  # rows really served
+            ins.REUSED_PREFIX_TOKENS.inc(reuse)
+            self.slot_tokens[adm.slot] = list(req.prompt)
+            self.slots[adm.slot] = req
+            if self._radix is not None:
+                # saved-prefill accounting at commit (rows REALLY served),
+                # and the prompt's full pages enter the tree NOW —
+                # concurrent requests sharing a system prompt hit it while
+                # this one is still decoding
+                if reuse:
+                    self._radix.note_served(reuse)
+                self.engine.radix_insert(adm.slot, req.prompt)
+            trace.TRACER.req_prefill_done(
+                req.req_id, tokens=len(req.prompt), reused=reuse)
+            self._emit(req, first, int(self.engine.pos[adm.slot]))
+        if req.recovered:
+            # counted at the moment the request really made it back into a
+            # slot (not at restart time — it could still fail or cancel
+            # during re-admission)
+            req.recovered = False
+            ins.REQUESTS_RECOVERED.inc()
+            trace.TRACER.event("request.recovered",
+                               cat="supervision", track="requests",
+                               req_id=req.req_id,
+                               tokens=req.produced)
+        elif req.preempted:
+            # a preempted request is back in a slot and its stream
+            # continues (byte-identical to uninterrupted)
+            req.preempted = False
+            self.resume_count += 1
+            ins.RESUMED.inc()
+            trace.TRACER.event("request.resumed",
+                               cat="scheduling", track="requests",
+                               req_id=req.req_id,
+                               tokens=req.produced)
+
+    def _commit_ready_inflight(self) -> None:
+        """Opportunistic early commit (overlapped loop): while the chunk in
+        flight is a plain/hybrid (non-spec) chunk, a fully-pumped head
+        admission can commit NOW — blocking only on its own logits (which
+        materialize with that chunk) instead of draining the pipeline for a
+        whole boundary. Spec chunks are excluded: their data-dependent
+        position advance must settle before any host-side slot activation
+        touches shared state."""
+        while self._inflight:
+            req, adm, reuse = self._inflight[0]
+            now = time.monotonic()
+            if (adm.off < len(adm.toks) or req.cancelled.is_set()
+                    or (req.deadline_at is not None
+                        and now >= req.deadline_at)):
+                return  # mid-pump or needs abort handling at a boundary
+            try:
+                self._commit_admission(req, adm, reuse)
+            except Exception as e:
+                log.exception("commit failed",
+                              extra={"request_id": req.req_id})
+                # _commit_admission pops up front, so the head here is the
+                # NEXT admission — pop only if the failure preceded the pop
+                if self._inflight and self._inflight[0][1] is adm:
+                    self._inflight.pop(0)
+                self._abort_admission(req, adm, e)
+
+    def _hybrid_now(self) -> bool:
+        """Whether in-flight admissions ride fused hybrid chunks right now:
+        the hybrid step is enabled AND there are decoders to fuse with
+        (with no decoders the legacy pump IS the fast path — nothing to
+        protect, prefill at full speed)."""
+        return self._hybrid_on and bool(self.slots)
+
     def _pump_admissions(self) -> bool:
-        """Advance in-flight admissions: when interleaving, pump prefill
-        chunks of the head admission until the stall budget is spent (decode
-        chunks run between calls); when not, the whole queue. An admission
-        past the TTFT deadline ignores the budget and pumps to completion.
-        Returns True if any admission work ran."""
+        """Advance in-flight admissions. Under the hybrid step (ISSUE 12)
+        an admission's prefill rides the fused decode chunks instead —
+        this pump then only COMMITS fully-pumped admissions (and applies
+        the hard TTFT-deadline override). On the legacy phase-split path
+        (--prefill-budget 0, or no decoders): when interleaving, pump
+        prefill chunks of the head admission until the stall budget is
+        spent (decode chunks run between calls); when not, the whole
+        queue. An admission past the TTFT deadline ignores the budget and
+        pumps to completion. Returns True if any admission work ran."""
         worked = False
         t0 = time.monotonic()
         while self._inflight:
@@ -1059,99 +1472,56 @@ class Scheduler:
                                    where="prefill")
                 self._abort_admission(req, adm, "timeout")
                 continue
+            pumped = adm.off >= len(adm.toks)
+            if not pumped and self._hybrid_now():
+                # the fused hybrid chunks carry this prefill (budget tokens
+                # per chunk, _dispatch_chunk) — nothing to pump here unless
+                # the hard TTFT deadline says finish it NOW despite the
+                # decoders (the one pacing override that survives hybrid)
+                overdue = (
+                    self.admit_ttft_deadline_ms is not None
+                    and (time.monotonic() - req.submitted_at) * 1000.0
+                    >= self.admit_ttft_deadline_ms)
+                if not overdue:
+                    return worked
             try:
                 tr = trace.TRACER
-                t_ch = tr.now() if tr.enabled else 0.0
-                self.ledger.transition("prefill")
-                done = self.engine.add_step(adm)
-                if self.slots and adm.logits is not None:
-                    # sync whenever decoders could stall: JAX dispatch is
-                    # async, so without this the pacing clock AND the
-                    # admission-gap metric would see host dispatch time only
-                    # (near zero on TPU) while the chunk's device time
-                    # silently serialized into the next decode chunk —
-                    # under-pacing the budget and mis-attributing the stall.
-                    # Applied in every admission mode so the sync/strict/
-                    # paced A/B compares like with like; the chunk must
-                    # finish before the next decode chunk anyway (same
-                    # device stream). With no decoders there is no stall to
-                    # attribute and dispatch stays pipelined.
-                    jax.block_until_ready(adm.logits)
-                if tr.enabled:
-                    tr.span_at("prefill.chunk", t_ch, tr.now(), cat="prefill",
-                               track="scheduler", req_id=req.req_id,
-                               slot=adm.slot, off=int(adm.off),
-                               total=len(adm.toks))
-                worked = True
+                done = pumped
+                if not pumped:
+                    t_ch = tr.now() if tr.enabled else 0.0
+                    self.ledger.transition("prefill")
+                    done = self.engine.add_step(adm)
+                    if self.slots and adm.logits is not None:
+                        # sync whenever decoders could stall: JAX dispatch is
+                        # async, so without this the pacing clock AND the
+                        # admission-gap metric would see host dispatch time
+                        # only (near zero on TPU) while the chunk's device
+                        # time silently serialized into the next decode
+                        # chunk — under-pacing the budget and mis-attributing
+                        # the stall. Applied in every admission mode so the
+                        # sync/strict/paced A/B compares like with like; the
+                        # chunk must finish before the next decode chunk
+                        # anyway (same device stream). With no decoders there
+                        # is no stall to attribute and dispatch stays
+                        # pipelined.
+                        jax.block_until_ready(adm.logits)
+                    if tr.enabled:
+                        tr.span_at("prefill.chunk", t_ch, tr.now(),
+                                   cat="prefill", track="scheduler",
+                                   req_id=req.req_id, slot=adm.slot,
+                                   off=int(adm.off), total=len(adm.toks))
+                    worked = True
                 if done:
-                    self.ledger.transition("commit")
-                    if req.resume_tokens is not None:
-                        # restart resume: install the last emitted token and
-                        # the recorded PRNG key as the decode carry — no new
-                        # token is sampled, so the client's stream continues
-                        # exactly where the crash cut it
-                        self.engine.resume_commit(
-                            adm, req.resume_tokens[-1], req.resume_key,
-                            req.temperature, req.topp,
-                            presence=req.presence, frequency=req.frequency,
-                            counted=(req.resume_tokens[:-1]
-                                     if (req.presence or req.frequency)
-                                     else None),
-                            spec_k=req.spec_k)
-                        self._inflight.pop(0)
-                        self.slot_tokens[adm.slot] = (list(req.prompt)
-                                                      + list(req.resume_tokens))
-                        self.slots[adm.slot] = req
-                        if self._radix is not None:
-                            # resumed streams re-enter the tree too: rows
-                            # written = prompt + all but the unfed last
-                            # resume token (so a SECOND resume of a shared
-                            # prefix maps instead of re-prefilling)
-                            if reuse:
-                                self._radix.note_served(reuse)
-                            self.engine.radix_insert(
-                                adm.slot,
-                                list(req.prompt) + list(req.resume_tokens[:-1]))
-                        trace.TRACER.req_prefill_done(
-                            req.req_id, tokens=len(adm.toks) + reuse,
-                            reused=reuse)
-                    else:
-                        first = self.engine.add_commit(adm, req.temperature,
-                                                       req.topp,
-                                                       seed=req.seed,
-                                                       presence=req.presence,
-                                                       frequency=req.frequency,
-                                                       spec_k=req.spec_k)
-                        self._inflight.pop(0)
-                        self.reused_prefix_tokens += reuse  # rows really served
-                        ins.REUSED_PREFIX_TOKENS.inc(reuse)
-                        self.slot_tokens[adm.slot] = list(req.prompt)
-                        self.slots[adm.slot] = req
-                        if self._radix is not None:
-                            # saved-prefill accounting at commit (rows REALLY
-                            # served), and the prompt's full pages enter the
-                            # tree NOW — concurrent requests sharing a system
-                            # prompt hit it while this one is still decoding
-                            if reuse:
-                                self._radix.note_served(reuse)
-                            self.engine.radix_insert(adm.slot, req.prompt)
-                        trace.TRACER.req_prefill_done(
-                            req.req_id, tokens=len(req.prompt), reused=reuse)
-                        self._emit(req, first, int(self.engine.pos[adm.slot]))
-                    if req.recovered:
-                        # counted at the moment the request really made it
-                        # back into a slot (not at restart time — it could
-                        # still fail or cancel during re-admission)
-                        req.recovered = False
-                        ins.REQUESTS_RECOVERED.inc()
-                        trace.TRACER.event("request.recovered",
-                                           cat="supervision", track="requests",
-                                           req_id=req.req_id,
-                                           tokens=req.produced)
+                    self._commit_admission(req, adm, reuse)
             except Exception as e:
                 log.exception("prefill failed",
                               extra={"request_id": req.req_id})
-                self._inflight.pop(0)
+                # add_step failures leave the head in place; a commit
+                # failure reaches here with it already popped by
+                # _commit_admission — pop only our own tuple, never the
+                # next admission's
+                if self._inflight and self._inflight[0][1] is adm:
+                    self._inflight.pop(0)
                 self._abort_admission(req, adm, e)
                 continue
             if not (self.admit_interleave and self.slots):
@@ -1207,6 +1577,9 @@ class Scheduler:
         for req in self._recover:
             self._fail_req(req, exc)
         self._recover = []
+        for req in self._backlog:
+            self._fail_req(req, exc)
+        self._backlog = []
         for req in list(self.slots.values()):
             self._fail_req(req, exc)
         self.slots.clear()
@@ -1350,24 +1723,16 @@ class Scheduler:
         recover: list[Request] = []
         for slot, req in sorted(self.slots.items(),
                                 key=lambda kv: kv[1].submitted_at):
-            emitted = self.slot_tokens.get(slot, [])[len(req.prompt):]
+            ok = self._record_resume(req, slot)
             req.slot = -1
-            if req.produced < 1 or len(emitted) != req.produced:
+            if not ok:
                 # bookkeeping drift between the emit records — resuming
                 # could duplicate or drop tokens; fail this one request
                 self._fail_req(req, RuntimeError(
                     "request not recoverable across engine restart "
-                    f"(emitted-token record {len(emitted)} != produced "
+                    "(emitted-token record disagrees with produced "
                     f"{req.produced})"))
                 continue
-            req.resume_tokens = list(emitted)
-            # advance by the tokens emitted SINCE the last (re)commit only:
-            # after a prior resume, keys[slot] is already an advanced key —
-            # replaying the cumulative produced-1 would double-count
-            req.resume_key = self._advance_key(
-                self.engine.keys[slot],
-                req.produced - 1 - req.key_advances)
-            req.key_advances = req.produced - 1
             req.recovered = True
             recover.append(req)
         self.slots.clear()
@@ -1409,9 +1774,26 @@ class Scheduler:
         admission pumps are serialized at chunk consumption points."""
         if self._stop.is_set():
             return True
-        if (not self.slots or self._inflight or self._deferred is not None
-                or self._recover or not self.pending.empty()):
+        if (not self.slots or self._deferred is not None
+                or self._recover or self._backlog
+                or not self.pending.empty()):
             return True
+        if self._inflight:
+            # hybrid admissions ride the pipelined chunks — no boundary
+            # needed while the head is mid-prefill and healthy. Commit,
+            # abort (cancel/deadline), and the TTFT-deadline override all
+            # need settled state, so those drain the pipeline.
+            if not self._hybrid_now():
+                return True
+            req, adm, _ = self._inflight[0]
+            now0 = time.monotonic()
+            if (adm.off >= len(adm.toks) or req.cancelled.is_set()
+                    or (req.deadline_at is not None
+                        and now0 >= req.deadline_at)
+                    or (self.admit_ttft_deadline_ms is not None
+                        and (now0 - req.submitted_at) * 1000.0
+                        >= self.admit_ttft_deadline_ms)):
+                return True
         now = time.monotonic()
         if any(r.cancelled.is_set()
                or (r.deadline_at is not None and now >= r.deadline_at)
@@ -1494,10 +1876,24 @@ class Scheduler:
         tokens ride the unconsumed chunk) fails fast with
         finish_reason='error' and /health goes unhealthy (the process
         supervisor owns the restart)."""
-        self.ledger.transition("decode_dispatch")
+        # hybrid step (ISSUE 12): while the head admission is mid-prefill
+        # and decoders exist, every chunk is a FUSED hybrid dispatch that
+        # carries up to `_budget_now` of its prompt tokens — no separate
+        # prefill launch ever stalls the decode cadence. Hybrid chunks are
+        # plain (non-spec) chunks; an in-flight spec chunk drains through
+        # the same mode-switch bail as spec<->plain.
+        hyb_adm = None
+        if self._hybrid_now() and self._inflight:
+            _req, _adm, _ = self._inflight[0]
+            if (_adm.off < len(_adm.toks) and not _req.cancelled.is_set()
+                    and (_req.deadline_at is None
+                         or time.monotonic() < _req.deadline_at)):
+                hyb_adm = _adm
+        self.ledger.transition("hybrid" if hyb_adm is not None
+                               else "decode_dispatch")
         use_spec = False
         alternating = False
-        if getattr(self.engine, "spec_k", 0):
+        if getattr(self.engine, "spec_k", 0) and hyb_adm is None:
             # speculate while some live slot can actually accept drafts;
             # sampled, penalized, and spec_k=0 traffic rides the cycles one
             # token at a time (per-slot eligibility, resolved on device)
@@ -1533,10 +1929,36 @@ class Scheduler:
                    for req in self.slots.values()):
                 n_disp = 1
         self._observe_host_gap(pipeline_empty, exclude_gap_s)
+
+        def _launch():
+            if hyb_adm is None:
+                return self.engine.decode_dispatch(n_disp, spec=use_spec)
+            if self._budget_ctl is not None:
+                # SLO-driven budget: re-evaluated against the live ITL
+                # window (rate-limited inside the controller)
+                self._budget_now = self._budget_ctl.update(self.perf.itl)
+            try:
+                return self.engine.hybrid_dispatch(n_disp, hyb_adm,
+                                                   self._budget_now)
+            except faults.InjectedFault as e:
+                if e.point != "engine.prefill":
+                    raise  # decode-point drills keep the fatal contract
+                # the per-request admission-failure contract survives
+                # hybrid: the engine.prefill drill fires BEFORE
+                # hybrid_dispatch mutates any state, so the engine is
+                # clean — fail just the joiner and dispatch a plain chunk
+                # for the batch. (A GENUINE failure inside the fused
+                # launch is indistinguishable from a decode failure — the
+                # jit donates the cache — and stays engine-fatal, handled
+                # by warm restart.)
+                req, adm, _reuse = self._inflight.pop(0)
+                self._abort_admission(req, adm, e)
+                return self.engine.decode_dispatch(n_disp, spec=False)
+
         tr = trace.TRACER
         if tr.enabled:
             t0 = tr.now()
-            chunk = self.engine.decode_dispatch(n_disp, spec=use_spec)
+            chunk = _launch()
             # the dispatch span: pure host work. Under overlap it lands
             # INSIDE the previous chunk's decode.device span — the
             # interleaving scripts/trace_smoke.sh asserts on.
@@ -1544,11 +1966,21 @@ class Scheduler:
                        track="scheduler", chunk=chunk.seq, n=chunk.n,
                        occupancy=len(self.slots), spec=use_spec,
                        pipelined=not pipeline_empty,
+                       hybrid_tokens=(chunk.hybrid_tokens or None),
                        host_gap_ms=(None if self._last_gap_ms is None
                                     else round(self._last_gap_ms, 3)))
+            if chunk.hybrid_tokens:
+                # the flight recorder's prefill story stays complete under
+                # hybrid: each fused slice is a prefill.chunk span for the
+                # ADMITTING request, bracketing the dispatch
+                _req = self._inflight[0][0] if self._inflight else None
+                tr.span_at("prefill.chunk", t0, tr.now(), cat="prefill",
+                           track="scheduler",
+                           req_id=_req.req_id if _req else "",
+                           slot=chunk.hybrid_slot, off=int(hyb_adm.off),
+                           total=len(hyb_adm.toks), hybrid=True)
             return chunk, dict(self.slots)
-        return (self.engine.decode_dispatch(n_disp, spec=use_spec),
-                dict(self.slots))
+        return _launch(), dict(self.slots)
 
     def _consume_chunk(self, chunk, snapshot) -> None:
         """Block on a dispatched chunk's tokens and emit them to the
@@ -1640,6 +2072,20 @@ class Scheduler:
                 # the emit/EOS Python work below then runs concurrently with
                 # device compute — unless boundary work needs the settled,
                 # fully-consumed state first.
+                if self._hybrid_on and not pending[0].spec:
+                    # early commit + early admission start (ISSUE 12): a
+                    # fully-pumped admission activates its slot NOW
+                    # (blocking only on its own logits), and a queued
+                    # arrival enters _inflight so its FIRST hybrid slice
+                    # rides the very next successor dispatch — neither
+                    # pays a full pipeline drain. Preemption and the other
+                    # release-side boundary work still wait for settled
+                    # state.
+                    if self._inflight:
+                        self._commit_ready_inflight()
+                    if self._backlog or not self.pending.empty():
+                        self.ledger.transition("admission")
+                        self._admit_starts(boundary=False)
                 nxt = (None if self._needs_boundary(pending[0])
                        else self._dispatch_chunk(pipeline_empty=False,
                                                  inflight=pending[0]))
@@ -1744,6 +2190,9 @@ class Scheduler:
         for req in self._recover:
             cut(req)
         self._recover = []
+        for req in self._backlog:
+            cut(req)
+        self._backlog = []
         while True:
             try:
                 cut(self.pending.get_nowait())

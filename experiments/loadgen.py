@@ -1,0 +1,278 @@
+"""Seeded multi-tenant open-loop load generator with SLO-attainment curves
+(ISSUE 12).
+
+Closed-loop drivers (submit, wait, submit again) hide overload: the arrival
+rate collapses to whatever the server sustains, so tail latency looks flat
+right up to the cliff. This generator is OPEN-LOOP — each tenant's arrivals
+are a seeded Poisson process whose rate does NOT react to completions — so
+saturation shows up where it belongs: in the latency distribution and the
+SLO-attainment curve, not in a silently reduced offered load.
+
+Per tenant: arrival rate (req/s), a prompt/output length mix (bounded
+uniform), a priority class, and an optional deadline fraction. The report
+is per-tenant AND aggregate:
+
+* offered vs completed request counts, finish-reason histogram;
+* TTFT / e2e percentiles (p50/p95/p99);
+* **SLO-attainment curves**: for a sweep of TTFT and ITL targets, the
+  fraction of finished requests that met each target — the whole latency
+  CDF as operators consume it, not one aggregate tok/s number that hides
+  the tail;
+* preempt/resume and prefill-budget counters from the scheduler, so a
+  priority mix shows WHAT the scheduler did, not just how it felt.
+
+CLI (tiny synthetic model, CPU-friendly)::
+
+    JAX_PLATFORMS=cpu python experiments/loadgen.py \
+        --duration 20 --seed 0 --prefill-budget auto --out /tmp/loadgen.json
+
+Library use: ``run_loadgen(sched, tenants, duration_s, seed)`` against any
+Scheduler — tests/test_hybrid.py and bench.py reuse pieces of it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: default TTFT / ITL target sweeps (ms) for the attainment curves —
+#: log-spaced to cover interactive (10 ms) through batch (10 s) regimes
+TTFT_TARGETS_MS = (10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000)
+ITL_TARGETS_MS = (5, 10, 25, 50, 100, 250, 500, 1000)
+
+
+@dataclass
+class TenantSpec:
+    """One tenant's open-loop traffic: Poisson arrivals at `rate_rps`,
+    prompts/outputs drawn uniformly from the given ranges."""
+
+    name: str
+    rate_rps: float
+    prompt_len: tuple[int, int] = (8, 24)
+    max_tokens: tuple[int, int] = (4, 12)
+    priority: int = 1
+    temperature: float = 0.0
+    weight: float = 1.0
+
+
+@dataclass
+class _Flight:
+    req: object
+    tenant: str
+    t_submit: float
+    tokens: list = field(default_factory=list)
+    shed: str | None = None
+
+
+def _percentiles(xs: list[float]) -> dict:
+    if not xs:
+        return {"count": 0, "p50": None, "p95": None, "p99": None}
+    s = sorted(xs)
+
+    def q(p):
+        r = p * (len(s) - 1)
+        lo = int(r)
+        hi = min(lo + 1, len(s) - 1)
+        return round(s[lo] + (s[hi] - s[lo]) * (r - lo), 3)
+
+    return {"count": len(s), "p50": q(0.5), "p95": q(0.95), "p99": q(0.99)}
+
+
+def _attainment(samples_ms: list[float], targets_ms) -> list[dict]:
+    """The SLO-attainment curve: fraction of samples at or under each
+    target. Empty sample sets answer None (unknowable, not 100%)."""
+    out = []
+    for t in targets_ms:
+        if not samples_ms:
+            out.append({"target_ms": t, "attainment": None})
+        else:
+            ok = sum(1 for v in samples_ms if v <= t)
+            out.append({"target_ms": t,
+                        "attainment": round(ok / len(samples_ms), 4)})
+    return out
+
+
+def run_loadgen(sched, tenants: list[TenantSpec], duration_s: float,
+                seed: int = 0, vocab: int = 90) -> dict:
+    """Drive `sched` with open-loop multi-tenant traffic for `duration_s`,
+    then wait for the tail and report. Deterministic arrival/shape schedule
+    per (seed, tenants); completions are of course machine-dependent."""
+    rng = random.Random(seed)
+    flights: list[_Flight] = []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def consume(fl: _Flight):
+        try:
+            for t in fl.req.tokens():
+                fl.tokens.append(t)
+        except Exception as e:  # shed/error/shutdown — recorded, not raised
+            fl.shed = type(e).__name__
+
+    def tenant_driver(spec: TenantSpec, sub_seed: int):
+        r = random.Random(sub_seed)
+        t_end = time.monotonic() + duration_s
+        while not stop.is_set() and time.monotonic() < t_end:
+            # open loop: the NEXT arrival is scheduled regardless of how
+            # the previous request is doing
+            time.sleep(min(r.expovariate(max(spec.rate_rps, 1e-6)), 5.0))
+            if stop.is_set() or time.monotonic() >= t_end:
+                return
+            plen = r.randint(*spec.prompt_len)
+            prompt = [(r.randrange(vocab)) + 1 for _ in range(plen)]
+            fl = _Flight(req=None, tenant=spec.name,
+                         t_submit=time.monotonic())
+            try:
+                fl.req = sched.submit(
+                    prompt, spec.temperature, 0.9,
+                    r.randint(*spec.max_tokens), frozenset(),
+                    seed=r.randrange(1 << 30), priority=spec.priority,
+                    tenant=spec.name)
+            except Exception as e:  # admission shed (429/503 analog)
+                fl.shed = type(e).__name__
+                with lock:
+                    flights.append(fl)
+                continue
+            with lock:
+                flights.append(fl)
+            threading.Thread(target=consume, args=(fl,), daemon=True).start()
+
+    drivers = [threading.Thread(target=tenant_driver, args=(s, seed * 977 + i),
+                                daemon=True)
+               for i, s in enumerate(tenants)]
+    t0 = time.monotonic()
+    for d in drivers:
+        d.start()
+    for d in drivers:
+        d.join(timeout=duration_s + 30)
+    # let the in-flight tail finish (bounded)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        with lock:
+            live = [f for f in flights
+                    if f.shed is None and f.req.finish_reason is None]
+        if not live:
+            break
+        time.sleep(0.05)
+    stop.set()
+    wall = time.monotonic() - t0
+
+    def report_for(sel: list[_Flight]) -> dict:
+        done = [f for f in sel if f.shed is None
+                and f.req.finish_reason is not None]
+        ttft = [f.req.ttft_ms for f in done if f.req.ttft_ms is not None]
+        itl = [f.req.itl_ms for f in done if f.req.itl_ms is not None]
+        e2e = [(f.req.finished_at - f.req.submitted_at) * 1000.0
+               for f in done if f.req.finished_at is not None]
+        reasons: dict[str, int] = {}
+        for f in sel:
+            key = f.shed or f.req.finish_reason or "unfinished"
+            reasons[key] = reasons.get(key, 0) + 1
+        return {
+            "offered": len(sel),
+            "completed": len(done),
+            "finish_reasons": reasons,
+            "ttft_ms": _percentiles(ttft),
+            "itl_ms": _percentiles(itl),
+            "e2e_ms": _percentiles(e2e),
+            "tokens": sum(len(f.tokens) for f in sel),
+            "slo_attainment": {
+                "ttft": _attainment(ttft, TTFT_TARGETS_MS),
+                "itl": _attainment(itl, ITL_TARGETS_MS),
+            },
+        }
+
+    with lock:
+        all_f = list(flights)
+    out = {
+        "seed": seed,
+        "duration_s": round(wall, 3),
+        "tenants": {s.name: {"rate_rps": s.rate_rps,
+                             "priority": s.priority,
+                             **report_for([f for f in all_f
+                                           if f.tenant == s.name])}
+                    for s in tenants},
+        "aggregate": report_for(all_f),
+        "tok_s": round(sum(len(f.tokens) for f in all_f) / max(wall, 1e-9),
+                       3),
+    }
+    summary = sched.latency_summary()
+    out["hybrid"] = summary.get("hybrid")
+    out["scheduler"] = {
+        "preemptions": getattr(sched, "preempt_count", 0),
+        "resumed": getattr(sched, "resume_count", 0),
+        "prefill_budget": getattr(sched, "_budget_now", None),
+    }
+    return out
+
+
+DEFAULT_TENANTS = [
+    # interactive: short prompts, high priority, modest rate
+    TenantSpec("interactive", rate_rps=2.0, prompt_len=(4, 10),
+               max_tokens=(3, 6), priority=2),
+    # chat: the bulk of traffic
+    TenantSpec("chat", rate_rps=3.0, prompt_len=(8, 24),
+               max_tokens=(4, 10), priority=1, temperature=0.8),
+    # batch: long prompts, low priority — the preemption donor
+    TenantSpec("batch", rate_rps=1.0, prompt_len=(24, 40),
+               max_tokens=(8, 16), priority=0),
+]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--duration", type=float, default=15.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--chunk", type=int, default=3)
+    ap.add_argument("--prefill-budget", default="auto")
+    ap.add_argument("--slo-itl-ms", type=float, default=None)
+    ap.add_argument("--slo-ttft-ms", type=float, default=None)
+    ap.add_argument("--out", default=None, help="write the JSON report here")
+    args = ap.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    from dllama_tpu.engine.batch import BatchEngine
+    from dllama_tpu.models.config import LlamaConfig
+    from dllama_tpu.models.llama import random_params
+    from dllama_tpu.serve.scheduler import Scheduler
+
+    cfg = LlamaConfig(dim=64, hidden_dim=128, n_layers=2, n_heads=4,
+                      n_kv_heads=2, vocab_size=96, seq_len=128)
+    params = random_params(cfg, seed=3, dtype=jnp.float32, quantize=False)
+    eng = BatchEngine(cfg, params, n_slots=args.slots,
+                      cache_dtype=jnp.float32, kv_layout="paged",
+                      page_size=8, max_prefill_chunk=16)
+    budget = args.prefill_budget
+    if budget != "auto":
+        budget = int(budget)
+    sched = Scheduler(eng, chunk=args.chunk, prefill_budget=budget,
+                      slo_itl_ms=args.slo_itl_ms,
+                      slo_ttft_ms=args.slo_ttft_ms)
+    try:
+        warm = sched.submit([1, 2, 3], 0.0, 0.9, 2, frozenset(), seed=7)
+        list(warm.tokens())
+        sched.reset_latency_stats()
+        report = run_loadgen(sched, DEFAULT_TENANTS, args.duration,
+                             seed=args.seed)
+    finally:
+        sched.shutdown()
+    text = json.dumps(report, indent=2)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -54,6 +54,13 @@ RULES: list[tuple[str, str, float]] = [
     ("paged_kernel.pages.*.tok_s_ratio_kernel_gather", "higher", 0.50),
     ("batch.*.agg_tok_s", "higher", 0.20),
     ("admission.stall_reduction_x", "higher", 0.50),
+    # ISSUE 12 hybrid fused step: the stall a joining prompt inflicts on
+    # running streams must stay collapsed (ratio vs the sync baseline,
+    # normalized) and the joiner's TTFT overhead must stay bounded; the
+    # during-admission ITL tail gates down like the slo record's
+    ("hybrid.stall_reduction_x", "higher", 0.50),
+    ("hybrid.ttft_overhead_x", "lower", 0.35),
+    ("hybrid.hybrid_itl_p95_ms", "lower", 0.50),
     # ISSUE 11 speculative continuous batching: the serving tier must keep
     # its spec-over-plain win on the draftable leg, and a spec neighbor
     # must never collapse the non-spec slots' throughput on the mixed leg

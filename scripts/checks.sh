@@ -41,12 +41,16 @@ for name in metrics.REGISTRY.names():
 # ...the speculative-decoding acceptance series are what
 # scripts/spec_smoke.sh and the bench spec_batch record assert on
 # (ISSUE 11): removal from the registry must fail here too
+# ...and the hybrid/preemption series are what scripts/hybrid_smoke.sh and
+# the bench hybrid record assert on (ISSUE 12): removal must fail here too
 for name in ("dllama_kv_pages_total", "dllama_kv_pages_used",
              "dllama_kv_pages_shared",
              "dllama_radix_lookups_total", "dllama_radix_hit_tokens_total",
              "dllama_radix_nodes", "dllama_radix_pages",
              "dllama_spec_cycles_total", "dllama_spec_tokens_total",
-             "dllama_spec_accepted_length"):
+             "dllama_spec_accepted_length",
+             "dllama_prefill_budget_tokens", "dllama_preemptions_total",
+             "dllama_resumed_total"):
     if name not in metrics.REGISTRY.names():
         missing.append(f"unregistered:{name}")
 for name in sorted(trace.SPAN_CATALOG):
@@ -144,3 +148,19 @@ if extra or missing:
 print(f"checks: paged kernel AOT registration + routing table OK "
       f"({len(routes)} routes)")
 PY
+
+# hybrid chunked prefill + preemption (ISSUE 12): the bench record and the
+# perf gate rules must keep covering the fused-step regression surface, and
+# the smoke target must keep existing. Textual (sub-second) checks.
+grep -q "def bench_hybrid" bench.py || {
+    echo "checks: bench.py lost its hybrid record (bench_hybrid)" >&2
+    exit 1; }
+grep -q "hybrid.stall_reduction_x" experiments/perfdiff.py || {
+    echo "checks: perfdiff rules lost hybrid.stall_reduction_x" >&2
+    exit 1; }
+grep -q "hybrid.ttft_overhead_x" experiments/perfdiff.py || {
+    echo "checks: perfdiff rules lost hybrid.ttft_overhead_x" >&2; exit 1; }
+test -x scripts/hybrid_smoke.sh || {
+    echo "checks: scripts/hybrid_smoke.sh missing or not executable" >&2
+    exit 1; }
+echo "checks: hybrid record + perf-gate rules + smoke target OK"

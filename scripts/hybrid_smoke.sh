@@ -1,0 +1,210 @@
+#!/usr/bin/env bash
+# hybrid_smoke.sh — end-to-end hybrid chunked-prefill + preemption smoke
+# target (ISSUE 12).
+#
+# Boots `python -m dllama_tpu serve` (the real CLI, not an in-process
+# server) on a freshly generated tiny fixture model with a FIXED
+# --prefill-budget, then:
+#
+#   * streams a long-running completion and admits a LONG prompt mid-stream:
+#     asserts the running stream KEPT EMITTING inside the joiner's
+#     admission window (tokens arrive between the join's submit and its
+#     first token — the fused hybrid step never freezes decoders for a
+#     whole prefill) with a bounded max inter-token gap, and that the
+#     dllama_prefill_budget_tokens gauge reports the armed budget;
+#   * fills both slots with priority-0 streams and fires a priority-high
+#     completion: asserts a preemption fires (dllama_preemptions_total),
+#     the suspended stream RESUMES and finishes its full budget
+#     (dllama_resumed_total), and GET /debug/kv still audits clean —
+#     preempt-to-pages released the slot without corrupting the pool;
+#   * finishes with a SIGTERM drain.
+#
+# SMOKE TARGET, not a pytest test (lives outside tests/, exempt from the
+# tier-1 run). CPU-only, ~2 min. Exit 0 = PASS.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import http.client
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.getcwd())
+from tests.test_serve import make_tiny_files  # the tier-1 fixture model
+
+tmp = tempfile.mkdtemp(prefix="dllama_hybrid_smoke_")
+mpath, tpath, _cfg = make_tiny_files(__import__("pathlib").Path(tmp))
+
+with socket.socket() as s:  # pick a free port
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+
+proc = subprocess.Popen(
+    [sys.executable, "-m", "dllama_tpu", "serve", "--model", mpath,
+     "--tokenizer", tpath, "--slots", "2", "--port", str(port),
+     "--prefill-budget", "16", "--preempt", "on"],
+    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+)
+
+LONG = ("alpha beta gamma delta epsilon zeta eta theta iota kappa "
+        "lambda mu nu xi omicron pi rho sigma tau upsilon phi chi psi "
+        "omega one two three four five six seven eight nine ten eleven")
+# the measured join: SAME words reordered — identical token count (so the
+# warm join above compiles every hybrid slice shape the measured one
+# needs) but a different prefix (so the radix cache cannot map it and the
+# admission really prefills)
+LONG2 = " ".join(reversed(LONG.split()))
+
+
+def get(path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read().decode()
+    conn.close()
+    return r.status, body
+
+
+def metric(text, name):
+    m = re.search(rf"^{name} ([0-9.e+-]+)$", text, re.M)
+    return float(m.group(1)) if m else 0.0
+
+
+def labeled(text, name):
+    return sum(float(m) for m in
+               re.findall(rf'^{name}\{{[^}}]*\}} ([0-9.e+-]+)$', text, re.M))
+
+
+def complete(body, out):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+    conn.request("POST", "/v1/chat/completions", json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    payload = json.loads(resp.read())
+    conn.close()
+    assert resp.status == 200, f"completion -> {resp.status}: {payload}"
+    out.append(payload)
+
+
+def stream(body, stamps, done):
+    """SSE client: stamp every delta arrival (perf_counter)."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+    conn.request("POST", "/v1/chat/completions",
+                 json.dumps({**body, "stream": True}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200, f"stream -> {resp.status}"
+    for raw in resp:
+        for line in raw.splitlines():
+            if line.startswith(b"data: ") and b"delta" in line:
+                stamps.append(time.perf_counter())
+    conn.close()
+    done.set()
+
+
+try:
+    deadline = time.time() + 120
+    while True:
+        try:
+            if get("/health/ready")[0] == 200:
+                break
+        except OSError:
+            pass
+        if proc.poll() is not None:
+            sys.exit("FAIL: server exited before becoming ready")
+        if time.time() > deadline:
+            sys.exit("FAIL: server never became ready")
+        time.sleep(0.25)
+
+    # ---- warm-up: compile the decode AND hybrid-slice shapes (a warm
+    # stream + a mid-stream join) so the measured leg times serving, not XLA
+    warm_out = []
+    st1, d1 = [], threading.Event()
+    t = threading.Thread(target=stream, args=(
+        {"messages": [{"role": "user", "content": "warm stream"}],
+         "max_tokens": 48, "temperature": 0.0}, st1, d1))
+    t.start()
+    time.sleep(0.5)
+    complete({"messages": [{"role": "user", "content": LONG}],
+              "max_tokens": 2, "temperature": 0.0}, warm_out)
+    d1.wait(timeout=240)
+    t.join(timeout=10)
+
+    # ---- measured leg: long prompt admitted mid-stream
+    stamps, done = [], threading.Event()
+    t = threading.Thread(target=stream, args=(
+        {"messages": [{"role": "user", "content": "tell me a story"}],
+         "max_tokens": 64, "temperature": 0.0}, stamps, done))
+    t.start()
+    while len(stamps) < 4:  # the stream is really decoding
+        assert not done.is_set(), "probe stream finished before the join"
+        time.sleep(0.01)
+    t_sub = time.perf_counter()
+    join_out = []
+    complete({"messages": [{"role": "user", "content": LONG2}],
+              "max_tokens": 2, "temperature": 0.0}, join_out)
+    ttft_ms = join_out[0]["timings"]["ttft_ms"]
+    done.wait(timeout=240)
+    t.join(timeout=10)
+    t_first = t_sub + ttft_ms / 1000.0
+    during = [ts for ts in stamps if t_sub <= ts <= t_first]
+    assert len(during) >= 1, (
+        f"running stream froze for the whole admission (ttft {ttft_ms}ms, "
+        f"0 tokens in the window) — hybrid step not engaging?")
+    gaps = [(b - a) * 1000.0 for a, b in zip(stamps, stamps[1:])
+            if a >= t_sub and b <= t_first + 0.2]
+    assert not gaps or max(gaps) < 2000.0, f"unbounded ITL gap: {max(gaps)}ms"
+
+    st, m1 = get("/metrics")
+    assert st == 200
+    assert metric(m1, "dllama_prefill_budget_tokens") == 16.0, (
+        "dllama_prefill_budget_tokens gauge missing or not armed")
+
+    # ---- preemption leg: both slots busy at priority 0, a high-priority
+    # completion preempts one, the victim resumes and finishes
+    sa, da = [], threading.Event()
+    sb, db = [], threading.Event()
+    ta = threading.Thread(target=stream, args=(
+        {"messages": [{"role": "user", "content": "low one"}],
+         "max_tokens": 48, "temperature": 0.0, "priority": 0}, sa, da))
+    tb = threading.Thread(target=stream, args=(
+        {"messages": [{"role": "user", "content": "low two"}],
+         "max_tokens": 48, "temperature": 0.0, "priority": 0}, sb, db))
+    ta.start(); tb.start()
+    while len(sa) < 2 or len(sb) < 2:
+        time.sleep(0.01)
+    hi_out = []
+    complete({"messages": [{"role": "user", "content": "urgent"}],
+              "max_tokens": 4, "temperature": 0.0, "priority": "high"},
+             hi_out)
+    da.wait(timeout=240); db.wait(timeout=240)
+    ta.join(timeout=10); tb.join(timeout=10)
+
+    st, m2 = get("/metrics")
+    assert st == 200
+    pre = labeled(m2, "dllama_preemptions_total")
+    res = metric(m2, "dllama_resumed_total")
+    assert pre >= 1, "no preemption fired for the high-priority request"
+    assert res >= 1, "preempted stream never resumed"
+
+    st, kv = get("/debug/kv")
+    kv = json.loads(kv)
+    assert st == 200 and kv["audit"]["ok"], f"/debug/kv audit: {kv}"
+    print(f"PASS: hybrid serve OK — {len(during)} tokens flowed during a "
+          f"{ttft_ms:.0f}ms admission (budget gauge 16), "
+          f"{pre:.0f} preemption(s) with {res:.0f} resume(s); "
+          f"/debug/kv audit clean")
+finally:
+    proc.send_signal(signal.SIGTERM)  # exercises the graceful drain path
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+PY

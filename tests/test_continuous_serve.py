@@ -240,8 +240,10 @@ def test_interleaved_admission_matches_synchronous_and_records_stalls():
     def run(interleave):
         eng = BatchEngine(cfg, params, n_slots=2, cache_dtype=jnp.float32,
                           max_prefill_chunk=8)
+        # prefill_budget=0 pins the legacy phase-split path this test
+        # A/Bs (the hybrid fused step is covered by tests/test_hybrid.py)
         sched = Scheduler(eng, chunk=2, admit_interleave=interleave,
-                          admit_stall_budget_ms=0.0)
+                          admit_stall_budget_ms=0.0, prefill_budget=0)
         try:
             r1 = sched.submit([1, 2, 3], 0.0, 0.9, 40, eos_ids=frozenset(), seed=1)
             it = r1.tokens()
@@ -284,7 +286,8 @@ def test_admission_pacing_budget_and_deadline():
     def run(**kw):
         eng = BatchEngine(cfg, params, n_slots=2, cache_dtype=jnp.float32,
                           max_prefill_chunk=8)
-        sched = Scheduler(eng, chunk=2, **kw)
+        # prefill_budget=0: this test drives the legacy pacing knobs
+        sched = Scheduler(eng, chunk=2, prefill_budget=0, **kw)
         try:
             r1 = sched.submit([1, 2, 3], 0.0, 0.9, 40, eos_ids=frozenset(), seed=1)
             it = r1.tokens()
@@ -314,7 +317,7 @@ def test_admission_pacing_budget_and_deadline():
     eng = BatchEngine(cfg, params, n_slots=3, cache_dtype=jnp.float32,
                       max_prefill_chunk=8)
     sched = Scheduler(eng, chunk=2, admit_stall_budget_ms=1e9,
-                      admit_ttft_deadline_ms=0.0)
+                      admit_ttft_deadline_ms=0.0, prefill_budget=0)
     try:
         r1 = sched.submit([1, 2, 3], 0.0, 0.9, 40, eos_ids=frozenset(), seed=1)
         it = r1.tokens()

@@ -363,6 +363,13 @@ def test_deferred_request_survives_restart():
     try:
         warm = sched.submit([5, 6], 0.0, 0.9, 2, frozenset())
         list(warm.tokens())  # compile warm-up
+        # slow every decode chunk a little: on a compile-warm CPU r1's whole
+        # 8-token run takes ~3 fast chunks, so the window in which r2 sits
+        # capacity-deferred is a few ms — narrower than the poll below, and
+        # the test raced it (the pre-existing tier-1 flake this fixes). The
+        # delay pins the deferred window open for ~hundreds of ms without
+        # changing any scheduling semantics.
+        faults.install("engine.decode", "delay", ms=30, times=40)
         # budget 8: prompt 40 + at most 7 resumed rows needs 7 pages incl.
         # the decode reserve, so the resume ALWAYS fits the 8-page pool no
         # matter how far r1 got before the crash
@@ -377,7 +384,7 @@ def test_deferred_request_survives_restart():
         deadline = _t.monotonic() + 30
         while not sched.health()["admission_deferred"]:
             assert _t.monotonic() < deadline, "admission never deferred"
-            _t.sleep(0.01)
+            _t.sleep(0.002)
         faults.install("scheduler.loop", "raise", times=1)
         out1 = list(it1)
         out2 = list(r2.tokens())
