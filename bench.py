@@ -65,6 +65,10 @@ Env knobs:
                        (scheduler-level spec-on vs spec-off on repetitive
                        text + a mixed spec/non-spec leg with per-class
                        tok/s and bit-exactness checks)
+  BENCH_COMPILE        '0': skip the compile & device-traffic record
+                       (cold-boot compile seconds, warmup-on vs warmup-off
+                       first-request TTFT, and the steady-state zero-
+                       recompile / zero-upload gate over a 200-token decode)
 """
 
 import json
@@ -1051,6 +1055,120 @@ def bench_hybrid(cfg, params, n_slots=None, prompt_len=None, chunk=None,
     return out
 
 
+def bench_compile(cfg, params, n_slots=2, chunk=4, steps=200, pf_chunk=64):
+    """Compile & device-traffic record (ISSUE 13), three legs:
+
+    * **cold** — scheduler boots with ``--warmup off``; the first request's
+      TTFT carries every XLA compile (``cold_ttft_ms``), and the compile
+      ledger's seconds delta is the cold-boot compile bill
+      (``cold_compile_s``).
+    * **warm** — a fresh engine boots with ``--warmup auto`` (its compile
+      bill moves to boot: ``warmup_s``, ``warmup_buckets``,
+      ``warmup_full_coverage``); the first request must then compile
+      NOTHING (``warm_first_request_compiles``) and
+      ``warmup_ttft_ratio = warm/cold`` is the headline TTFT win the
+      perfdiff gate pins.
+    * **steady** — a 200-token decode driven at the ENGINE level (the
+      worker is shut down first, so snapshots can't race it): after one
+      warm chunk and a page pre-grow, the measured window must record
+      ZERO compiles (unexpected or otherwise) and ZERO host->device upload
+      bytes — the PR 3 device-resident-state invariant plus the bounded
+      compiled-shape universe, both as absolute perfdiff ceilings. The
+      window runs under ``transfer_guard='strict'``, so an implicit upload
+      would fail the leg loudly, not just move a counter.
+
+    CPU hosts shrink to the HYBRID_FIXTURE model (same precedent as
+    bench_hybrid: the record measures scheduling/compile behavior, not
+    model FLOPs). BENCH_COMPILE=0 skips."""
+    import jax
+    import jax.numpy as jnp
+
+    from dllama_tpu.engine.batch import BatchEngine
+    from dllama_tpu.models.config import LlamaConfig
+    from dllama_tpu.models.llama import random_params
+    from dllama_tpu.obs import compile as cobs
+    from dllama_tpu.serve.scheduler import Scheduler
+
+    fixture = jax.default_backend() == "cpu"
+    if fixture:
+        cfg = LlamaConfig(**HYBRID_FIXTURE)
+        params = random_params(cfg, seed=3, dtype=jnp.float32, quantize=False)
+        cache_dtype = jnp.float32
+    else:
+        cache_dtype = jnp.bfloat16
+    steps = min(steps, cfg.seq_len - 32)
+    out = {"slots": n_slots, "chunk": chunk, "steps": steps,
+           "fixture": fixture, "layout": "paged/64"}
+    prompt = [int(x) % (cfg.vocab_size - 2) + 1 for x in range(7, 15)]
+
+    def boot_and_first(warmup: str):
+        eng = BatchEngine(cfg, params, n_slots=n_slots,
+                          cache_dtype=cache_dtype, max_prefill_chunk=pf_chunk,
+                          kv_layout="paged", page_size=64,  # serving default
+                          attn_impl=os.environ.get("BENCH_ATTN", "auto"))
+        s0 = cobs.LEDGER.total_seconds()
+        sched = Scheduler(eng, chunk=chunk, warmup=warmup)
+        boot_compile_s = cobs.LEDGER.total_seconds() - s0
+        c0 = cobs.LEDGER.total_compiles()
+        r = sched.submit(prompt, 0.0, 0.9, 2 * chunk, frozenset(), seed=1)
+        toks = list(r.tokens())
+        assert len(toks) == 2 * chunk
+        first_compiles = cobs.LEDGER.total_compiles() - c0
+        first_compile_s = cobs.LEDGER.total_seconds() - s0 - boot_compile_s
+        return sched, (r.ttft_ms or 0.0), boot_compile_s, first_compiles, \
+            first_compile_s
+
+    # ---- cold leg: first request pays the compile bill
+    sched, ttft, boot_s, n_first, s_first = boot_and_first("off")
+    sched.shutdown()
+    out["cold_ttft_ms"] = round(ttft, 1)
+    out["cold_compile_s"] = round(boot_s + s_first, 3)
+    out["cold_first_request_compiles"] = n_first
+    # ---- warm leg: the bill moves to boot; first request compiles nothing
+    sched, ttft, boot_s, n_first, _ = boot_and_first("auto")
+    rep = sched.warmup_report or {}
+    out["warmup_s"] = rep.get("seconds")
+    out["warmup_buckets"] = rep.get("buckets")
+    out["warmup_full_coverage"] = bool(rep.get("full_coverage"))
+    out["warm_ttft_ms"] = round(ttft, 1)
+    out["warm_first_request_compiles"] = n_first
+    if out["cold_ttft_ms"]:
+        out["warmup_ttft_ratio"] = round(
+            out["warm_ttft_ms"] / max(out["cold_ttft_ms"], 0.05), 3)
+    # ---- steady leg: engine-level (no worker to race), strict guard
+    sched.shutdown()
+    eng = sched.engine
+    eng.add(0, prompt, temperature=0.0, seed=2)
+    eng.decode(chunk)  # one warm chunk past the admission boundary
+    eng._alloc_decode_rows(steps + 2 * chunk)  # pre-grow: page allocation
+    # is an amortized boundary event, not per-chunk traffic — provision the
+    # window so the gate measures the steady path alone
+    warm = eng.decode(chunk)  # consume the pre-grow's vector refresh
+    assert warm.shape[0] == chunk
+    eng.transfer_guard = "strict"
+    cobs.reset_transfers()
+    c0, u0 = cobs.LEDGER.total_compiles(), cobs.LEDGER.total_unexpected()
+    n_chunks = max(1, steps // chunk)
+    pending = eng.decode_dispatch(chunk)
+    for _ in range(n_chunks - 1):  # overlapped: successor off the carry
+        nxt = eng.decode_dispatch(chunk)
+        eng.decode_consume(pending)
+        pending = nxt
+    eng.decode_consume(pending)
+    tr = cobs.transfer_snapshot()
+    out["steady"] = {
+        "chunks": n_chunks,
+        "decode_tokens": n_chunks * chunk,
+        "compiles": cobs.LEDGER.total_compiles() - c0,
+        "unexpected_compiles": cobs.LEDGER.total_unexpected() - u0,
+        "upload_bytes": tr["h2d"]["bytes"],
+        "upload_transfers": tr["h2d"]["count"],
+        "download_bytes": tr["d2h"]["bytes"],
+        "transfer_guard": "strict",
+    }
+    return out
+
+
 def bench_overlap(cfg, params, n_slots=8, chunk=4, steps=48, pf_chunk=64):
     """Overlap A/B for the serving tier: aggregate decode tok/s and the
     inter-chunk host gap with the scheduler's overlapped dispatch on vs off
@@ -1960,6 +2078,19 @@ def worker():
         except Exception as e:
             hybrid_rec = {"error": repr(e)[:200]}
 
+    # compile & device-traffic record (ISSUE 13): cold vs warmed-boot
+    # first-request TTFT + the steady-state zero-recompile / zero-upload
+    # gate; BENCH_COMPILE=0 skips
+    compile_rec = None
+    if (sweep_on and admit_params is not None
+            and os.environ.get("BENCH_COMPILE") != "0"
+            and time.monotonic() < deadline - 90):
+        try:
+            compile_rec = bench_compile(LlamaConfig(**PRESETS[sweep_on]),
+                                        admit_params)
+        except Exception as e:
+            compile_rec = {"error": repr(e)[:200]}
+
     # paged-attention route A/B: jnp gather vs the fused flash-decode
     # kernel at 2-3 page sizes (ISSUE 8); BENCH_PAGED_KERNEL=0 skips
     paged_kernel_ab = None
@@ -2011,6 +2142,7 @@ def worker():
         "moe": moe,
         "admission": admit,
         "hybrid": hybrid_rec,
+        "compile": compile_rec,
         "overlap": overlap_ab,
         "trace": trace_ab,
         "paged": paged_ab,

@@ -163,6 +163,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "field) within each priority class — repeatable, "
                         "e.g. --tenant-weight paid=4 --tenant-weight free=1; "
                         "unlisted tenants weigh 1")
+    p.add_argument("--warmup", choices=["auto", "off"], default="off",
+                   help="serve mode, needs --slots > 0: precompile the "
+                        "declared compiled-shape universe at boot (decode/"
+                        "spec scans, pow2 prefill chunks, pow2 hybrid "
+                        "budget slices, the commit sample — each x plain/"
+                        "penalized) BEFORE the scheduler takes traffic, so "
+                        "the first real request pays zero XLA compile. "
+                        "Coverage + timings at GET /debug/compile; default "
+                        "off (opt-in — boot takes the compile time instead)")
+    p.add_argument("--transfer-guard", choices=["off", "log", "strict"],
+                   default="off",
+                   help="serve mode, needs --slots > 0: guard the steady-"
+                        "state decode/spec dispatch window with "
+                        "jax.transfer_guard — every operand there is a "
+                        "device-resident carry, so 'strict' turns an "
+                        "unexpected implicit host->device upload (the PR 3 "
+                        "invariant breaking) into an error instead of a "
+                        "silently serialized pipeline; 'log' logs them. "
+                        "Transfer accounting (dllama_transfers_total) is "
+                        "always on regardless")
     p.add_argument("--admit-ttft-deadline-ms", type=float, default=None,
                    help="serve mode, needs --slots > 0: joiners older than this "
                         "pump their prefill to completion despite the stall "
@@ -510,6 +530,8 @@ def cmd_serve(args) -> int:
         prefill_budget=prefill_budget,
         preempt=args.preempt,
         tenant_weights=_parse_tenant_weights(args.tenant_weight),
+        warmup=args.warmup,
+        transfer_guard=args.transfer_guard,
     )
 
 

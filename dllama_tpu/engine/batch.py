@@ -39,6 +39,7 @@ from dllama_tpu.engine.engine import pow2_chunk
 from dllama_tpu.engine.sampling import sample_logits
 from dllama_tpu.models.config import LlamaConfig
 from dllama_tpu.models.llama import KVCache, PagedKVCache, forward
+from dllama_tpu.obs import compile as compile_obs
 from dllama_tpu.obs import instruments as ins
 from dllama_tpu.obs import trace
 from dllama_tpu.utils import faults
@@ -524,7 +525,10 @@ class DecodeChunk:
         scheduler fails flagged rows' REQUESTS (finish_reason='error',
         rows released unreusable) — a poisoned slot must not crash the
         engine nor serve garbage tokens."""
-        out = None if self.bad is None else np.asarray(self.bad)
+        out = None
+        if self.bad is not None:
+            out = np.asarray(self.bad)
+            compile_obs.note_transfer("d2h", "nan_guard", int(out.nbytes))
         if self.bad_inject is not None:
             out = self.bad_inject if out is None else (out | self.bad_inject)
         if out is None or not out.any():
@@ -568,6 +572,13 @@ class BatchEngine:
         # auto = on whenever the layout is paged; the tree only acts through
         # the radix_* methods the serving scheduler drives, so direct add/
         # decode/release library use is unchanged either way.
+        transfer_guard: str = "off",  # 'off' | 'log' | 'strict'
+        # (--transfer-guard, ISSUE 13): steady-state decode/spec jit calls
+        # run under jax.transfer_guard_host_to_device — their operands are
+        # device-resident carries by construction, so 'strict' turns any
+        # implicit per-chunk upload into an error instead of a silently
+        # serialized pipeline. Boundary uploads (vector refresh, prefill
+        # chunks) happen outside the guarded window and stay legal.
     ):
         from dllama_tpu.ops.layers import build_rope_cache
 
@@ -820,6 +831,31 @@ class BatchEngine:
                 static_argnums=(15,), donate_argnums=(1, 2, 12),
             )
             self._hist_write = jax.jit(self._hist_write_impl, donate_argnums=(0,))
+
+        # ---- compile observability (ISSUE 13, obs/compile): the ledger's
+        # jax.monitoring listener attributes every trace/compile to the
+        # scoped dispatch sites below, and THIS engine's shape contract
+        # declares the expected compiled universe. Engine construction
+        # declares the scheduler-independent buckets (pow2 prefill chunks,
+        # the B=1 commit sample); the serving scheduler adds the decode/
+        # spec/hybrid buckets it will dispatch (declare_serving_buckets).
+        if transfer_guard not in compile_obs.TRANSFER_GUARD_MODES:
+            raise ValueError(
+                f"transfer_guard must be one of "
+                f"{compile_obs.TRANSFER_GUARD_MODES}, got {transfer_guard!r}")
+        self.transfer_guard = transfer_guard
+        self.contract = compile_obs.ShapeContract()
+        self._bucket_tag = sel.bucket_tag()
+        from dllama_tpu.engine.kernel_select import pow2_buckets
+
+        # pow2_chunk never emits a chunk wider than the prompt cap, and a
+        # prompt is < seq_len — the declared prefill universe honors both
+        for c in pow2_buckets(self._prefill_bucket_cap()):
+            self.contract.declare("prefill_chunk", f"m{c}",
+                                  note=self._bucket_tag)
+        self.contract.declare("commit", "b1", note=self._bucket_tag)
+        compile_obs.LEDGER.install_contract(self.contract)
+        compile_obs.LEDGER.ensure_listener()
 
     # ------------------------------------------------------------- jitted fns
 
@@ -1450,6 +1486,283 @@ class BatchEngine:
             paged_impl=("gather" if self.attn_route == "paged_gather"
                         else "kernel"))
 
+    # ------------------------------ compile contract & warmup (ISSUE 13)
+
+    def _prefill_bucket_cap(self) -> int:
+        """Widest prefill chunk add_step can emit: the CLI cap, bounded by
+        the context (a prompt is < seq_len, so pow2_chunk never exceeds
+        it)."""
+        return max(1, min(self.max_prefill_chunk, self.seq_len - 1))
+
+    @staticmethod
+    def _n_in_range(lo: int, hi: int):
+        """Contract allow-predicate for 'n{v}' keys: the decode/spec scan
+        length can be row-limit-clamped to ANY value in [lo, hi] near the
+        context edge — expected, but not worth a warm target each."""
+
+        def pred(key: str) -> bool:
+            try:
+                v = int(key[1:]) if key.startswith("n") else -1
+            except ValueError:
+                return False
+            return lo <= v <= hi
+
+        return pred
+
+    @staticmethod
+    def _hybrid_in_range(pow2s, chunk_hi: int):
+        """Allow-predicate for 'p{P}.n{v}' hybrid keys: any declared pow2
+        slice × any row-limit-clamped decode length in [1, chunk]."""
+        allowed = {int(p) for p in pow2s}
+
+        def pred(key: str) -> bool:
+            try:
+                p_part, n_part = key.split(".", 1)
+                p = int(p_part[1:]) if p_part.startswith("p") else -1
+                v = int(n_part[1:]) if n_part.startswith("n") else -1
+            except ValueError:
+                return False
+            return p in allowed and 1 <= v <= chunk_hi
+
+        return pred
+
+    def declare_serving_buckets(self, chunk: int,
+                                hybrid_budget_hi: int = 0) -> None:
+        """Declare the serving scheduler's expected compiled-shape
+        universe into this engine's contract (idempotent): the fused
+        decode scan at n∈{1, chunk} (any clamp in between allowed), the
+        spec verify chunk ditto, and the hybrid launch at every pow2
+        budget slice × the decode chunk — each × {plain, penalized}.
+        Called by Scheduler.__init__ with its chunk and budget ceiling;
+        direct library users who never declare keep classification at
+        'undeclared' (no contract, no false alarms)."""
+        from dllama_tpu.engine.kernel_select import pow2_buckets
+
+        tag = self._bucket_tag
+        chunk = max(1, int(chunk))
+        fns = ["decode", "decode_pen"]
+        if self.spec_k:
+            fns += ["spec", "spec_pen"]
+        for fn in fns:
+            for v in sorted({1, chunk}):
+                self.contract.declare(fn, f"n{v}", note=tag)
+            self.contract.allow(fn, self._n_in_range(1, chunk),
+                                key=f"n1..{chunk}")
+        if self.supports_hybrid and hybrid_budget_hi > 0:
+            cap = min(int(hybrid_budget_hi), self._prefill_bucket_cap())
+            ps = pow2_buckets(cap)
+            for fn in ("hybrid", "hybrid_pen"):
+                for p in ps:
+                    self.contract.declare(fn, f"p{p}.n{chunk}", note=tag)
+                self.contract.allow(fn, self._hybrid_in_range(ps, chunk),
+                                    key=f"p<={cap}.n1..{chunk}")
+
+    def _ensure_counts(self) -> None:
+        if self._counts is None:
+            self._counts = jnp.zeros((self.n_slots, self.cfg.vocab_size),
+                                     jnp.int32)
+
+    def _warm_worklist(self, chunk: int, hybrid_budget_hi: int) -> list:
+        """(fn, key, thunk) for every warm-target bucket. Each thunk
+        dispatches the REAL jitted callable with inert operands — the
+        all-inactive masks freeze every decode row (writes masked, keys/
+        pos/token carries returned value-identical), and prefill slices
+        write zeros into idle slot 0's rows, which nothing reads before
+        a real admission overwrites them — so XLA compiles the exact
+        serving shapes while the engine state stays semantically
+        untouched."""
+        from dllama_tpu.engine.kernel_select import pow2_buckets
+
+        work: list = []
+        B = self.n_slots
+        carry: dict = {}
+
+        def prefill_thunk(c):
+            def run():
+                self._sync_vectors()
+                # warmup is unsharded-only, where _use_slot_prefill is
+                # always True — the B=1 slot prefill IS the serving shape
+                row, self.cache = self._prefill_slot(
+                    self.params, self.cache, jnp.zeros((1, c), jnp.int32),
+                    jnp.int32(0), jnp.int32(0), self.rope_cache)
+                carry["logits"] = row
+                if self.spec_k:
+                    self.history = self._hist_write(
+                        self.history, jnp.int32(0), jnp.int32(0),
+                        jnp.zeros((c,), jnp.int32))
+            return run
+
+        for c in pow2_buckets(self._prefill_bucket_cap()):
+            work.append(("prefill_chunk", f"m{c}", prefill_thunk(c)))
+
+        def commit_thunk():
+            row = carry.get("logits")
+            if row is None:  # pragma: no cover - prefill thunks run first
+                return
+            _key, sub = jax.random.split(self._base_key)
+            sample_logits(row, sub, jnp.float32(0.8), jnp.float32(0.9))
+
+        work.append(("commit", "b1", commit_thunk))
+
+        def decode_thunk(n, pen):
+            def run():
+                self._sync_vectors()
+                args = (self.params, self.cache, self._last_dev[:, None],
+                        self._pos_dev, self._active_dev, self._keys_dev,
+                        self._temps_dev, self._topp_dev, n, self.rope_cache,
+                        self._limit_dev)
+                if pen:
+                    self._ensure_counts()
+                    (toks, self.cache, self._keys_dev, self._pos_dev,
+                     self._last_dev, self._counts, _bad) = self._decode_pen(
+                        *args, self._counts, self._pres_dev, self._freq_dev)
+                else:
+                    (toks, self.cache, self._keys_dev, self._pos_dev,
+                     self._last_dev, _bad) = self._decode(*args)
+                if self.spec_k:
+                    # the per-chunk history backfill dispatches alongside
+                    # every real decode chunk — warm its per-n shape too
+                    self.history = self._hist_write_batch(
+                        self.history, toks.T, self._pos_dev,
+                        jnp.zeros(B, bool))
+            return run
+
+        for v in sorted({1, max(1, int(chunk))}):
+            work.append(("decode", f"n{v}", decode_thunk(v, False)))
+            work.append(("decode_pen", f"n{v}", decode_thunk(v, True)))
+
+        if self.spec_k:
+            def spec_thunk(n, pen):
+                def run():
+                    self._sync_vectors()
+                    args = (self.params, self.cache, self.history,
+                            self._last_dev, self._pos_dev, self._active_dev,
+                            self._speck_dev, self._keys_dev, self._temps_dev,
+                            self._topp_dev, self.rope_cache, self._limit_dev)
+                    if pen:
+                        self._ensure_counts()
+                        (emits, advs, nxt, self.cache, self.history,
+                         self._keys_dev, self._pos_dev, drafts, _bad,
+                         self._counts) = self._spec_step_pen(
+                            *args, self._counts, self._pres_dev,
+                            self._freq_dev, n)
+                    else:
+                        (emits, advs, nxt, self.cache, self.history,
+                         self._keys_dev, self._pos_dev, drafts, _bad) = \
+                            self._spec_step(*args, n)
+                    self._last_dev = nxt
+                return run
+
+            for v in sorted({1, max(1, int(chunk))}):
+                work.append(("spec", f"n{v}", spec_thunk(v, False)))
+                work.append(("spec_pen", f"n{v}", spec_thunk(v, True)))
+
+        if self.supports_hybrid and hybrid_budget_hi > 0:
+            cap = min(int(hybrid_budget_hi), self._prefill_bucket_cap())
+
+            def hybrid_thunk(p, n, pen):
+                def run():
+                    self._sync_vectors()
+                    args = (self.params, self.cache,
+                            jnp.zeros((1, p), jnp.int32), jnp.int32(0),
+                            jnp.int32(0), self._last_dev[:, None],
+                            self._pos_dev, self._active_dev, self._keys_dev,
+                            self._temps_dev, self._topp_dev, n,
+                            self.rope_cache, self._limit_dev)
+                    if pen:
+                        self._ensure_counts()
+                        (plog, toks, self.cache, self._keys_dev,
+                         self._pos_dev, self._last_dev, self._counts,
+                         _bad) = self._hybrid_pen(
+                            *args, self._counts, self._pres_dev,
+                            self._freq_dev)
+                    else:
+                        (plog, toks, self.cache, self._keys_dev,
+                         self._pos_dev, self._last_dev, _bad) = \
+                            self._hybrid(*args)
+                return run
+
+            nv = max(1, int(chunk))
+            for p in pow2_buckets(cap):
+                work.append(("hybrid", f"p{p}.n{nv}",
+                             hybrid_thunk(p, nv, False)))
+                work.append(("hybrid_pen", f"p{p}.n{nv}",
+                             hybrid_thunk(p, nv, True)))
+        return work
+
+    def _warm_boundary_ops(self) -> None:
+        """Precompile the small eager ops the admission/commit/release
+        boundaries dispatch (surgical ``.at[row].set`` carry writes, PRNG
+        key derivation): each is a once-per-process compile XLA would
+        otherwise pay on the FIRST real request — exactly the TTFT the
+        warmup pass exists to protect. Results are discarded; engine
+        state is untouched."""
+        self._pos_dev.at[0].set(0)
+        self._last_dev.at[0].set(0)
+        self._keys_dev.at[0].set(self._base_key)
+        key = jax.random.PRNGKey(0)
+        jax.random.split(jax.random.fold_in(key, 0))
+        jnp.full((1,), 0, jnp.int32)
+        if self._counts is not None:
+            self._counts.at[0].set(0)
+
+    def warmup(self, chunk: int = 4, hybrid_budget_hi: int = 0) -> dict:
+        """``--warmup auto`` precompile pass: declare + dispatch every
+        warm-target bucket once with inert operands, so the first REAL
+        request pays zero compile (TTFT stops carrying XLA's cold-start).
+        Must run at boot (no active slots; the serving scheduler calls it
+        before its worker thread starts); unsharded engines only. Returns
+        the warmup report `/debug/compile` serves — ``full_coverage``
+        means every declared warm target really compiled."""
+        if self.active.any():
+            raise RuntimeError("warmup must run before any slot is active")
+        if self._shardings is not None:
+            raise ValueError("warmup supports unsharded engines (inert "
+                             "operands would implicitly reshard on a mesh)")
+        self.declare_serving_buckets(chunk, hybrid_budget_hi)
+        ledger = compile_obs.LEDGER
+        t_start = time.perf_counter()
+        compiled, cached = 0, 0
+        per_fn: dict[str, int] = {}
+        had_counts = self._counts is not None
+        work = self._warm_worklist(max(1, int(chunk)), hybrid_budget_hi)
+        with ledger.warmup_phase():
+            for fn, key, thunk in work:
+                with ledger.scope(fn, key) as sc:
+                    thunk()
+                if sc.trace_s or sc.lower_s or sc.compile_s:
+                    compiled += 1
+                    per_fn[fn] = per_fn.get(fn, 0) + 1
+                else:
+                    cached += 1  # this process already compiled the shape
+            self._warm_boundary_ops()
+        # the report's seconds must cover compile AND the inert device
+        # work, and serving must not start with warmup launches still
+        # occupying the device stream
+        jax.block_until_ready(self.cache.k)
+        if not had_counts:
+            # the pen-variant warm thunks allocated the [B, vocab] penalty
+            # counts just to compile their shapes; only the cached XLA
+            # executables are needed after warmup — restore the lazy
+            # allocation so a penalty-free deployment pays no HBM for it
+            self._counts = None
+        report = {
+            "mode": "auto",
+            "buckets": len(work),
+            "compiled": compiled,
+            "cached": cached,
+            "per_fn": per_fn,
+            "seconds": round(time.perf_counter() - t_start, 3),
+            "full_coverage": ledger.snapshot(entries=0)["contract"]["full"],
+        }
+        ledger.warmup_report = report
+        log.info("warmup precompile: %d/%d buckets compiled, %d cached "
+                 "(%.2fs; %s)", compiled, len(work), cached,
+                 report["seconds"],
+                 "full coverage" if report["full_coverage"]
+                 else "coverage INCOMPLETE")
+        return report
+
     def warm_restart(self) -> None:
         """Crash recovery WITHOUT a model reload: rebuild everything a
         failed chunk may have poisoned — the KV cache buffers (the jitted
@@ -1577,6 +1890,7 @@ class BatchEngine:
         if self.spec_k:
             # the n-gram proposer drafts from the prompt too — that's the
             # whole point of prompt lookup
+            compile_obs.note_transfer("h2d", "history", c * 4)
             self.history = self._hist_write(
                 self.history, jnp.int32(slot), jnp.int32(self.pos[slot]),
                 jnp.asarray(adm.toks[off : off + c]),
@@ -1586,13 +1900,18 @@ class BatchEngine:
                 # the slot's block table changed at add_begin (page alloc /
                 # COW): refresh the device copy before the chunk reads it
                 self._sync_vectors()
-            row, self.cache = self._prefill_slot(
-                self.params, self.cache,
-                jnp.asarray(adm.toks[off : off + c][None]),
-                jnp.int32(slot),
-                jnp.int32(self.pos[slot]),
-                self.rope_cache,
-            )
+            ptoks = jnp.asarray(adm.toks[off : off + c][None])
+            compile_obs.note_transfer("h2d", "prefill", int(ptoks.nbytes))
+            with compile_obs.LEDGER.scope(
+                    "prefill_chunk", f"m{c}",
+                    sig=lambda: compile_obs.sig_of(ptoks)):
+                row, self.cache = self._prefill_slot(
+                    self.params, self.cache,
+                    ptoks,
+                    jnp.int32(slot),
+                    jnp.int32(self.pos[slot]),
+                    self.rope_cache,
+                )
             adm.logits = row  # [1, V] — the slot's own row
         else:
             chunk = np.zeros((self.n_slots, c), np.int32)
@@ -1607,13 +1926,22 @@ class BatchEngine:
             # dispatching async device work — aliasing turns that into a
             # read/write race.
             pos_vec = jnp.asarray(self.pos.copy(), jnp.int32)
-            logits, self.cache = self._prefill_step(
-                self.params, self.cache,
-                jnp.asarray(chunk),
-                pos_vec,
-                jnp.asarray(onehot),
-                self.rope_cache,
-            )
+            chunk_dev = jnp.asarray(chunk)
+            onehot_dev = jnp.asarray(onehot)
+            compile_obs.note_transfer(
+                "h2d", "prefill",
+                int(chunk_dev.nbytes) + int(pos_vec.nbytes)
+                + int(onehot_dev.nbytes))
+            with compile_obs.LEDGER.scope(
+                    "prefill_chunk", f"m{c}",
+                    sig=lambda: compile_obs.sig_of(chunk_dev)):
+                logits, self.cache = self._prefill_step(
+                    self.params, self.cache,
+                    chunk_dev,
+                    pos_vec,
+                    onehot_dev,
+                    self.rope_cache,
+                )
             adm.logits = logits[slot : slot + 1]
         self.pos[slot] += c
         adm.off += c
@@ -1645,9 +1973,13 @@ class BatchEngine:
         key, sub = jax.random.split(key)
         self.keys[slot] = np.array(key)  # np.array copies (np.asarray of a jax
         # array is a read-only view; this row is mutated on every add)
-        first = int(np.asarray(
-            sample_logits(adm.logits, sub, jnp.float32(temperature), jnp.float32(topp))
-        )[0])
+        with compile_obs.LEDGER.scope(
+                "commit", "b1",
+                sig=lambda: compile_obs.sig_of(adm.logits)):
+            tok = sample_logits(adm.logits, sub, jnp.float32(temperature),
+                                jnp.float32(topp))
+        first = int(np.asarray(tok)[0])
+        compile_obs.note_transfer("d2h", "commit", int(tok.nbytes))
         self.active[slot] = True
         self.last_token[slot] = first
         self.temperature[slot] = temperature
@@ -1774,13 +2106,22 @@ class BatchEngine:
         self._freq_dev = jnp.asarray(self.frequency.copy())
         self._speck_dev = jnp.asarray(self.spec_k_slot.copy())
         self._limit_dev = jnp.asarray(self._row_limit())
+        nbytes = (int(self._active_dev.nbytes) + int(self._temps_dev.nbytes)
+                  + int(self._topp_dev.nbytes) + int(self._pres_dev.nbytes)
+                  + int(self._freq_dev.nbytes) + int(self._speck_dev.nbytes)
+                  + int(self._limit_dev.nbytes))
         if self.pool is not None:
             # block tables are host-authoritative like pos/active: refresh the
             # cache's device copy at the same boundaries (the pool arrays are
             # the mirrors; .copy() for the same aliasing reason as above)
-            self.cache = PagedKVCache(
-                self.cache.k, self.cache.v,
-                jnp.asarray(self.pool.tables.copy(), jnp.int32))
+            tables = jnp.asarray(self.pool.tables.copy(), jnp.int32)
+            nbytes += int(tables.nbytes)
+            self.cache = PagedKVCache(self.cache.k, self.cache.v, tables)
+        # boundary upload accounting (ISSUE 13): this fan is the ONLY
+        # legitimate steady-path upload site, and it fires at boundaries
+        # only — a per-chunk rate here is the device-resident-state
+        # invariant breaking (the transfer-guard strict mode would raise)
+        compile_obs.note_transfer("h2d", "vectors", nbytes)
         self._vec_dirty = False
 
     def decode_dispatch(self, n: int, spec: bool = False) -> DecodeChunk:
@@ -1840,16 +2181,28 @@ class BatchEngine:
         )
         t0 = time.perf_counter()
         t_disp = time.monotonic()  # trace clock; ~free next to perf_counter
+        # steady-state contract, both halves (ISSUE 13): the compile scope
+        # attributes any trace/compile this launch causes to its shape
+        # bucket, and the transfer guard (strict mode) turns an implicit
+        # host->device upload into an error — every operand below is a
+        # device-resident carry, so a clean engine trips neither.
+        guard = compile_obs.h2d_guard(self.transfer_guard)
         if self._counts is not None and (
             (self.presence[self.active] != 0).any()
             or (self.frequency[self.active] != 0).any()
         ):
-            (toks, self.cache, self._keys_dev, self._pos_dev, self._last_dev,
-             self._counts, bad) = self._decode_pen(
-                *args, self._counts, self._pres_dev, self._freq_dev)
+            with compile_obs.LEDGER.scope(
+                    "decode_pen", f"n{n}",
+                    sig=lambda: compile_obs.sig_of(*args[2:])), guard:
+                (toks, self.cache, self._keys_dev, self._pos_dev,
+                 self._last_dev, self._counts, bad) = self._decode_pen(
+                    *args, self._counts, self._pres_dev, self._freq_dev)
         else:
-            (toks, self.cache, self._keys_dev, self._pos_dev, self._last_dev,
-             bad) = self._decode(*args)
+            with compile_obs.LEDGER.scope(
+                    "decode", f"n{n}",
+                    sig=lambda: compile_obs.sig_of(*args[2:])), guard:
+                (toks, self.cache, self._keys_dev, self._pos_dev,
+                 self._last_dev, bad) = self._decode(*args)
         start_pos = self.pos.copy()
         active = self.active.copy()
         advance = np.where(
@@ -1868,10 +2221,15 @@ class BatchEngine:
             # full chunk would spill past the history row are skipped: their
             # slot froze mid-chunk at seq_len, where spec_eligible freezes it
             # anyway — a draft from slightly stale history is only a
-            # proposal, verify rejects it.
-            fits = active & (start_pos + 1 + n <= self.seq_len + 1)
+            # proposal, verify rejects it. The mask is computed ON DEVICE
+            # off the dispatch-time carry (identical values to the old host
+            # mask for every active row — _active_dev/_pos_dev are synced
+            # mirrors here), so spec engines keep steady-state decode at
+            # literally zero host->device uploads (ISSUE 13).
+            fits_dev = self._active_dev & (pos_before + 1 + n
+                                           <= self.seq_len + 1)
             self.history = self._hist_write_batch(
-                self.history, toks.T, pos_before, jnp.asarray(fits))
+                self.history, toks.T, pos_before, fits_dev)
         # the host pos mirror advances arithmetically — exactly what the scan
         # computes — so it stays current without waiting for the tokens
         self.pos += advance
@@ -1928,15 +2286,18 @@ class BatchEngine:
         ppos = int(self.pos[slot])
         if self.spec_k:
             # prompt tokens feed the n-gram proposer exactly like add_step
+            compile_obs.note_transfer("h2d", "history", c * 4)
             self.history = self._hist_write(
                 self.history, jnp.int32(slot), jnp.int32(ppos),
                 jnp.asarray(adm.toks[adm.off : adm.off + c]),
             )
         self._sync_vectors()
         pos_before = self._pos_dev
+        ptoks = jnp.asarray(adm.toks[adm.off : adm.off + c][None])
+        compile_obs.note_transfer("h2d", "prefill", int(ptoks.nbytes))
         args = (
             self.params, self.cache,
-            jnp.asarray(adm.toks[adm.off : adm.off + c][None]),
+            ptoks,
             jnp.int32(slot),
             jnp.int32(ppos),
             self._last_dev[:, None],
@@ -1951,16 +2312,27 @@ class BatchEngine:
         )
         t0 = time.perf_counter()
         t_disp = time.monotonic()
+        # same steady-state contract as decode_dispatch: the prefill slice
+        # upload happened above (an expected, counted boundary transfer);
+        # the fused launch itself takes only device-resident operands, so
+        # the strict transfer guard holds through hybrid serving too
+        guard = compile_obs.h2d_guard(self.transfer_guard)
         if self._counts is not None and (
             (self.presence[self.active] != 0).any()
             or (self.frequency[self.active] != 0).any()
         ):
-            (plog, toks, self.cache, self._keys_dev, self._pos_dev,
-             self._last_dev, self._counts, bad) = self._hybrid_pen(
-                *args, self._counts, self._pres_dev, self._freq_dev)
+            with compile_obs.LEDGER.scope(
+                    "hybrid_pen", f"p{c}.n{n}",
+                    sig=lambda: compile_obs.sig_of(ptoks, *args[5:])), guard:
+                (plog, toks, self.cache, self._keys_dev, self._pos_dev,
+                 self._last_dev, self._counts, bad) = self._hybrid_pen(
+                    *args, self._counts, self._pres_dev, self._freq_dev)
         else:
-            (plog, toks, self.cache, self._keys_dev, self._pos_dev,
-             self._last_dev, bad) = self._hybrid(*args)
+            with compile_obs.LEDGER.scope(
+                    "hybrid", f"p{c}.n{n}",
+                    sig=lambda: compile_obs.sig_of(ptoks, *args[5:])), guard:
+                (plog, toks, self.cache, self._keys_dev, self._pos_dev,
+                 self._last_dev, bad) = self._hybrid(*args)
         adm.logits = plog  # [1, V] — materializes with the chunk
         adm.off += c
         start_pos = self.pos.copy()
@@ -1977,9 +2349,11 @@ class BatchEngine:
             bad_inject = np.zeros(self.n_slots, bool)
             bad_inject[int(np.flatnonzero(active)[0])] = True
         if self.spec_k:
-            fits = active & (start_pos + 1 + n <= self.seq_len + 1)
+            # device-side fits mask, same reasoning as decode_dispatch
+            fits_dev = self._active_dev & (pos_before + 1 + n
+                                           <= self.seq_len + 1)
             self.history = self._hist_write_batch(
-                self.history, toks.T, pos_before, jnp.asarray(fits))
+                self.history, toks.T, pos_before, fits_dev)
         self.pos += advance
         self.chunk_seq += 1
         ins.PREFILL_TOKENS.inc(c)
@@ -2028,16 +2402,24 @@ class BatchEngine:
             self.rope_cache,
             self._limit_dev,
         )
+        guard = compile_obs.h2d_guard(self.transfer_guard)
         if self._counts is not None and (
             (self.presence[self.active] != 0).any()
             or (self.frequency[self.active] != 0).any()
         ):
-            (emits, advs, nxt, self.cache, self.history, self._keys_dev,
-             self._pos_dev, drafts, bad, self._counts) = self._spec_step_pen(
-                *args, self._counts, self._pres_dev, self._freq_dev, n_cycles)
+            with compile_obs.LEDGER.scope(
+                    "spec_pen", f"n{n_cycles}",
+                    sig=lambda: compile_obs.sig_of(*args[3:])), guard:
+                (emits, advs, nxt, self.cache, self.history, self._keys_dev,
+                 self._pos_dev, drafts, bad, self._counts) = \
+                    self._spec_step_pen(*args, self._counts, self._pres_dev,
+                                        self._freq_dev, n_cycles)
         else:
-            (emits, advs, nxt, self.cache, self.history, self._keys_dev,
-             self._pos_dev, drafts, bad) = self._spec_step(*args, n_cycles)
+            with compile_obs.LEDGER.scope(
+                    "spec", f"n{n_cycles}",
+                    sig=lambda: compile_obs.sig_of(*args[3:])), guard:
+                (emits, advs, nxt, self.cache, self.history, self._keys_dev,
+                 self._pos_dev, drafts, bad) = self._spec_step(*args, n_cycles)
         self._last_dev = nxt
         self._spec_inflight += 1
         active = self.active.copy()
@@ -2071,6 +2453,7 @@ class BatchEngine:
         one-chunk stop overrun), and the acceptance telemetry
         (dllama_spec_* series) is recorded."""
         toks = np.asarray(chunk.toks)
+        compile_obs.note_transfer("d2h", "decode_tokens", int(toks.nbytes))
         # the transfer above is the device sync: observing here (not at
         # dispatch) keeps DECODE_CHUNK_SECONDS device-real under overlapped
         # consumption. The clock starts at the later of the chunk's dispatch
@@ -2097,6 +2480,10 @@ class BatchEngine:
             chunk.advance = total
             chunk.adv_cycles = advs
             chunk.start_pos = np.asarray(chunk.start_dev).astype(np.int32)
+            compile_obs.note_transfer(
+                "d2h", "spec_counts",
+                int(advs.nbytes) + int(drafted.nbytes)
+                + int(chunk.start_pos.nbytes))
             m_cycles, b = advs.shape
             # flatten each slot's accepted runs (cycle-major) with one
             # boolean-mask gather per emitting slot — C-speed, not an

@@ -27,6 +27,20 @@ from dllama_tpu.models.config import LlamaConfig
 PAGED_ROUTES = ("paged_kernel", "paged_gather")
 
 
+def pow2_buckets(cap: int) -> tuple[int, ...]:
+    """The bounded pow2 shape universe ``engine.pow2_chunk`` can emit under
+    ``cap`` — (1, 2, 4, ..., <=cap). This is THE bucket enumeration behind
+    the compile ledger's shape contract (obs/compile): prefill chunks,
+    hybrid budget slices, and the warmup precompile worklist all quantize
+    to exactly this set, which is what makes the compiled-shape universe
+    declarable (and its violations detectable) in the first place."""
+    vals, c = [], 1
+    while c <= max(1, int(cap)):
+        vals.append(c)
+        c *= 2
+    return tuple(vals)
+
+
 @dataclass
 class KernelSelection:
     mm: Callable  # matmul for output-dim-sharded / replicated weights
@@ -38,6 +52,13 @@ class KernelSelection:
     # 'paged_gather' — the single string obs/bench/README quote for "what
     # actually runs", and what chunk_cost_model prices (kernel vs gather
     # paged bytes differ by the whole re-materialized view)
+    def bucket_tag(self) -> str:
+        """'backend/attn_route' — the variant tag the compile ledger's
+        shape-bucket contract stamps on each declared bucket, so a
+        coverage dump says WHICH compiled universe (dense vs paged, jnp vs
+        flash) the buckets belong to."""
+        return f"{self.backend}/{self.attn_route}"
+
     fused_scatter_max_t: int | None = None  # paged_kernel route only: the
     # widest chunk (query rows per slot) whose new-KV scatter stays fused
     # inside the kernel launch. A speculative verify forward is spec_k+1

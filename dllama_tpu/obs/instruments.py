@@ -124,9 +124,11 @@ RADIX_PAGES = metrics.gauge(
 BUILD_INFO = metrics.gauge(
     "dllama_tpu_build_info",
     "Always 1; the labels carry what is running — package version, jax "
-    "version, jax backend platform, and whether the overlapped decode "
-    "pipeline is active (on/off, or n/a on the single-engine tier)",
-    ("version", "jax", "backend", "overlap"))
+    "version, jax backend platform, whether the overlapped decode "
+    "pipeline is active (on/off, or n/a on the single-engine tier), and "
+    "the boot warmup mode (auto = the compiled-shape universe was "
+    "precompiled before traffic; off; n/a on the single-engine tier)",
+    ("version", "jax", "backend", "overlap", "warmup"))
 QUEUE_DEPTH = metrics.gauge(
     "dllama_queue_depth", "Requests waiting in the admission queue")
 BUSY_SLOTS = metrics.gauge(
@@ -234,6 +236,50 @@ GOODPUT = metrics.gauge(
     "Windowed GOODPUT token rate: only tokens of requests that finished "
     "stop/length within every configured SLO count (goodput/throughput is "
     "the useful-work fraction)")
+
+# ------------------------------------- compile & device traffic (ISSUE 13)
+
+JIT_COMPILES = metrics.counter(
+    "dllama_jit_compiles_total",
+    "Observed XLA jit traces/compiles, by dispatch-site function label "
+    "(obs/compile.COMPILE_FNS; 'untracked' = compiles outside any "
+    "instrumented site). Steady-state serving must not move this at all — "
+    "a nonzero rate mid-traffic is a recompile storm stealing device time",
+    ("fn",))
+JIT_COMPILE_SECONDS = metrics.counter(
+    "dllama_jit_compile_seconds_total",
+    "Wall seconds spent tracing/lowering/compiling, by function label "
+    "(the jax.monitoring /jax/core/compile/* durations, attributed by the "
+    "compile ledger's dispatch-site scopes)",
+    ("fn",))
+JIT_UNEXPECTED_COMPILES = metrics.counter(
+    "dllama_jit_unexpected_compiles_total",
+    "Compiles whose shape-bucket key fell OUTSIDE the declared contract "
+    "(obs/compile.ShapeContract): each one also logs a structured warning "
+    "naming the offending shape. Any nonzero value means the bounded "
+    "compiled-shape universe the perf work assumes has been violated",
+    ("fn",))
+TRANSFERS = metrics.counter(
+    "dllama_transfers_total",
+    "Host<->device transfers at the engine boundary, by direction "
+    "(h2d/d2h) and site (obs/compile.TRANSFER_SITES): uploads happen at "
+    "admission/commit/release boundaries only — a per-chunk h2d rate in "
+    "steady-state decode is the PR 3 invariant breaking",
+    ("direction", "site"))
+TRANSFER_BYTES = metrics.counter(
+    "dllama_transfer_bytes_total",
+    "Bytes moved by the transfers dllama_transfers_total counts, same "
+    "direction/site labels",
+    ("direction", "site"))
+DEVICE_LIVE_BUFFERS = metrics.gauge(
+    "dllama_device_live_buffers",
+    "Live jax arrays on the backend (jax.live_arrays), refreshed at "
+    "scrape time — a monotone climb under steady traffic is a device-"
+    "memory leak showing before the OOM does")
+DEVICE_LIVE_BYTES = metrics.gauge(
+    "dllama_device_live_bytes",
+    "Bytes held by the live jax arrays (companion of "
+    "dllama_device_live_buffers; params + KV + decode state + transients)")
 
 # -------------------------------------------------- process self-metrics
 

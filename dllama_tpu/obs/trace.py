@@ -50,6 +50,7 @@ SPAN_CATALOG = {
     "decode.device": "chunk dispatch -> tokens materialized: the device-side window (track: device)",
     "decode.spec": "one batched speculative propose/verify cycle (track: device)",
     "emit.scan": "post-consume token emit + EOS/budget stop scan (track: scheduler)",
+    "compile": "one jit trace/lower/compile attributed to a dispatch site (obs/compile ledger); args carry fn/key/classification — visible in Perfetto as compile stealing device time mid-traffic (track: compile)",
 }
 
 #: instant-event names (``ph: "i"`` in the export), same drift contract

@@ -42,6 +42,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from dllama_tpu import __version__
 from dllama_tpu.engine.sampling import Sampler
 from dllama_tpu.obs import metrics, new_request_id, trace
+from dllama_tpu.obs import compile as compile_obs
 from dllama_tpu.obs import instruments as ins
 from dllama_tpu.obs import perf as perfmod
 from dllama_tpu.serve.scheduler import (
@@ -221,6 +222,10 @@ class ApiServer:
             "backend": jax.default_backend(),
             "overlap": ("n/a" if scheduler is None
                         else ("on" if scheduler.overlap else "off")),
+            # boot precompile state (ISSUE 13): whether this replica warmed
+            # its compiled-shape universe before taking traffic
+            "warmup": ("n/a" if scheduler is None
+                       else getattr(scheduler, "warmup", "off")),
         }
         ins.BUILD_INFO.labels(**self.build_info).set(1)
         # SLO policy for the /debug/requests/{req_id} postmortem verdict —
@@ -244,7 +249,15 @@ class ApiServer:
             h = self.scheduler.health()
         else:
             h = {"live": True, "ready": True, "queue_depth": 0,
-                 "busy_slots": 0, "n_slots": 0, "last_step_age_s": 0.0}
+                 "busy_slots": 0, "n_slots": 0, "last_step_age_s": 0.0,
+                 # compile observability rides the single tier's probe too
+                 # (no warmup pass there — the batched scheduler owns it)
+                 "compile": {
+                     "warmup": "n/a",
+                     "compiles": compile_obs.LEDGER.total_compiles(),
+                     "unexpected_compiles":
+                         compile_obs.LEDGER.total_unexpected(),
+                 }}
         if self.draining:
             h["ready"] = False
             h["draining"] = True
@@ -776,6 +789,7 @@ _KNOWN_PATHS = {
     "/debug/kv": "/debug/kv",
     "/debug/perf": "/debug/perf",
     "/debug/radix": "/debug/radix",
+    "/debug/compile": "/debug/compile",
 }
 
 
@@ -832,6 +846,7 @@ class _Handler(BaseHTTPRequestHandler):
             # self-metrics) current without putting their aggregation on the
             # serving hot path.
             ins.refresh_process_gauges()
+            compile_obs.refresh_device_gauges()
             if self.api.scheduler is not None:
                 self.api.scheduler.ledger.poke()
                 self.api.scheduler.perf.refresh_gauges()
@@ -955,7 +970,24 @@ class _Handler(BaseHTTPRequestHandler):
                 "preemptions": getattr(sched, "preempt_count", 0),
                 "resumed": getattr(sched, "resume_count", 0),
             }
+        # compile-ledger summary (ISSUE 13; both tiers — the ledger is
+        # process-global): compiles/seconds/unexpected + warmup state; the
+        # full dump lives at GET /debug/compile
+        payload["compile"] = compile_obs.LEDGER.summary()
         self._send_json(200, payload)
+
+    def _debug_compile(self) -> None:
+        """GET /debug/compile — the ISSUE 13 join, one JSON document: the
+        jit compile ledger (per-fn totals + recent entries with shape
+        signatures), shape-bucket contract coverage (declared / compiled /
+        missing-warm / unexpected-seen per fn), the boot warmup report,
+        host<->device transfer tallies by direction+site, and live device
+        memory. Works without the span tracer; tier-independent (the
+        ledger and transfer counters are process-global)."""
+        sched = self.api.scheduler
+        self._send_json(200, compile_obs.debug_payload(
+            warmup_report=(sched.warmup_report if sched is not None
+                           else None)))
 
     def _debug_get(self) -> None:
         """GET /debug/trace (Chrome trace-event JSON for Perfetto),
@@ -970,6 +1002,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path == "/debug/radix":
             self._debug_radix()  # tracer-independent (tree + counters)
+            return
+        if self.path == "/debug/compile":
+            self._debug_compile()  # tracer-independent (ledger + counters)
             return
         tr = trace.TRACER
         if not tr.enabled:
@@ -1272,6 +1307,12 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
                     "--slots > 0; the single-engine tier serves one request "
                     "at a time — ignored (priority/tenant body fields are "
                     "accepted but inert)")
+    if n_slots <= 0 and (defaults.get("warmup") not in (None, "off")
+                         or defaults.get("transfer_guard")
+                         not in (None, "off")):
+        log.warning("--warmup / --transfer-guard need --slots > 0; the "
+                    "single-engine tier has no BatchEngine shape contract "
+                    "to precompile or guard — ignored")
     if n_slots > 0:
         from dllama_tpu.engine.batch import BatchEngine
         from dllama_tpu.serve.scheduler import Scheduler
@@ -1348,6 +1389,10 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
             page_size=page_size,
             kv_pages=int(defaults.get("kv_pages") or 0),
             radix_cache=radix_cache,
+            # steady-state upload enforcement (--transfer-guard): 'strict'
+            # turns an implicit per-chunk host->device transfer inside the
+            # decode/spec dispatch window into an error
+            transfer_guard=str(defaults.get("transfer_guard") or "off"),
         )
         # admission pacing (serve/scheduler.py): budget bounds the decode
         # stall a joining prefill may insert per visit; the optional TTFT
@@ -1392,6 +1437,11 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
             sched_kw["preempt"] = str(defaults["preempt"])
         if defaults.get("tenant_weights"):
             sched_kw["tenant_weights"] = dict(defaults["tenant_weights"])
+        # boot precompile (--warmup auto): the scheduler declares its
+        # compiled-shape universe and warms every bucket before the worker
+        # takes traffic — first-request TTFT stops paying XLA cold-start
+        if defaults.get("warmup"):
+            sched_kw["warmup"] = str(defaults["warmup"])
         scheduler = Scheduler(be, **sched_kw)
     api = ApiServer(
         loaded,

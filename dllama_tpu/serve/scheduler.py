@@ -63,6 +63,7 @@ import jax
 import numpy as np
 
 from dllama_tpu.engine.batch import BatchEngine
+from dllama_tpu.obs import compile as compile_obs
 from dllama_tpu.obs import instruments as ins
 from dllama_tpu.obs import perf
 from dllama_tpu.obs import trace
@@ -262,7 +263,8 @@ class Scheduler:
                  slo_itl_ms: float | None = None,
                  prefill_budget: int | str = "auto",
                  preempt: str = "auto",
-                 tenant_weights: dict[str, float] | None = None):
+                 tenant_weights: dict[str, float] | None = None,
+                 warmup: str = "off"):
         self.engine = engine
         self.chunk = chunk
         self.admit_timeout = admit_timeout
@@ -443,6 +445,30 @@ class Scheduler:
         self._preempt_on = preempt != "off"
         self.preempt_count = 0  # lifetime totals (latency_summary/health)
         self.resume_count = 0
+        # ---- compile observability (ISSUE 13, obs/compile): declare THIS
+        # scheduler's expected compiled-shape universe into the engine's
+        # contract (decode/spec at {1, chunk}, hybrid at every pow2 budget
+        # slice) so any off-contract compile classifies unexpected; with
+        # --warmup auto, precompile the whole universe BEFORE the worker
+        # starts — the first real request then pays zero compile.
+        if warmup not in ("auto", "off"):
+            raise ValueError(f"warmup must be auto|off, got {warmup!r}")
+        self.warmup = warmup
+        self.warmup_report: dict | None = None
+        hybrid_hi = 0
+        if self._hybrid_on:
+            hybrid_hi = (self._budget_ctl.hi if self._budget_ctl is not None
+                         else self._budget_now)
+        if hasattr(engine, "declare_serving_buckets"):
+            engine.declare_serving_buckets(chunk=self.chunk,
+                                           hybrid_budget_hi=hybrid_hi)
+        if warmup == "auto":
+            if getattr(engine, "_shardings", None) is not None:
+                log.warning("--warmup auto needs an unsharded engine; "
+                            "skipping the precompile pass")
+            elif hasattr(engine, "warmup"):
+                self.warmup_report = engine.warmup(
+                    chunk=self.chunk, hybrid_budget_hi=hybrid_hi)
         # worker heartbeat: stamped once per loop iteration. A device call
         # that hangs stops the heartbeat while work exists — which is exactly
         # the condition the watchdog turns into "stalled".
@@ -591,6 +617,18 @@ class Scheduler:
             "resumed": self.resume_count,
             "preempted_waiting": sum(
                 1 for r in list(self._backlog) if r.preempted),
+            # compile observability (ISSUE 13): operators see a recompile
+            # storm from the health probe without scraping /metrics —
+            # `unexpected` > 0 means the compiled-shape contract broke
+            "compile": {
+                "warmup": self.warmup,
+                "warmed_buckets": (None if self.warmup_report is None
+                                   else self.warmup_report["compiled"]),
+                "full_coverage": (None if self.warmup_report is None
+                                  else self.warmup_report["full_coverage"]),
+                "compiles": compile_obs.LEDGER.total_compiles(),
+                "unexpected_compiles": compile_obs.LEDGER.total_unexpected(),
+            },
         }
 
     def drain(self, timeout_s: float = 30.0) -> bool:
@@ -693,6 +731,11 @@ class Scheduler:
                 "preemptions": self.preempt_count,
                 "resumed": self.resume_count,
             },
+            # compile-ledger record (ISSUE 13): lifetime compiles/seconds
+            # and the unexpected (off-contract) count — the host-side view
+            # of the dllama_jit_* series; `warmup` names the boot mode
+            "compile": dict(compile_obs.LEDGER.summary(),
+                            warmup_mode=self.warmup),
         }
 
     def reset_latency_stats(self) -> None:

@@ -84,6 +84,16 @@ RULES: list[tuple[str, str, float]] = [
     # the ledger partition invariant is an absolute property, not a trend:
     # gate it against a fixed ceiling via the pseudo-rule below
     ("slo.ledger_residual_frac", "ceiling", 0.02),
+    # ISSUE 13 compile & device-traffic record: the steady-state decode
+    # window must stay at ZERO recompiles (unexpected or otherwise) and
+    # ZERO host->device upload bytes — absolute ceilings, unscaled, like
+    # the ledger residual (invariants, not trends); and a warmed boot must
+    # keep the first-request TTFT collapsed vs cold (ratio is normalized)
+    ("compile.steady.compiles", "ceiling", 0.0),
+    ("compile.steady.unexpected_compiles", "ceiling", 0.0),
+    ("compile.steady.upload_bytes", "ceiling", 0.0),
+    ("compile.warm_first_request_compiles", "ceiling", 0.0),
+    ("compile.warmup_ttft_ratio", "lower", 0.5),
     ("*", "info", 0.0),
 ]
 

@@ -43,6 +43,9 @@ for name in metrics.REGISTRY.names():
 # (ISSUE 11): removal from the registry must fail here too
 # ...and the hybrid/preemption series are what scripts/hybrid_smoke.sh and
 # the bench hybrid record assert on (ISSUE 12): removal must fail here too
+# ...and the compile-ledger / transfer series are what
+# scripts/compile_smoke.sh, the bench compile record, and the perfdiff
+# zero-ceilings assert on (ISSUE 13): removal must fail here too
 for name in ("dllama_kv_pages_total", "dllama_kv_pages_used",
              "dllama_kv_pages_shared",
              "dllama_radix_lookups_total", "dllama_radix_hit_tokens_total",
@@ -50,7 +53,11 @@ for name in ("dllama_kv_pages_total", "dllama_kv_pages_used",
              "dllama_spec_cycles_total", "dllama_spec_tokens_total",
              "dllama_spec_accepted_length",
              "dllama_prefill_budget_tokens", "dllama_preemptions_total",
-             "dllama_resumed_total"):
+             "dllama_resumed_total",
+             "dllama_jit_compiles_total", "dllama_jit_compile_seconds_total",
+             "dllama_jit_unexpected_compiles_total",
+             "dllama_transfers_total", "dllama_transfer_bytes_total",
+             "dllama_device_live_buffers", "dllama_device_live_bytes"):
     if name not in metrics.REGISTRY.names():
         missing.append(f"unregistered:{name}")
 for name in sorted(trace.SPAN_CATALOG):
@@ -101,10 +108,36 @@ if readme_states != catalog_states:
              f"{sorted(catalog_states - readme_states)} readme-only="
              f"{sorted(readme_states - catalog_states)}")
 
+# compile-fn catalog (ISSUE 13): the README "Compile fn" bucket table must
+# match obs/compile.COMPILE_FNS EXACTLY (both directions, like the ledger
+# check) — a renamed dispatch-site label with a stale doc row is a contract
+# lying to the operator. The table is the one whose header row starts
+# "| Compile fn |".
+from dllama_tpu.obs import compile as compile_obs
+
+rows, in_table = [], False
+for line in readme.splitlines():
+    if line.startswith("| Compile fn |"):
+        in_table = True
+        continue
+    if in_table:
+        if not line.startswith("|"):
+            break
+        m = re.match(r"^\| `([a-z_]+)` \|", line)
+        if m:
+            rows.append(m.group(1))
+readme_fns, catalog_fns = set(rows), set(compile_obs.COMPILE_FNS)
+if readme_fns != catalog_fns:
+    sys.exit("compile-fn label drift between obs/compile.COMPILE_FNS and "
+             f"the README bucket table: catalog-only="
+             f"{sorted(catalog_fns - readme_fns)} readme-only="
+             f"{sorted(readme_fns - catalog_fns)}")
+
 print(f"checks: catalog drift OK ({len(metrics.REGISTRY.names())} metrics, "
       f"{len(trace.SPAN_CATALOG)} spans, {len(trace.EVENT_CATALOG)} events, "
       f"{len(faults.POINTS)} fault points, "
-      f"{len(perf.LEDGER_STATES)} ledger states all documented)")
+      f"{len(perf.LEDGER_STATES)} ledger states, "
+      f"{len(compile_obs.COMPILE_FNS)} compile fns all documented)")
 PY
 
 # paged flash-decode kernel (ISSUE 8): the op must stay registered in the
@@ -164,3 +197,24 @@ test -x scripts/hybrid_smoke.sh || {
     echo "checks: scripts/hybrid_smoke.sh missing or not executable" >&2
     exit 1; }
 echo "checks: hybrid record + perf-gate rules + smoke target OK"
+
+# compile & device-traffic observability (ISSUE 13): the bench record, the
+# perfdiff zero-ceilings, and the smoke target must keep existing —
+# deleting any of them would un-gate the zero-recompile / zero-upload
+# invariants silently. Textual (sub-second) checks.
+grep -q "def bench_compile" bench.py || {
+    echo "checks: bench.py lost its compile record (bench_compile)" >&2
+    exit 1; }
+grep -q "compile.steady.unexpected_compiles" experiments/perfdiff.py || {
+    echo "checks: perfdiff rules lost compile.steady.unexpected_compiles" >&2
+    exit 1; }
+grep -q "compile.steady.upload_bytes" experiments/perfdiff.py || {
+    echo "checks: perfdiff rules lost compile.steady.upload_bytes" >&2
+    exit 1; }
+grep -q "compile.warmup_ttft_ratio" experiments/perfdiff.py || {
+    echo "checks: perfdiff rules lost compile.warmup_ttft_ratio" >&2
+    exit 1; }
+test -x scripts/compile_smoke.sh || {
+    echo "checks: scripts/compile_smoke.sh missing or not executable" >&2
+    exit 1; }
+echo "checks: compile record + zero-ceiling rules + smoke target OK"

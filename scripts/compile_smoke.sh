@@ -1,0 +1,144 @@
+#!/usr/bin/env bash
+# compile_smoke.sh — end-to-end compile-observability smoke target (ISSUE 13).
+#
+# Boots `python -m dllama_tpu serve` (the real CLI, not an in-process
+# server) on a freshly generated tiny fixture model with `--warmup auto`
+# and `--transfer-guard strict`, runs one completion, and asserts:
+#
+#   * GET /debug/compile reports FULL declared bucket coverage
+#     (contract.full) and a warmup report with full_coverage=true;
+#   * ZERO unexpected compiles anywhere, and the first real request
+#     compiled NOTHING (the compile totals before and after the completion
+#     are identical) — the warmed TTFT therefore sits far below the
+#     cold-boot compile bill the warmup report records (asserted:
+#     ttft_ms < warmup seconds * 1000, a generous bound that still fails
+#     loudly if warmup silently stops covering the serving shapes);
+#   * the transfer tallies show boundary uploads + per-chunk downloads and
+#     /health carries the compile object with unexpected_compiles == 0;
+#   * the strict transfer guard survived the whole run (any implicit
+#     steady-state upload would have errored the request).
+#
+# Finishes with a SIGTERM drain. SMOKE TARGET, not a pytest test (lives
+# outside tests/, exempt from the tier-1 run). CPU-only, ~2 min (the
+# warmup pass pays the XLA compile bill up front — that is the point).
+# Exit 0 = PASS.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import http.client
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.getcwd())
+from tests.test_serve import make_tiny_files  # the tier-1 fixture model
+
+tmp = tempfile.mkdtemp(prefix="dllama_compile_smoke_")
+mpath, tpath, _cfg = make_tiny_files(__import__("pathlib").Path(tmp))
+
+with socket.socket() as s:  # pick a free port
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+
+proc = subprocess.Popen(
+    [sys.executable, "-m", "dllama_tpu", "serve", "--model", mpath,
+     "--tokenizer", tpath, "--slots", "2", "--port", str(port),
+     "--kv-layout", "paged", "--page-size", "8",
+     "--warmup", "auto", "--transfer-guard", "strict"],
+    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+)
+
+
+def get(path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read().decode()
+    conn.close()
+    return r.status, body
+
+
+try:
+    # warmup runs before the server binds readiness: the wait below covers
+    # the whole precompile pass (CPU XLA is slow — that is what it costs)
+    deadline = time.time() + 300
+    while True:
+        try:
+            if get("/health/ready")[0] == 200:
+                break
+        except OSError:
+            pass
+        if proc.poll() is not None:
+            sys.exit("FAIL: server exited before becoming ready")
+        if time.time() > deadline:
+            sys.exit("FAIL: server never became ready")
+        time.sleep(0.25)
+
+    st, doc = get("/debug/compile")
+    doc = json.loads(doc)
+    assert st == 200
+    warm = doc["warmup"]
+    assert warm and warm["full_coverage"], f"warmup coverage: {warm}"
+    assert doc["contract"]["full"], f"bucket coverage incomplete: " \
+        f"{doc['contract']}"
+    assert doc["unexpected"] == 0, f"unexpected compiles: {doc['totals']}"
+    compiles_before = doc["compiles"]
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    t0 = time.perf_counter()
+    conn.request("POST", "/v1/chat/completions", json.dumps(
+        {"messages": [{"role": "user", "content": "hello compile ledger"}],
+         "max_tokens": 12, "temperature": 0.0}),
+        {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    payload = json.loads(resp.read())
+    conn.close()
+    assert resp.status == 200, f"completion -> {resp.status}: {payload}"
+    assert payload["usage"]["completion_tokens"] > 0
+    ttft_ms = payload["timings"]["ttft_ms"]
+
+    st, doc = get("/debug/compile")
+    doc = json.loads(doc)
+    assert doc["compiles"] == compiles_before, (
+        f"warmed first request still compiled "
+        f"{doc['compiles'] - compiles_before} computations: "
+        f"{doc['entries'][-5:]}")
+    assert doc["unexpected"] == 0
+    # the warmed TTFT must sit far below the compile bill warmup absorbed
+    # (a cold boot pays ~that bill on its first request)
+    assert ttft_ms < warm["seconds"] * 1000, (
+        f"warmed ttft {ttft_ms}ms not below the {warm['seconds']}s "
+        "cold-boot compile bill — warmup stopped covering serving shapes?")
+    tr = doc["transfers"]
+    assert tr["sites"].get("h2d.prefill", {}).get("bytes", 0) > 0
+    assert tr["sites"].get("d2h.decode_tokens", {}).get("bytes", 0) > 0
+    assert doc["device_memory"]["buffers"] > 0
+
+    st, h = get("/health")
+    h = json.loads(h)
+    assert st == 200 and h["compile"]["unexpected_compiles"] == 0
+    assert h["compile"]["full_coverage"] is True
+    assert h["build"]["warmup"] == "auto"
+
+    st, m = get("/metrics")
+    assert st == 200
+    assert re.search(r"^dllama_jit_compiles_total\{", m, re.M)
+    assert not re.search(
+        r'^dllama_jit_unexpected_compiles_total\{[^}]*\} [1-9]', m, re.M)
+    print(f"PASS: compile serve OK — {warm['buckets']} buckets warmed in "
+          f"{warm['seconds']}s with full coverage; warmed first-request "
+          f"ttft {ttft_ms}ms, zero compiles, zero unexpected, strict "
+          "transfer guard clean")
+finally:
+    proc.send_signal(signal.SIGTERM)  # exercises the graceful drain path
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+PY

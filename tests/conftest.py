@@ -77,6 +77,13 @@ _RUN_LAST = (
 )
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the time-budgeted tier-1 run (-m 'not slow') — "
+        "long drills whose coverage an un-budgeted `pytest tests/` keeps")
+
+
 def pytest_collection_modifyitems(config, items):
     first = {name: i - len(_RUN_FIRST) for i, name in enumerate(_RUN_FIRST)}
     last = {name: i + 1 for i, name in enumerate(_RUN_LAST)}

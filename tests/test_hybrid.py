@@ -18,6 +18,13 @@ Contracts driven here:
   windowed ITL p95 violates --slo-itl-ms and grows it under headroom.
 
 Tiny config + memoized workloads, same discipline as test_paged_kv.py.
+Engines are SESSION-SHARED across the scheduler matrix (keyed on the
+shapes that force a rebuild: layout, spec, n_slots): every run after the
+first reuses the resident jitted callables via engine.warm_restart() —
+decode state, page pool, and radix tree rebuilt, ZERO recompiles — which
+is what keeps this suite from displacing the tier-1 tail past the time
+budget (the PR 11 regression ISSUE 13 calls out). Every submit is seeded,
+so shared PRNG/admission counters cannot leak between runs.
 """
 
 import time
@@ -40,13 +47,37 @@ PAGE = 8
 LONG_PROMPT = [int(x) % 90 + 1 for x in range(7, 31)]  # 24 tokens: several
 # budget-4 slices, so the admission really rides multiple hybrid chunks
 
+_ENGINES: dict = {}
+
+
+def _engine(layout, spec=0, n_slots=3):
+    """Session-shared engine (one XLA compile set per key). Reuse goes
+    through warm_restart(): decode state + pool + an EMPTY radix tree are
+    rebuilt against the resident weights while the jitted callables — and
+    their compiles — survive, so no run sees another run's cache."""
+    key = (layout, spec, n_slots)
+    eng = _ENGINES.get(key)
+    if eng is None:
+        eng = _ENGINES[key] = BatchEngine(
+            CFG, PARAMS, n_slots=n_slots, cache_dtype=jnp.float32, spec=spec,
+            kv_layout=layout, page_size=PAGE, radix_cache="auto",
+            max_prefill_chunk=8)
+        return eng
+    if eng.pool is not None and eng.radix is None:
+        # a radix="off" run disabled the tree for its scheduler's lifetime;
+        # restore it so warm_restart rebuilds it against the fresh pool
+        from dllama_tpu.engine.radix import RadixCache
+
+        eng.radix = RadixCache(eng.pool)
+    eng.warm_restart()
+    return eng
+
 
 def _sched(layout, *, overlap=True, spec=0, radix="auto", budget="auto",
-           n_slots=3, chunk=3, kv_pages=0, max_prefill_chunk=8, **kw):
-    eng = BatchEngine(CFG, PARAMS, n_slots=n_slots, cache_dtype=jnp.float32,
-                      spec=spec, kv_layout=layout, page_size=PAGE,
-                      kv_pages=kv_pages, radix_cache=radix,
-                      max_prefill_chunk=max_prefill_chunk)
+           n_slots=3, chunk=3, **kw):
+    eng = _engine(layout, spec, n_slots)
+    if radix == "off" and eng.radix is not None:
+        eng.radix = None  # per-run opt-out; _engine restores it on reuse
     return Scheduler(eng, chunk=chunk, overlap=overlap,
                      prefill_budget=budget, **kw)
 
@@ -193,6 +224,7 @@ def test_preempt_resume_bit_exact_greedy_and_sampled():
     assert _preempt_run(11, 0.8) == _uninterrupted(11, 0.8)
 
 
+@pytest.mark.slow
 def test_preempt_survives_warm_restart():
     """A request preempted to pages survives a worker crash while suspended
     (its resume record is host-side; the dead tree just costs a re-prefill)
@@ -259,6 +291,7 @@ def test_wfq_starvation_bound():
         sched.shutdown()
 
 
+@pytest.mark.slow
 def test_tenant_weights_skew_service():
     """A 4x-weighted tenant is charged 1/4 the virtual time per request, so
     its backlog drains ahead of an equal flood from a weight-1 tenant."""
@@ -382,6 +415,7 @@ def test_budget_controller_shrinks_and_grows():
     assert ctl3.update(win) == 64
 
 
+@pytest.mark.slow
 def test_budget_honors_itl_slo_under_long_prompt_flood():
     """Integration: an impossible ITL target + a flood of long prompts
     drives the windowed p95 over target, and the auto budget SHRINKS while

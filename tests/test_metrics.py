@@ -640,6 +640,57 @@ def test_health_carries_process_self_metrics(mserver):
     assert proc["threads"] >= 2  # worker + this handler at minimum
 
 
+def test_debug_compile_ledger_transfers_and_health_object(mserver):
+    """GET /debug/compile (ISSUE 13): after served traffic the document
+    carries the jit ledger (per-fn totals + entries with shape sigs),
+    shape-bucket contract coverage, transfer tallies (boundary uploads +
+    per-chunk downloads), and live device memory; /health answers the
+    compile object (recompile storms visible without a scrape) and the
+    dllama_jit_* / dllama_transfer* series render on /metrics."""
+    port, _api, _ = mserver
+    st, data, _ = _post_raw(port, "/v1/chat/completions",
+                            {"messages": [{"role": "user", "content": "jit"}],
+                             "max_tokens": 6, "temperature": 0.0})
+    assert st == 200
+    st, data, _ = _get_raw(port, "/debug/compile")
+    assert st == 200
+    doc = json.loads(data)
+    tot = doc["totals"]
+    # the serving flow really billed its dispatch sites
+    assert tot["prefill_chunk"]["compiles"] >= 1
+    assert tot["decode"]["compiles"] >= 1
+    assert tot["commit"]["compiles"] >= 1
+    assert doc["unexpected"] == 0
+    assert any(e["fn"] == "decode" and e["sig"] for e in doc["entries"])
+    cov = doc["contract"]["fns"]
+    assert "decode" in cov and cov["decode"]["unexpected_seen"] == []
+    tr = doc["transfers"]
+    assert tr["sites"]["h2d.prefill"]["bytes"] > 0  # admission uploads
+    assert tr["sites"]["d2h.decode_tokens"]["bytes"] > 0  # token fetches
+    assert doc["device_memory"]["buffers"] > 0
+    assert doc["warmup"] is None  # mserver boots --warmup off
+    # /health: the compile object rides the probe
+    st, data, _ = _get_raw(port, "/health")
+    h = json.loads(data)
+    assert h["compile"]["unexpected_compiles"] == 0
+    assert h["compile"]["compiles"] >= 1
+    assert h["compile"]["warmup"] == "off"
+    assert h["build"]["warmup"] == "off"
+    # ... and /debug/perf folds the summary
+    st, data, _ = _get_raw(port, "/debug/perf")
+    assert json.loads(data)["compile"]["unexpected"] == 0
+    # the series render in the exposition
+    st, text, _ = _get_raw(port, "/metrics")
+    fams, samples = parse_exposition(text.decode())
+    assert fams["dllama_jit_compiles_total"] == "counter"
+    assert fams["dllama_jit_unexpected_compiles_total"] == "counter"
+    assert samples[("dllama_jit_compiles_total", '{fn="decode"}')] >= 1
+    assert samples[("dllama_transfer_bytes_total",
+                    '{direction="d2h",site="decode_tokens"}')] > 0
+    assert samples[("dllama_device_live_buffers", "")] > 0
+    assert samples[("dllama_device_live_bytes", "")] > 0
+
+
 def test_postmortem_gains_slo_verdict(mserver):
     """/debug/requests/{req_id} postmortems judge the request's recorded
     marks against the configured SLOs: ttft_ok/itl_ok plus violated_by_ms,
