@@ -1,0 +1,87 @@
+"""``python -m dllama_tpu.analysis`` — run the invariant analyzer.
+
+Exit 0 with zero findings, 1 otherwise. Diagnostics are one per line in
+``file:line: rule-id message`` form (editor/CI clickable); ``--json``
+emits the machine-readable document instead. ``--lock-graph`` prints the
+static lock-order edges (holder -> acquired @ site) and exits 0 — the
+graph behind the ``lock-order`` verdicts.
+
+Stdlib-only and jax-free by construction: importing jax here would drag
+seconds of startup into a gate scripts/checks.sh runs on every commit
+(an assertion in scripts/analysis_smoke.sh pins this).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _detect_root(explicit: str | None) -> str:
+    if explicit:
+        return os.path.abspath(explicit)
+    # <root>/dllama_tpu/analysis/__main__.py
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dllama_tpu.analysis",
+        description="dllama-tpu static invariant analyzer (ISSUE 14)")
+    ap.add_argument("--root", default=None,
+                    help="repo root to analyze (default: this checkout)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable diagnostics on stdout")
+    ap.add_argument("--lock-graph", action="store_true",
+                    help="print the static lock-order edges and exit")
+    args = ap.parse_args(argv)
+
+    # the container may pre-import jax via sitecustomize; what matters is
+    # that the ANALYZER itself never pulls it in (sub-5s CI gate)
+    had_jax = "jax" in sys.modules
+    t0 = time.monotonic()
+    from dllama_tpu.analysis.core import RULE_CATALOG, Project, run
+
+    assert had_jax or "jax" not in sys.modules, \
+        "the analyzer must not import jax"
+
+    project = Project.from_disk(_detect_root(args.root))
+
+    if args.lock_graph:
+        from dllama_tpu.analysis.rules_locks import build_graph
+        from dllama_tpu.utils.locks import LOCK_RANKS
+
+        edges, _reentrant, _ca, _mg = build_graph(project)
+        for holder, acquired, rel, line in sorted(set(edges)):
+            hr = LOCK_RANKS.get(holder, "?")
+            ar = LOCK_RANKS.get(acquired, "?")
+            print(f"{holder}({hr}) -> {acquired}({ar})  @ {rel}:{line}")
+        return 0
+
+    diags = run(project)
+    dt = time.monotonic() - t0
+    if args.json:
+        print(json.dumps({
+            "findings": [{"path": d.path, "line": d.line, "rule": d.rule,
+                          "message": d.message} for d in diags],
+            "count": len(diags),
+            "files": len(project.sources),
+            "rules": len(RULE_CATALOG),
+            "seconds": round(dt, 3),
+        }, indent=2))
+    else:
+        for d in diags:
+            print(d)
+        status = "FAIL" if diags else "OK"
+        print(f"analysis: {status} — {len(diags)} finding(s) over "
+              f"{len(project.sources)} files, {len(RULE_CATALOG)} rules "
+              f"({dt:.2f}s, no jax)", file=sys.stderr)
+    return 1 if diags else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

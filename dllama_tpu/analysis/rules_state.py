@@ -1,0 +1,224 @@
+"""Device-state write discipline (``dev-state``) and the steady-state
+host-transfer lint (``transfer-note``).
+
+``dev-state``: the decode carry arrays ``_pos_dev`` / ``_last_dev`` /
+``_keys_dev`` are DEVICE-authoritative — the fused scans mutate them with
+data-dependent values (sampled tokens, threefry splits, spec advances)
+that the host cannot mirror mid-flight, so a bulk re-upload from a host
+mirror can clobber an in-flight overlapped chunk's carry (the exact bug
+class PR 10 shipped and PR 13's transfer guard only catches at runtime).
+Sanctioned write shapes, everything else is an error:
+
+* surgical per-row writes: ``self.X = self.X.at[row].set(...)``;
+* carry unpacking from a jit call: ``(..., self.X, ...) = self._decode(...)``;
+* rebinding a local name (itself a carry from an unpack);
+* anything inside the boundary-rebuild sites ``__init__`` /
+  ``warm_restart`` / ``_sync_vectors``.
+
+``transfer-note``: inside the steady-state decode/spec functions of
+``engine/batch.py``, any host<->device materialization (``np.asarray`` /
+``jnp.asarray`` / ``device_get`` / ``device_put`` / ``block_until_ready``)
+must sit AT a ``note_transfer``-annotated site: a statement within
+``NOTE_WINDOW`` statements of a ``note_transfer`` call in some enclosing
+statement list (so transfers nested under a ``with`` scope count their
+enclosing statement's position). Function-level exemption would let a new
+unannotated upload ride an unrelated note elsewhere in the function — an
+unannotated transfer in the steady path is PR 3's zero-upload invariant
+silently eroding. (Host-side ``.copy()`` of numpy mirrors is not a
+transfer; the upload it feeds is caught at its ``jnp.asarray``. The one
+aggregated-fan site, ``_sync_vectors``, carries a reasoned suppression.)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dllama_tpu.analysis.core import Diagnostic, dotted, parent_map
+
+#: device-authoritative attrs (the host mirrors are pos/last_token/keys)
+DEV_ATTRS = ("_pos_dev", "_last_dev", "_keys_dev")
+
+#: functions allowed to rebuild the carries wholesale: construction, the
+#: crash-recovery rebuild, and the boundary vector fan
+SANCTIONED_FNS = ("__init__", "warm_restart", "_sync_vectors")
+
+#: the steady-state functions of engine/batch.py the transfer lint guards
+STEADY_FILE = "dllama_tpu/engine/batch.py"
+STEADY_FNS = ("decode_dispatch", "_spec_dispatch", "hybrid_dispatch",
+              "decode_consume", "_sync_vectors", "nonfinite")
+
+_TRANSFER_CALLS = {"np.asarray", "numpy.asarray", "jnp.asarray",
+                   "jnp.array", "jax.device_get", "jax.device_put",
+                   "jax.block_until_ready"}
+
+#: a transfer is "annotated" when a note_transfer call sits within this
+#: many statements of it in some enclosing statement list
+NOTE_WINDOW = 4
+
+
+def _is_self_attr(node: ast.AST, attrs=DEV_ATTRS) -> str | None:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self" and node.attr in attrs):
+        return node.attr
+    return None
+
+
+def _is_at_write(value: ast.AST, attr: str) -> bool:
+    """value is self.<attr>.at[...].set/add/mul/...(...)?"""
+    if not (isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Attribute)):
+        return False
+    sub = value.func.value
+    if not isinstance(sub, ast.Subscript):
+        return False
+    at = sub.value
+    return (isinstance(at, ast.Attribute) and at.attr == "at"
+            and _is_self_attr(at.value) == attr)
+
+
+def _check_dev_state(src, diags):
+    func_stack: list[str] = []
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            func_stack.append(node.name)
+            self.generic_visit(node)
+            func_stack.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def _sanctioned(self) -> bool:
+            return any(f in SANCTIONED_FNS for f in func_stack)
+
+        def _flag(self, node, attr, why):
+            diags.append(Diagnostic(
+                src.rel, node.lineno, "dev-state",
+                f"whole-array rebind of device-authoritative self.{attr} "
+                f"({why}) — write per-row via .at[slot].set(...), or do it "
+                f"in {'/'.join(SANCTIONED_FNS)} (an in-flight overlapped "
+                "chunk's carry would be clobbered)"))
+
+        def visit_Assign(self, node):
+            if not self._sanctioned():
+                for t in node.targets:
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        # carry unpack from a jit call is THE sanctioned
+                        # whole-array source; anything else is not
+                        if not isinstance(node.value, ast.Call):
+                            for el in t.elts:
+                                a = _is_self_attr(el)
+                                if a:
+                                    self._flag(node, a,
+                                               "tuple rebind from a "
+                                               "non-call value")
+                        continue
+                    a = _is_self_attr(t)
+                    if a is None:
+                        continue
+                    v = node.value
+                    if _is_at_write(v, a) or isinstance(v, ast.Name):
+                        continue
+                    self._flag(node, a, f"assigned {type(v).__name__}")
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            a = _is_self_attr(node.target)
+            if a and not self._sanctioned():
+                self._flag(node, a, "augmented assignment")
+            self.generic_visit(node)
+
+    V().visit(src.tree)
+
+
+def _is_note(stmt: ast.AST) -> bool:
+    """The statement ITSELF (not a nested sub-block) calls note_transfer —
+    descending into child statement lists would let a compound statement
+    (an ``if`` holding both a transfer and a note deep inside) annotate
+    its own transfers from the outer level."""
+    stack = [stmt]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Call):
+            d = dotted(n.func)
+            if d is not None and d.split(".")[-1] == "note_transfer":
+                return True
+        for name, value in ast.iter_fields(n):
+            if name in ("body", "orelse", "finalbody", "handlers") \
+                    and isinstance(value, list):
+                continue  # nested statement lists are their own level
+            if isinstance(value, list):
+                stack.extend(v for v in value if isinstance(v, ast.AST))
+            elif isinstance(value, ast.AST):
+                stack.append(value)
+    return False
+
+
+def _blocks_of(fn: ast.FunctionDef):
+    """Every statement list in `fn` (bodies of the function, ifs, withs,
+    loops, try arms) as (list, {stmt_node: index})."""
+    out = []
+    stack = [fn]
+    while stack:
+        node = stack.pop()
+        for attr in ("body", "orelse", "finalbody"):
+            stmts = getattr(node, attr, None)
+            if isinstance(stmts, list) and stmts:
+                out.append((stmts, {id(s): i for i, s in enumerate(stmts)}))
+                stack.extend(stmts)
+        for h in getattr(node, "handlers", []) or []:
+            out.append((h.body, {id(s): i for i, s in enumerate(h.body)}))
+            stack.extend(h.body)
+    return out
+
+
+def _check_transfers(src, diags, parents):
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.FunctionDef) \
+                or node.name not in STEADY_FNS:
+            continue
+        blocks = _blocks_of(node)
+        noted_idx = [({id(s) for s in stmts},
+                      sorted(i for i, s in enumerate(stmts) if _is_note(s)))
+                     for stmts, _ in blocks]
+
+        def annotated(call: ast.AST) -> bool:
+            # walk ancestor statements: at each enclosing statement list,
+            # is a note_transfer-bearing statement within NOTE_WINDOW?
+            cur = call
+            while cur is not node:
+                parent = parents.get(cur)
+                if parent is None:
+                    break
+                for (stmts, index), (ids, notes) in zip(blocks, noted_idx):
+                    if id(cur) in ids:
+                        i = index[id(cur)]
+                        if any(abs(i - j) <= NOTE_WINDOW for j in notes):
+                            return True
+                cur = parent
+            return False
+
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = dotted(sub.func)
+            is_xfer = (d in _TRANSFER_CALLS
+                       or (isinstance(sub.func, ast.Attribute)
+                           and sub.func.attr == "block_until_ready"))
+            if is_xfer and not annotated(sub):
+                diags.append(Diagnostic(
+                    src.rel, sub.lineno, "transfer-note",
+                    f"host<->device transfer ({d or 'block_until_ready'}) "
+                    f"in steady-state {node.name}() with no "
+                    f"note_transfer(...) within {NOTE_WINDOW} statements — "
+                    "the zero-steady-upload invariant (PR 3/13) erodes "
+                    "invisibly"))
+
+
+def check(project) -> list[Diagnostic]:
+    diags: list[Diagnostic] = []
+    for src in project.py_sources("dllama_tpu/engine/"):
+        _check_dev_state(src, diags)
+    steady = project.source(STEADY_FILE)
+    if steady is not None and steady.parse_error() is None:
+        _check_transfers(steady, diags, parent_map(steady.tree))
+    return diags

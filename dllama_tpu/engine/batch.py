@@ -43,6 +43,7 @@ from dllama_tpu.obs import compile as compile_obs
 from dllama_tpu.obs import instruments as ins
 from dllama_tpu.obs import trace
 from dllama_tpu.utils import faults
+from dllama_tpu.utils import locks
 
 log = logging.getLogger("dllama_tpu.engine")
 
@@ -98,8 +99,10 @@ class PagePool:
         # reentrant: the scheduler worker is the only mutator, but audit()
         # is also served from HTTP handler threads (GET /debug/kv) — the
         # lock keeps a cross-thread audit from reading a half-applied
-        # mutation as corruption
-        self._mu = threading.RLock()
+        # mutation as corruption. Named rank "engine.pool" (utils/locks):
+        # the radix prefix tree shares this object, and DLLAMA_LOCK_AUDIT=1
+        # turns any out-of-rank nesting under it into a raise
+        self._mu = locks.make_rlock("engine.pool")
         # DLLAMA_POOL_AUDIT=1: run the full invariant check after EVERY
         # release (tests/conftest.py arms it for the whole suite — any page
         # leak fails at the release that caused it, not at drain)
@@ -1302,8 +1305,9 @@ class BatchEngine:
 
     def _pool_page_copy(self, src_page: int, dst_page: int) -> None:
         """PagePool's device-copy callback (copy-on-write page clones)."""
-        self.cache = self._copy_page(
-            self.cache, jnp.int32(src_page), jnp.int32(dst_page))
+        with compile_obs.LEDGER.scope("boundary", "page_copy"):
+            self.cache = self._copy_page(
+                self.cache, jnp.int32(src_page), jnp.int32(dst_page))
 
     def _row_limit(self) -> np.ndarray:
         """i32[B] per-slot decode row limit: the cache edge (seq_len) on
@@ -1417,9 +1421,10 @@ class BatchEngine:
         if self.spec_k and hit.rows:
             # the mapped prefix's token ids feed the n-gram proposer, same
             # as the cross-slot copy path did
-            self.history = self._hist_write(
-                self.history, jnp.int32(slot), jnp.int32(0),
-                jnp.asarray(np.asarray(hit.tokens, np.int32)))
+            with compile_obs.LEDGER.scope("boundary", "hist"):
+                self.history = self._hist_write(
+                    self.history, jnp.int32(slot), jnp.int32(0),
+                    jnp.asarray(np.asarray(hit.tokens, np.int32)))
         self._vec_dirty = True
 
     def radix_insert(self, slot: int, toks) -> int:
@@ -1562,7 +1567,7 @@ class BatchEngine:
             self._counts = jnp.zeros((self.n_slots, self.cfg.vocab_size),
                                      jnp.int32)
 
-    def _warm_worklist(self, chunk: int, hybrid_budget_hi: int) -> list:
+    def _warm_worklist(self, chunk: int, hybrid_budget_hi: int) -> list:  # dllama: allow[jit-scope] thunks dispatch under ledger.scope(fn, key) in warmup()
         """(fn, key, thunk) for every warm-target bucket. Each thunk
         dispatches the REAL jitted callable with inert operands — the
         all-inactive masks freeze every decode row (writes masked, keys/
@@ -1832,16 +1837,19 @@ class BatchEngine:
             self.pool.share_prefix(src_slot, dst_slot, rows,
                                    self._pool_page_copy)
         else:
-            self.cache = self._copy_rows(
-                self.cache, jnp.int32(src_slot), jnp.int32(dst_slot), jnp.int32(rows)
-            )
+            with compile_obs.LEDGER.scope("boundary", "copy_rows"):
+                self.cache = self._copy_rows(
+                    self.cache, jnp.int32(src_slot), jnp.int32(dst_slot),
+                    jnp.int32(rows)
+                )
         if self.spec_k:
             # the shared prefix's token ids come along so the n-gram
             # proposer can draft from it in the new slot too (masked full-row
             # copy: one compile serves every prefix length)
-            self.history = self._hist_copy_prefix(
-                self.history, jnp.int32(src_slot), jnp.int32(dst_slot),
-                jnp.int32(rows))
+            with compile_obs.LEDGER.scope("boundary", "hist"):
+                self.history = self._hist_copy_prefix(
+                    self.history, jnp.int32(src_slot), jnp.int32(dst_slot),
+                    jnp.int32(rows))
         self.pos[dst_slot] = rows
         self._pos_dev = self._pos_dev.at[dst_slot].set(int(rows))
         self._vec_dirty = True
@@ -1891,10 +1899,11 @@ class BatchEngine:
             # the n-gram proposer drafts from the prompt too — that's the
             # whole point of prompt lookup
             compile_obs.note_transfer("h2d", "history", c * 4)
-            self.history = self._hist_write(
-                self.history, jnp.int32(slot), jnp.int32(self.pos[slot]),
-                jnp.asarray(adm.toks[off : off + c]),
-            )
+            with compile_obs.LEDGER.scope("boundary", "hist"):
+                self.history = self._hist_write(
+                    self.history, jnp.int32(slot), jnp.int32(self.pos[slot]),
+                    jnp.asarray(adm.toks[off : off + c]),
+                )
         if self._use_slot_prefill:
             if self.pool is not None:
                 # the slot's block table changed at add_begin (page alloc /
@@ -2008,10 +2017,11 @@ class BatchEngine:
             self._counts = self._counts.at[slot].set(0)
         if self.spec_k:
             # invariant: history[slot, pos] holds the slot's unfed token
-            self.history = self._hist_write(
-                self.history, jnp.int32(slot), jnp.int32(self.pos[slot]),
-                jnp.full((1,), first, jnp.int32),
-            )
+            with compile_obs.LEDGER.scope("boundary", "hist"):
+                self.history = self._hist_write(
+                    self.history, jnp.int32(slot), jnp.int32(self.pos[slot]),
+                    jnp.full((1,), first, jnp.int32),
+                )
         return first
 
     def resume_commit(self, adm: "Admission", last_token: int, key,
@@ -2051,10 +2061,11 @@ class BatchEngine:
             self._counts = self._counts.at[slot].set(jnp.asarray(row))
         if self.spec_k:
             # invariant: history[slot, pos] holds the slot's unfed token
-            self.history = self._hist_write(
-                self.history, jnp.int32(slot), jnp.int32(self.pos[slot]),
-                jnp.full((1,), int(last_token), jnp.int32),
-            )
+            with compile_obs.LEDGER.scope("boundary", "hist"):
+                self.history = self._hist_write(
+                    self.history, jnp.int32(slot), jnp.int32(self.pos[slot]),
+                    jnp.full((1,), int(last_token), jnp.int32),
+                )
 
     def add(self, slot: int, prompt_tokens: list[int], temperature: float = 0.8,
             topp: float = 0.9, start_pos: int = 0, seed: int | None = None,
@@ -2084,7 +2095,7 @@ class BatchEngine:
         return self.add_commit(adm, temperature, topp, seed,
                                presence=presence, frequency=frequency)
 
-    def _sync_vectors(self) -> None:
+    def _sync_vectors(self) -> None:  # dllama: allow[transfer-note] ONE aggregated note_transfer("h2d","vectors",nbytes) at the end of the fan accounts every upload above it
         """Refresh the device copies of the host-authoritative per-slot
         vectors. A no-op in steady-state decode: only admission/commit/
         release/copy mark them dirty, so the old per-chunk six-array upload
@@ -2228,8 +2239,9 @@ class BatchEngine:
             # literally zero host->device uploads (ISSUE 13).
             fits_dev = self._active_dev & (pos_before + 1 + n
                                            <= self.seq_len + 1)
-            self.history = self._hist_write_batch(
-                self.history, toks.T, pos_before, fits_dev)
+            with compile_obs.LEDGER.scope("boundary", "hist_batch"):
+                self.history = self._hist_write_batch(
+                    self.history, toks.T, pos_before, fits_dev)
         # the host pos mirror advances arithmetically — exactly what the scan
         # computes — so it stays current without waiting for the tokens
         self.pos += advance
@@ -2287,10 +2299,11 @@ class BatchEngine:
         if self.spec_k:
             # prompt tokens feed the n-gram proposer exactly like add_step
             compile_obs.note_transfer("h2d", "history", c * 4)
-            self.history = self._hist_write(
-                self.history, jnp.int32(slot), jnp.int32(ppos),
-                jnp.asarray(adm.toks[adm.off : adm.off + c]),
-            )
+            with compile_obs.LEDGER.scope("boundary", "hist"):
+                self.history = self._hist_write(
+                    self.history, jnp.int32(slot), jnp.int32(ppos),
+                    jnp.asarray(adm.toks[adm.off : adm.off + c]),
+                )
         self._sync_vectors()
         pos_before = self._pos_dev
         ptoks = jnp.asarray(adm.toks[adm.off : adm.off + c][None])
@@ -2352,8 +2365,9 @@ class BatchEngine:
             # device-side fits mask, same reasoning as decode_dispatch
             fits_dev = self._active_dev & (pos_before + 1 + n
                                            <= self.seq_len + 1)
-            self.history = self._hist_write_batch(
-                self.history, toks.T, pos_before, fits_dev)
+            with compile_obs.LEDGER.scope("boundary", "hist_batch"):
+                self.history = self._hist_write_batch(
+                    self.history, toks.T, pos_before, fits_dev)
         self.pos += advance
         self.chunk_seq += 1
         ins.PREFILL_TOKENS.inc(c)
@@ -2476,14 +2490,16 @@ class BatchEngine:
             emits = toks
             advs = np.asarray(chunk.adv_dev).astype(np.int32)  # [m, B]
             drafted = np.asarray(chunk.drafted_dev).astype(np.int32)
-            total = advs.sum(axis=0).astype(np.int32)  # [B]
-            chunk.advance = total
-            chunk.adv_cycles = advs
             chunk.start_pos = np.asarray(chunk.start_dev).astype(np.int32)
+            # accounted immediately after the three materializations above
+            # (the transfer-note rule windows the annotation to its site)
             compile_obs.note_transfer(
                 "d2h", "spec_counts",
                 int(advs.nbytes) + int(drafted.nbytes)
                 + int(chunk.start_pos.nbytes))
+            total = advs.sum(axis=0).astype(np.int32)  # [B]
+            chunk.advance = total
+            chunk.adv_cycles = advs
             m_cycles, b = advs.shape
             # flatten each slot's accepted runs (cycle-major) with one
             # boolean-mask gather per emitting slot — C-speed, not an

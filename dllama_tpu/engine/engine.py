@@ -25,6 +25,7 @@ import numpy as np
 from dllama_tpu.engine.sampling import Sampler
 from dllama_tpu.models.config import LlamaConfig
 from dllama_tpu.models.llama import KVCache, forward
+from dllama_tpu.obs import compile as compile_obs
 from dllama_tpu.obs import instruments as ins
 from dllama_tpu.ops.layers import build_rope_cache
 
@@ -266,9 +267,17 @@ class InferenceEngine:
         t = tokens.shape[1]
         if self.pos + t > self.seq_len:
             raise ValueError(f"position {self.pos}+{t} exceeds seq_len {self.seq_len}")
-        logits, self.cache = self._step(
-            self.params, self.cache, jnp.asarray(tokens, jnp.int32), jnp.int32(self.pos), self.rope_cache
-        )
+        # compile attribution (ISSUE 14): the single-engine tier's jit
+        # dispatches are ledger-scoped like the batched tier's, so its
+        # compiles land under labeled fns instead of "untracked"
+        toks_dev = jnp.asarray(tokens, jnp.int32)
+        with compile_obs.LEDGER.scope(
+                "single_step", f"m{t}",
+                sig=lambda: compile_obs.sig_of(toks_dev)):
+            logits, self.cache = self._step(
+                self.params, self.cache, toks_dev, jnp.int32(self.pos),
+                self.rope_cache
+            )
         self.pos += t
         return logits
 
@@ -398,14 +407,18 @@ class InferenceEngine:
         """Fused n-step greedy decode on device; returns tokens [n, B]."""
         if self.pos + n > self.seq_len:
             raise ValueError(f"position {self.pos}+{n} exceeds seq_len {self.seq_len}")
-        toks, self.cache = self._decode_n(
-            self.params,
-            self.cache,
-            jnp.asarray(token, jnp.int32).reshape(self.batch, 1),
-            jnp.int32(self.pos),
-            self.rope_cache,
-            n,
-        )
+        tok_dev = jnp.asarray(token, jnp.int32).reshape(self.batch, 1)
+        with compile_obs.LEDGER.scope(
+                "single_decode", f"n{n}",
+                sig=lambda: compile_obs.sig_of(tok_dev)):
+            toks, self.cache = self._decode_n(
+                self.params,
+                self.cache,
+                tok_dev,
+                jnp.int32(self.pos),
+                self.rope_cache,
+                n,
+            )
         self.pos += n
         return np.asarray(toks)
 
@@ -456,10 +469,13 @@ class InferenceEngine:
             h[self.pos - hist.shape[0] : self.pos] = hist
             h[self.pos] = token
             h = jnp.asarray(h)
-        out, cnt, cyc, self.cache, h_out, pos = self._spec_decoders[key](
-            self.params, self.cache, h, jnp.int32(token),
-            jnp.int32(self.pos), self.rope_cache, n,
-        )
+        with compile_obs.LEDGER.scope(
+                "single_spec", f"n{n}",
+                sig=lambda: compile_obs.sig_of(h)):
+            out, cnt, cyc, self.cache, h_out, pos = self._spec_decoders[key](
+                self.params, self.cache, h, jnp.int32(token),
+                jnp.int32(self.pos), self.rope_cache, n,
+            )
         cnt = int(cnt)
         m = min(n, cnt)
         toks = np.asarray(out)[:m]
@@ -493,12 +509,17 @@ class InferenceEngine:
             jnp.float32(sampler.temperature),
             jnp.float32(sampler.topp),
         )
-        if counts is not None and sampler.has_penalties:
-            toks, self.cache = self._decode_penalized_n(
-                *args, jnp.asarray(counts, jnp.int32).reshape(self.batch, -1),
-                jnp.float32(sampler.presence), jnp.float32(sampler.frequency))
-        else:
-            toks, self.cache = self._decode_sample_n(*args)
+        with compile_obs.LEDGER.scope(
+                "single_decode", f"n{n}",
+                sig=lambda: compile_obs.sig_of(args[2])):
+            if counts is not None and sampler.has_penalties:
+                toks, self.cache = self._decode_penalized_n(
+                    *args,
+                    jnp.asarray(counts, jnp.int32).reshape(self.batch, -1),
+                    jnp.float32(sampler.presence),
+                    jnp.float32(sampler.frequency))
+            else:
+                toks, self.cache = self._decode_sample_n(*args)
         self.pos += n
         return np.asarray(toks)
 

@@ -12,6 +12,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from dllama_tpu.obs import compile as compile_obs
+
 
 # top-p candidate-set width: nucleus sampling restricts to the approx-top-K
 # logits instead of full-vocab sort (see sample_logits). At real-vocab sizes
@@ -136,4 +138,9 @@ class Sampler:
 
     def __call__(self, logits: jax.Array) -> jax.Array:
         self.key, sub = jax.random.split(self.key)
-        return sample(logits, sub, self.temperature, self.topp)
+        # ledger-scoped like every jit dispatch (analysis rule jit-scope):
+        # the first-token sample's compile is attributed, not "untracked"
+        with compile_obs.LEDGER.scope(
+                "single_sample", f"b{logits.shape[0]}",
+                sig=lambda: compile_obs.sig_of(logits)):
+            return sample(logits, sub, self.temperature, self.topp)

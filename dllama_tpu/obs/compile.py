@@ -58,6 +58,7 @@ from collections import deque
 
 from dllama_tpu.obs import instruments as ins
 from dllama_tpu.obs import trace
+from dllama_tpu.utils import locks
 
 log = logging.getLogger("dllama_tpu.obs")
 
@@ -85,6 +86,20 @@ COMPILE_FNS = {
     "hybrid_pen": "the hybrid launch with penalty counts (keys p{P}.n{n})",
     "commit": "add_commit's first-token sample off the admission logits "
               "(key b1 — one [1, V] shape per engine)",
+    "single_sample": "the single-engine Sampler's jitted sample off "
+                     "prefill logits (keys b{B}; never contract-declared, "
+                     "so it cannot classify unexpected)",
+    "single_step": "the single-engine tier's jitted step "
+                   "(InferenceEngine.step: pow2 prefill chunks and "
+                   "decode_step; keys m{T} = token width)",
+    "single_decode": "the single-engine fused n-step decode scans "
+                     "(greedy, sampled and penalized variants; keys n{n})",
+    "single_spec": "the single-engine prompt-lookup speculative decode "
+                   "(keys n{n} = tokens requested from the chunk)",
+    "boundary": "small boundary carry ops (history writes, cross-slot row "
+                "copies, COW page clones, surgical .at row writes) — one-"
+                "time per-process compiles; attributed so steady-state "
+                "decode shows ZERO untracked compiles",
     "untracked": "compiles observed outside any instrumented dispatch "
                  "site (boundary eager ops, library use, other jits); "
                  "never classified unexpected, but counted — steady-state "
@@ -132,7 +147,7 @@ class ShapeContract:
     violate (direct library use), so it never classifies unexpected."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("obs.contract")
         # fn -> {key: {"note": str, "warm": bool}}
         self._buckets: dict[str, dict[str, dict]] = {}
         # fn -> {range_key: predicate} — keyed so re-declaring the same
@@ -261,7 +276,9 @@ class CompileLedger:
     same lifecycle as the metrics registry."""
 
     def __init__(self, max_entries: int = 256):
-        self._lock = threading.Lock()
+        # _on_event bumps the untracked compile counter while holding this
+        # (obs.ledger ranks below the obs.metrics leaf — rank-legal)
+        self._lock = locks.make_lock("obs.ledger")
         self._tls = threading.local()
         self.max_entries = int(max_entries)
         self.entries: deque = deque(maxlen=self.max_entries)
@@ -491,7 +508,7 @@ LEDGER = CompileLedger()
 
 # ------------------------------------------------------------- transfers
 
-_transfer_lock = threading.Lock()
+_transfer_lock = locks.make_lock("obs.transfers")
 # (direction, site) -> [count, bytes] — mirror of the counters so the
 # /debug payload can enumerate label combos without registry introspection
 _transfers: dict[tuple[str, str], list] = {}

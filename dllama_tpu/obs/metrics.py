@@ -30,7 +30,8 @@ from __future__ import annotations
 import bisect
 import math
 import re
-import threading
+
+from dllama_tpu.utils import locks
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -84,7 +85,9 @@ class _Family:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self._lock = threading.Lock()  # guards children dict AND child state
+        # guards children dict AND child state. LEAF rank (utils/locks):
+        # render/observe paths must never acquire anything under it
+        self._lock = locks.make_lock("obs.metrics")
         self._children: dict[tuple, object] = {}
 
     def _make_child(self):
@@ -275,7 +278,7 @@ class Registry:
     """Name -> family map with idempotent registration and text rendering."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("obs.metrics")
         self._families: dict[str, _Family] = {}
 
     def _register(self, cls, name, help, labelnames=(), **kw):

@@ -42,11 +42,11 @@ from __future__ import annotations
 
 import math
 import random
-import threading
 import time
 from dataclasses import dataclass
 
 from dllama_tpu.obs import instruments as ins
+from dllama_tpu.utils import locks
 
 #: v5e HBM bandwidth (public spec), the same constant
 #: experiments/hbm_traffic.py prices its offline rooflines against — the
@@ -87,7 +87,7 @@ class WindowQuantiles:
         self.cap = int(cap)
         self._slice_s = self.window_s / self.slices
         self._now = now_fn
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("obs.perf")
         # ring of (bucket_index, samples, seen); bucket = floor(now/slice_s)
         self._ring: list[tuple[int, list[float], int]] = []
 
@@ -171,7 +171,7 @@ class WindowSums:
         self.slices = int(slices)
         self._slice_s = self.window_s / self.slices
         self._now = now_fn
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("obs.perf")
         self._ring: list[tuple[int, dict]] = []
         self._t0 = now_fn()  # windows younger than window_s rate over age
 
@@ -230,7 +230,10 @@ class TimeLedger:
         self.states = tuple(states)
         self._counter = counter
         self._now = now_fn
-        self._lock = threading.Lock()
+        # _bill() increments the scheduler-time counter while holding this
+        # (obs.perf ranks below the obs.metrics leaf, so that nesting is
+        # rank-legal by construction)
+        self._lock = locks.make_lock("obs.perf")
         self.totals = {s: 0.0 for s in self.states}
         self._state: str | None = None
         self._t: float | None = None
@@ -268,6 +271,15 @@ class TimeLedger:
             now = self._now()
             self._bill(now)
             self._set(state, now)
+
+    def state(self) -> str | None:
+        """The current exclusive state (None before start()/after close())
+        — cross-thread readers (the scheduler's drain/watchdog idleness
+        check) join this with container occupancy, closing the false-idle
+        window while the worker holds a request BETWEEN containers (popped
+        from in-flight, slot not yet assigned)."""
+        with self._lock:
+            return self._state
 
     def poke(self) -> None:
         """Bill the open span without changing state (scrape freshness: a

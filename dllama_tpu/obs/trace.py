@@ -33,9 +33,10 @@ every layer can import it without cycles or optional-dependency gates.
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import OrderedDict, deque
+
+from dllama_tpu.utils import locks
 
 #: span names the serving stack emits — the documented contract between the
 #: instrumentation, the README trace-catalog table, and scripts/checks.sh's
@@ -225,7 +226,9 @@ class Tracer:
         self.capacity = int(capacity)
         self.max_requests = int(max_requests)
         self.max_chunks = int(max_chunks_per_request)
-        self._lock = threading.Lock()
+        # LEAF rank (utils/locks): record paths do pure ring/dict work and
+        # must never acquire anything under it
+        self._lock = locks.make_lock("obs.tracer")
         self._events: deque = deque(maxlen=self.capacity)
         self._dropped = 0
         self._tracks: dict[str, int] = {}

@@ -45,6 +45,7 @@ from dllama_tpu.obs import metrics, new_request_id, trace
 from dllama_tpu.obs import compile as compile_obs
 from dllama_tpu.obs import instruments as ins
 from dllama_tpu.obs import perf as perfmod
+from dllama_tpu.utils import locks
 from dllama_tpu.serve.scheduler import (
     QueueFull,
     SchedulerDraining,
@@ -193,7 +194,7 @@ class ApiServer:
         # prompt-lookup speculative decoding for greedy single-engine serving
         # (generate() ignores it for sampled requests and the batched tier)
         self.spec = int(spec)
-        self.lock = threading.Lock()
+        self.lock = locks.make_lock("api.single")
         self.model_name = "dllama-tpu"
         # continuous-batching tier: a serve/scheduler.Scheduler over a
         # BatchEngine — concurrent requests share the device, no global lock

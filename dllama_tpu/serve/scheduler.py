@@ -68,6 +68,7 @@ from dllama_tpu.obs import instruments as ins
 from dllama_tpu.obs import perf
 from dllama_tpu.obs import trace
 from dllama_tpu.utils import faults
+from dllama_tpu.utils import locks
 
 log = logging.getLogger("dllama_tpu.serve")
 
@@ -360,7 +361,7 @@ class Scheduler:
         # with plain decode chunks (toggle state) so it still advances.
         self._spec_tick = False
         self._completed: list[Request] = []  # ring of recent requests (metrics)
-        self._metrics_lock = threading.Lock()
+        self._metrics_lock = locks.make_lock("scheduler.metrics")
         ins.SLOTS_TOTAL.set(engine.n_slots)
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -565,10 +566,20 @@ class Scheduler:
 
     def _busy(self) -> bool:
         """Whether the worker owes anyone progress (watchdog gating: an idle
-        worker parked on its wake event must never read as stalled)."""
+        worker parked on its wake event must never read as stalled).
+
+        Container occupancy alone is NOT enough: during admission start and
+        commit the worker briefly holds a request in NO container (popped
+        from the backlog / in-flight list, slot not yet assigned) while
+        doing milliseconds of device work — a cross-thread drain() polling
+        exactly then used to read the system as idle and cut the request
+        mid-commit (found by the DLLAMA_LOCK_AUDIT timing perturbation,
+        ISSUE 14). The time ledger's exclusive state closes the window: the
+        worker is only truly idle when it says so."""
         return (bool(self.slots) or bool(self._inflight)
                 or bool(self._recover) or bool(self._backlog)
-                or self._deferred is not None or not self.pending.empty())
+                or self._deferred is not None or not self.pending.empty()
+                or self.ledger.state() not in ("idle", None))
 
     def health(self) -> dict:
         """Liveness + readiness snapshot for the API tier's /health.

@@ -49,12 +49,12 @@ from __future__ import annotations
 
 import logging
 import os
-import threading
 import time
 from dataclasses import dataclass, field
 
 from dllama_tpu.obs import instruments as ins
 from dllama_tpu.obs import trace
+from dllama_tpu.utils import locks
 
 log = logging.getLogger("dllama_tpu.faults")
 
@@ -94,7 +94,8 @@ class _Fault:
     times: int | None = None  # fire at most N times (None = forever)
     hits: int = 0  # total fire() visits (fired or not)
     fired: int = 0
-    lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    lock: object = field(
+        default_factory=lambda: locks.make_lock("faults.point"), repr=False)
 
     def visit(self) -> str | None:
         """Count one arrival at the point; return the action to apply (or
@@ -112,7 +113,7 @@ class _Fault:
 
 
 _plan: dict[str, _Fault] = {}
-_plan_lock = threading.Lock()
+_plan_lock = locks.make_lock("faults.plan")
 
 
 def parse(spec: str) -> list[_Fault]:

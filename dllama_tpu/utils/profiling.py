@@ -33,6 +33,7 @@ import numpy as np
 
 from dllama_tpu.obs import instruments as ins
 from dllama_tpu.obs import trace as reqtrace
+from dllama_tpu.utils import locks
 
 
 class ProfileBusy(RuntimeError):
@@ -42,7 +43,7 @@ class ProfileBusy(RuntimeError):
 
 #: the one-session profiler lock + state shared by trace() (CLI --trace)
 #: and start_profile() (POST /debug/profile)
-_prof_lock = threading.Lock()
+_prof_lock = locks.make_lock("utils.profiling")
 _prof_state = {"active": False, "dir": None, "started_at": 0.0,
                "duration_s": None}
 

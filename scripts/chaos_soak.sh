@@ -14,7 +14,11 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-exec env JAX_PLATFORMS=cpu DLLAMA_POOL_AUDIT=1 python experiments/chaos.py \
+# DLLAMA_LOCK_AUDIT=1 (ISSUE 14): the soak's five-plus concurrent threads
+# (clients, worker, watchdog, scrapes) run with the lock-order sanitizer
+# armed — a rank inversion raises at the acquisition, with both sites named
+exec env JAX_PLATFORMS=cpu DLLAMA_POOL_AUDIT=1 DLLAMA_LOCK_AUDIT=1 \
+    python experiments/chaos.py \
     --requests "${CHAOS_REQUESTS:-200}" \
     --seed "${CHAOS_SEED:-0}" \
     --clients "${CHAOS_CLIENTS:-4}"

@@ -9,10 +9,19 @@
 #      point in dllama_tpu/utils/faults.POINTS must appear in README.md.
 #      The catalogs are the single definition sites; this keeps the docs
 #      from silently rotting when an instrument, a trace point, or a fault
-#      point is added.
+#      point is added. (These syncs genuinely need the live registry
+#      import, so they stay here.)
+#   3. the repo-native invariant analyzer (ISSUE 14) as a HARD gate:
+#      `python -m dllama_tpu.analysis` — jit-dispatch discipline,
+#      device-state writes, single-site catalogs, the steady-state
+#      transfer lint, the static lock-order graph, and the textual
+#      contracts this script used to grep for (paged routes, bench
+#      records, perfdiff rules, the AOT inventory), all with file:line
+#      diagnostics. scripts/analysis_smoke.sh drills that the gate can
+#      actually fail.
 #
-# Pure host: imports only dllama_tpu.obs (stdlib-only — no jax, no model),
-# so it runs anywhere in <1s. Exit 0 = PASS.
+# Pure host: imports only dllama_tpu.obs/analysis (stdlib-only — no jax,
+# no model), so it runs anywhere in seconds. Exit 0 = PASS.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -140,81 +149,9 @@ print(f"checks: catalog drift OK ({len(metrics.REGISTRY.names())} metrics, "
       f"{len(compile_obs.COMPILE_FNS)} compile fns all documented)")
 PY
 
-# paged flash-decode kernel (ISSUE 8): the op must stay registered in the
-# AOT Mosaic gate's inventory — deleting the aot_check cases would let a
-# Mosaic rejection survive to a live window while kernel_select still
-# routes the kernel by default. Textual check (no jax import: this script
-# stays sub-second).
-grep -q "paged_decode_attention" experiments/aot_check.py || {
-    echo "checks: paged_decode_attention missing from the AOT gate" \
-         "(experiments/aot_check.py op inventory)" >&2; exit 1; }
-grep -q "fused scatter" experiments/aot_check.py || {
-    echo "checks: the AOT gate lost its fused-scatter paged cases" >&2
-    exit 1; }
-
-# ...and the README routing table must name every route kernel_select can
-# resolve the paged layout to (engine/kernel_select.PAGED_ROUTES is the
-# definition site; both directions checked textually)
-for route in paged_kernel paged_gather; do
-    grep -q "\"$route\"" dllama_tpu/engine/kernel_select.py || {
-        echo "checks: route '$route' missing from engine/kernel_select.py" \
-             "(PAGED_ROUTES drifted?)" >&2; exit 1; }
-    grep -q "| \`$route\` |" README.md || {
-        echo "checks: README 'Paged KV cache' routing table lost its" \
-             "'$route' row" >&2; exit 1; }
-done
-python - <<'PY'
-import re
-
-with open("dllama_tpu/engine/kernel_select.py", encoding="utf-8") as f:
-    m = re.search(r"PAGED_ROUTES\s*=\s*\(([^)]*)\)", f.read())
-assert m, "PAGED_ROUTES tuple missing from engine/kernel_select.py"
-routes = set(re.findall(r'"([a-z_]+)"', m.group(1)))
-with open("README.md", encoding="utf-8") as f:
-    readme_routes = set(re.findall(r"^\| `([a-z_]+)` \|", f.read(), re.M))
-extra = {r for r in readme_routes if r.startswith("paged_")} - routes
-missing = routes - readme_routes
-if extra or missing:
-    raise SystemExit(
-        "README paged-routing drift vs kernel_select.PAGED_ROUTES: "
-        f"readme-only={sorted(extra)} catalog-only={sorted(missing)}")
-print(f"checks: paged kernel AOT registration + routing table OK "
-      f"({len(routes)} routes)")
-PY
-
-# hybrid chunked prefill + preemption (ISSUE 12): the bench record and the
-# perf gate rules must keep covering the fused-step regression surface, and
-# the smoke target must keep existing. Textual (sub-second) checks.
-grep -q "def bench_hybrid" bench.py || {
-    echo "checks: bench.py lost its hybrid record (bench_hybrid)" >&2
-    exit 1; }
-grep -q "hybrid.stall_reduction_x" experiments/perfdiff.py || {
-    echo "checks: perfdiff rules lost hybrid.stall_reduction_x" >&2
-    exit 1; }
-grep -q "hybrid.ttft_overhead_x" experiments/perfdiff.py || {
-    echo "checks: perfdiff rules lost hybrid.ttft_overhead_x" >&2; exit 1; }
-test -x scripts/hybrid_smoke.sh || {
-    echo "checks: scripts/hybrid_smoke.sh missing or not executable" >&2
-    exit 1; }
-echo "checks: hybrid record + perf-gate rules + smoke target OK"
-
-# compile & device-traffic observability (ISSUE 13): the bench record, the
-# perfdiff zero-ceilings, and the smoke target must keep existing —
-# deleting any of them would un-gate the zero-recompile / zero-upload
-# invariants silently. Textual (sub-second) checks.
-grep -q "def bench_compile" bench.py || {
-    echo "checks: bench.py lost its compile record (bench_compile)" >&2
-    exit 1; }
-grep -q "compile.steady.unexpected_compiles" experiments/perfdiff.py || {
-    echo "checks: perfdiff rules lost compile.steady.unexpected_compiles" >&2
-    exit 1; }
-grep -q "compile.steady.upload_bytes" experiments/perfdiff.py || {
-    echo "checks: perfdiff rules lost compile.steady.upload_bytes" >&2
-    exit 1; }
-grep -q "compile.warmup_ttft_ratio" experiments/perfdiff.py || {
-    echo "checks: perfdiff rules lost compile.warmup_ttft_ratio" >&2
-    exit 1; }
-test -x scripts/compile_smoke.sh || {
-    echo "checks: scripts/compile_smoke.sh missing or not executable" >&2
-    exit 1; }
-echo "checks: compile record + zero-ceiling rules + smoke target OK"
+# everything textual that used to be grep'd here — the paged-route README
+# table (ISSUE 8), the hybrid/compile bench records and perfdiff rules
+# (ISSUES 12/13), the AOT inventory — plus the new invariant rules
+# (ISSUE 14) run as ONE analyzer pass with real file:line diagnostics
+python -m dllama_tpu.analysis
+echo "checks: invariant analyzer OK (jit/device-state/catalog/transfer/lock rules + repo gates)"

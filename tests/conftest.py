@@ -18,6 +18,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # any refcount/free-list corruption fails at the release that caused it,
 # suite-wide, instead of surfacing as a mystery page leak later.
 os.environ.setdefault("DLLAMA_POOL_AUDIT", "1")
+# Runtime lock-order sanitizer (utils/locks, ISSUE 14): every named lock
+# the stack creates audits its acquisition rank suite-wide — an
+# out-of-rank nesting (the shape that deadlocks once two threads
+# interleave) raises LockOrderError naming both hold sites, at the test
+# that introduced it. Must be set before dllama_tpu.obs imports.
+os.environ.setdefault("DLLAMA_LOCK_AUDIT", "1")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

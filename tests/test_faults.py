@@ -320,6 +320,41 @@ def test_drain_completes_inflight_then_rejects_new():
         sched.shutdown()
 
 
+def test_drain_waits_out_the_commit_window():
+    """ISSUE 14 regression: during admission commit the worker briefly
+    holds the request in NO container (popped from the in-flight list,
+    slot not yet assigned) while add_commit does device work — a
+    concurrent drain() polling exactly then used to read _busy() False,
+    declare the system idle, and cut the request mid-commit (surfaced as
+    an intermittent 503 by the DLLAMA_LOCK_AUDIT timing perturbation).
+    The time-ledger state join closes the window; this pins it OPEN with
+    a slowed commit and asserts drain waits for the request instead."""
+    sched = make_sched(n_slots=1)
+    try:
+        eng = sched.engine
+        in_commit = threading.Event()
+        orig = eng.add_commit
+
+        def slow_commit(adm, *a, **kw):
+            in_commit.set()
+            time.sleep(0.4)  # hold the no-container window wide open
+            return orig(adm, *a, **kw)
+
+        eng.add_commit = slow_commit
+        req = sched.submit([1, 2, 3], 0.0, 0.9, 4, eos_ids=frozenset(),
+                           seed=1)
+        assert in_commit.wait(10.0)
+        # the worker is INSIDE the window right now: no slots, no
+        # in-flight entry, empty queue — only the ledger state says busy
+        assert sched._busy()
+        assert sched.drain(10.0) is True  # waits; never cuts the commit
+        toks, exc = drain_tokens(req, timeout=5.0)
+        assert exc is None and len(toks) == 4
+        assert req.finish_reason == "length"
+    finally:
+        sched.shutdown()
+
+
 def test_drain_timeout_cuts_stragglers():
     """A request cut off by the drain timeout must surface as a FAILURE to
     its client (SchedulerDraining on the queue), never as a clean-looking
