@@ -48,6 +48,10 @@ Env knobs:
   BENCH_RADIX          '0': skip the radix prefix-cache chat-replay record
                        (shared-system-prompt + multi-turn legs, cold-vs-warm
                        TTFT and saved-prefill tokens)
+  BENCH_ROUTER         '0': skip the multi-replica router record (two real
+                       tiny replicas behind serve/router.py: prefix-affinity
+                       warm-TTFT win vs round-robin + the 2-vs-1-replica
+                       aggregate tok/s scaling ratio)
   BENCH_HYBRID         '0': skip the hybrid chunked-prefill record (client-
                        observed admission stall + joiner TTFT, legacy sync
                        phase-split vs the fused hybrid step, bit-exactness
@@ -1497,6 +1501,216 @@ def bench_radix(cfg, params, n_slots=4, chunk=4, steps=24, pf_chunk=64,
             sched.shutdown()
 
 
+def bench_router(n_slots=2, steps=10, followers=5, clients=4,
+                 scale_rounds=6):
+    """Multi-replica router record (ISSUE 15): two REAL engine replicas —
+    the full serve HTTP surface on the aio front-end — behind
+    serve/router.py, measuring the two claims the subsystem makes:
+
+    * **affinity leg**: `followers` completions sharing one long system
+      prompt, routed with prefix-affinity ON vs OFF (OFF = least-loaded
+      with LRU tie-break, which alternates replicas for sequential
+      traffic — round-robin in effect). ON pins the shared prefix to ONE
+      radix-warm replica, so the mean follower TTFT collapses
+      (`affinity.warm_ttft_ratio_on_off`, perfdiff-gated < 1);
+    * **scale leg**: the same concurrent distinct-prefix closed-loop
+      burst through the router over ONE replica vs over BOTH
+      (`scale.agg_tok_s_ratio_2_1`, perfdiff-gated > 1; both in-process
+      replicas share this host's cores, so the CPU ratio sits well under
+      the ~2x a two-chip deployment shows).
+
+    Builds its OWN tiny fixture model rather than using the preset: the
+    signal here is routing policy, not model compute, and two
+    preset-sized replicas in one process would double HBM.
+    BENCH_ROUTER=0 skips. CPU-feasible (~1 min)."""
+    import http.client as _hc
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from dllama_tpu.engine.loader import load_model
+    from dllama_tpu.models.config import LlamaConfig
+    from dllama_tpu.models.formats import save_model, tensor_plan
+    from dllama_tpu.serve.api import make_server
+    from dllama_tpu.serve.router import make_router
+    from dllama_tpu.tokenizer.tokenizer import Tokenizer
+
+    # ---- tiny fixture (tests/test_serve.make_tiny_files's shape, inline
+    # so the bench stays importable without the test tree)
+    tmp = tempfile.mkdtemp(prefix="dllama_bench_router_")
+    vocab = [bytes([i]) for i in range(256)]
+    scores = [0.0] * 256
+    for piece, score in {b"he": 1.0, b"ll": 2.0, b"hello": 4.0}.items():
+        vocab.append(piece)
+        scores.append(score)
+    bos_id = len(vocab)
+    vocab += [b"<s>", b"</s>"]
+    scores += [0.0, 0.0]
+    tok = Tokenizer(vocab, scores, bos_id, [bos_id + 1],
+                    chat_template="...<|start_header_id|>...")
+    tpath = os.path.join(tmp, "tok.t")
+    tok.save(tpath)
+    tiny = LlamaConfig(dim=64, hidden_dim=128, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=len(vocab), seq_len=512)
+    rng = np.random.default_rng(0)
+    tensors = {}
+    for name, shape, _ft in tensor_plan(tiny):
+        if name.endswith(("rms_att", "rms_ffn")) or name == "final_norm":
+            tensors[name] = np.ones(shape, np.float32)
+        else:
+            tensors[name] = (rng.standard_normal(shape) * 0.05).astype(
+                np.float32)
+    mpath = os.path.join(tmp, "model.m")
+    save_model(mpath, tiny, tensors)
+
+    def post(port, body, timeout=120):
+        conn = _hc.HTTPConnection("127.0.0.1", port, timeout=timeout)
+        conn.request("POST", "/v1/chat/completions", json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = json.loads(resp.read())
+        hdrs = dict(resp.getheaders())
+        conn.close()
+        if resp.status != 200:
+            raise RuntimeError(f"completion -> {resp.status}: {data}")
+        return data, hdrs
+
+    def complete(port, system, user, max_tokens=steps):
+        body, hdrs = post(port, {
+            "messages": [{"role": "system", "content": system},
+                         {"role": "user", "content": user}],
+            "max_tokens": max_tokens, "temperature": 0.0})
+        return body, hdrs.get("X-Replica-Id", "")
+
+    servers, routers = [], []
+    try:
+        for _ in range(2):
+            loaded = load_model(mpath, tpath, mesh=None)
+            httpd, api = make_server(loaded, host="127.0.0.1", port=0,
+                                     n_slots=n_slots, kv_layout="paged",
+                                     page_size=8)
+            threading.Thread(target=httpd.serve_forever,
+                             daemon=True).start()
+            servers.append((httpd, api))
+        addrs = [f"127.0.0.1:{h.server_address[1]}" for h, _ in servers]
+        # compile warm-up straight at each replica (prefill + decode paths)
+        for h, _ in servers:
+            complete(h.server_address[1], "warm-up preamble", "hi",
+                     max_tokens=4)
+
+        def boot_router(replicas, affinity):
+            server, router = make_router(replicas, poll_s=1.0,
+                                         affinity=affinity)
+            router.start()
+            threading.Thread(target=server.serve_forever,
+                             daemon=True).start()
+            routers.append((server, router))
+            # a health poll can time out while the host's cores are pegged
+            # by a neighbor's XLA compute; measuring a leg with a replica
+            # transiently marked down would bias the routing under test
+            deadline = time.monotonic() + 30
+            while not all(r.ready and r.handshaken and r.config_ok
+                          for r in router.replicas):
+                if time.monotonic() > deadline:
+                    raise RuntimeError("router never saw every replica "
+                                       "ready")
+                time.sleep(0.2)
+                for rep in router.replicas:
+                    router._poll_one(rep)
+            return server.server_address[1]
+
+        # a long shared system prompt: cold prefill dominates TTFT, which
+        # is exactly the cost affinity routing avoids on the warm path
+        # (byte-level fixture tokenizer: ~1 token/char — stay well under
+        # the 512-token context while still dwarfing the few-token suffix)
+        preamble = ("You are a careful, thorough assistant who always "
+                    "answers in complete sentences, cites sources, and "
+                    "keeps a steady, measured tone across every turn. " * 2)
+
+        def affinity_leg(port, tag):
+            cold, _ = complete(port, preamble + tag, "first question")
+            ttfts, rids = [], set()
+            for i in range(followers):
+                body, rid = complete(port, preamble + tag, f"question {i}")
+                ttfts.append(body["timings"]["ttft_ms"])
+                rids.add(rid)
+            return {
+                "cold_ttft_ms": round(cold["timings"]["ttft_ms"], 3),
+                "warm_ttft_ms_mean": round(sum(ttfts) / len(ttfts), 3),
+                "replicas_used": len(rids),
+            }
+
+        port_on = boot_router(addrs, affinity=True)
+        on = affinity_leg(port_on, "affinity-on leg.")
+        port_off = boot_router(addrs, affinity=False)
+        off = affinity_leg(port_off, "affinity-off leg.")
+        affinity = {
+            "on": on, "off": off,
+            "warm_ttft_ratio_on_off": round(
+                on["warm_ttft_ms_mean"] / max(off["warm_ttft_ms_mean"],
+                                              1e-9), 4),
+        }
+
+        # ---- scale leg: closed-loop concurrent burst, distinct prefixes
+        def burst(port, tag):
+            tokens = [0] * clients
+            errors: list[BaseException] = []
+
+            def run(ci):
+                try:
+                    for r in range(scale_rounds):
+                        body, _ = complete(
+                            port, f"distinct {tag} prefix c{ci}",
+                            f"round {r}")
+                        tokens[ci] += body["usage"]["completion_tokens"]
+                except BaseException as e:  # surfaced below, never swallowed
+                    errors.append(e)
+
+            threads = [threading.Thread(target=run, args=(ci,))
+                       for ci in range(clients)]
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.monotonic() - t0
+            if errors:
+                # a partially-failed burst must not publish a deflated
+                # agg_tok_s into a perfdiff-gated record
+                raise RuntimeError(
+                    f"router scale leg ({tag}): {len(errors)} client "
+                    f"thread(s) failed: {errors[0]!r}")
+            return {"agg_tok_s": round(sum(tokens) / max(wall, 1e-9), 3),
+                    "completions": clients * scale_rounds,
+                    "wall_s": round(wall, 3)}
+
+        port_one = boot_router(addrs[:1], affinity=False)
+        one = burst(port_one, "solo")
+        port_two = boot_router(addrs, affinity=False)
+        two = burst(port_two, "duo")
+        scale = {
+            "replica_1": one, "replica_2": two,
+            "agg_tok_s_ratio_2_1": round(
+                two["agg_tok_s"] / max(one["agg_tok_s"], 1e-9), 4),
+        }
+        return {"slots": n_slots, "followers": followers,
+                "clients": clients, "affinity": affinity, "scale": scale}
+    finally:
+        for server, router in routers:
+            router.stop()
+            server.shutdown()
+            server.server_close()
+        for httpd, api in servers:
+            try:
+                if api.scheduler is not None:
+                    api.scheduler.shutdown()
+                httpd.shutdown()
+                httpd.server_close()
+            except OSError:
+                pass
+
+
 def bench_slo(cfg, params, n_slots=8, chunk=4, steps=48, pf_chunk=64,
               slo_ttft_ms=5000.0, slo_itl_ms=500.0):
     """SLO & saturation record (ISSUE 7): serve a short mixed burst through
@@ -2091,6 +2305,17 @@ def worker():
         except Exception as e:
             compile_rec = {"error": repr(e)[:200]}
 
+    # multi-replica router record (ISSUE 15): affinity warm-TTFT win vs
+    # round-robin + the 2-vs-1-replica scaling ratio over two real tiny
+    # replicas behind serve/router.py; BENCH_ROUTER=0 skips
+    router_rec = None
+    if (os.environ.get("BENCH_ROUTER") != "0"
+            and time.monotonic() < deadline - 90):
+        try:
+            router_rec = bench_router()
+        except Exception as e:
+            router_rec = {"error": repr(e)[:200]}
+
     # paged-attention route A/B: jnp gather vs the fused flash-decode
     # kernel at 2-3 page sizes (ISSUE 8); BENCH_PAGED_KERNEL=0 skips
     paged_kernel_ab = None
@@ -2148,6 +2373,7 @@ def worker():
         "paged": paged_ab,
         "paged_kernel": paged_kernel_ab,
         "radix": radix_rec,
+        "router": router_rec,
         "slo": slo_rec,
         "spec_batch": spec_batch_rec,
         "kb_per_token_per_chip": kb_measured if kb_measured is not None else round(kb, 1),

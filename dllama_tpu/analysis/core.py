@@ -41,7 +41,7 @@ RULE_CATALOG = {
     "gate-routes": "engine/kernel_select.PAGED_ROUTES drifted from the "
                    "README paged-routing table",
     "gate-bench": "bench.py lost a gated record (bench_hybrid / "
-                  "bench_compile)",
+                  "bench_compile / bench_router)",
     "gate-perfdiff": "experiments/perfdiff.py lost a gated regression rule",
     "gate-aot": "experiments/aot_check.py lost the paged-kernel AOT "
                 "inventory",

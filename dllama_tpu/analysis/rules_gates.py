@@ -43,18 +43,22 @@ REQUIRED_ROUTES = ("paged_kernel", "paged_gather")
 PERFDIFF_KEYS = ("hybrid.stall_reduction_x", "hybrid.ttft_overhead_x",
                  "compile.steady.unexpected_compiles",
                  "compile.steady.upload_bytes",
-                 "compile.warmup_ttft_ratio")
+                 "compile.warmup_ttft_ratio",
+                 # ISSUE 15: the router's affinity warm-TTFT win and the
+                 # 2-vs-1-replica scaling ratio stay gated
+                 "router.affinity.warm_ttft_ratio_on_off",
+                 "router.scale.agg_tok_s_ratio_2_1")
 
 #: aot_check.py markers: the paged flash-decode op inventory + its fused-
 #: scatter cases (ISSUE 8)
 AOT_MARKERS = ("paged_decode_attention", "fused scatter")
 
 #: bench records the perf gate rules read
-BENCH_DEFS = ("bench_hybrid", "bench_compile")
+BENCH_DEFS = ("bench_hybrid", "bench_compile", "bench_router")
 
 #: smoke scripts the gates cite (path, must-be-executable)
 GATED_SCRIPTS = ("scripts/hybrid_smoke.sh", "scripts/compile_smoke.sh",
-                 "scripts/analysis_smoke.sh")
+                 "scripts/analysis_smoke.sh", "scripts/router_smoke.sh")
 
 
 def _line_of(src, needle: str, default: int = 1) -> int:

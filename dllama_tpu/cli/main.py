@@ -7,6 +7,10 @@ Modes:
   chat       REPL with chat template + streaming EOS detection
              (dllama.cpp:121-205)
   serve      OpenAI-compatible HTTP server (the `dllama-api` binary's role)
+  router     multi-replica front: one address over N `serve` replicas with
+             config handshake, health/drain polling, prefix-affinity
+             routing, and failover (the reference ROOT node's role over
+             its worker mesh, serve/router.py)
   info       print the model header (llm.cpp:100-123's dump)
 
 There is no `worker` mode: the reference needs one process per node because
@@ -29,8 +33,12 @@ def build_parser() -> argparse.ArgumentParser:
         prog="dllama-tpu",
         description="TPU-native distributed-llama: tensor/sequence/data-parallel LLM inference",
     )
-    p.add_argument("mode", choices=["inference", "chat", "serve", "info"])
-    p.add_argument("--model", required=True, help=".m model file")
+    p.add_argument("mode", choices=["inference", "chat", "serve", "info",
+                                    "router"])
+    # required for every mode except `router` (which owns no engine —
+    # replicas own their weights); main() enforces it per mode
+    p.add_argument("--model", default=None, help=".m model file "
+                   "(required for every mode except router)")
     p.add_argument("--tokenizer", help=".t tokenizer file")
     p.add_argument("--prompt", help="prompt text (inference mode)")
     p.add_argument("--steps", type=int, default=64, help="max tokens to generate")
@@ -117,8 +125,57 @@ def build_parser() -> argparse.ArgumentParser:
                    help="prefill chunk cap (pow-2 chunks; larger = better MXU "
                         "utilization, more HBM for activations)")
     p.add_argument("--dequantize", action="store_true", help="load Q40 weights as bf16 (faster prefill, 4x HBM)")
-    p.add_argument("--port", type=int, default=9990, help="HTTP port (serve mode)")
-    p.add_argument("--host", default="127.0.0.1", help="HTTP bind address (serve mode)")
+    p.add_argument("--port", type=int, default=None,
+                   help="HTTP port (default: 9990 in serve mode, 9980 in "
+                        "router mode)")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="HTTP bind address (serve/router modes)")
+    p.add_argument("--frontend", choices=["aio", "threads"], default="aio",
+                   help="serve mode: connection transport. 'aio' (default) "
+                        "multiplexes every connection — accept, parse, SSE "
+                        "fan-out, disconnect detection — on one selectors "
+                        "event loop with a small fixed worker pool and one "
+                        "SSE pump thread, so thousands of streams cost "
+                        "thousands of sockets, not thousands of threads "
+                        "(dllama_process_threads stays flat). 'threads' "
+                        "keeps the thread-per-connection stdlib server as "
+                        "the A/B baseline. Routes and HTTP semantics are "
+                        "identical")
+    p.add_argument("--aio-workers", type=int, default=0,
+                   help="serve mode, --frontend aio: request-handling "
+                        "worker threads (0 = min(8, cores); streams don't "
+                        "occupy workers — only non-streaming completions "
+                        "and probe/debug endpoints do)")
+    p.add_argument("--sse-heartbeat-s", type=float, default=15.0,
+                   help="serve mode: emit a `: keep-alive` SSE comment "
+                        "frame on streams idle this long, so router/LB "
+                        "idle timeouts can't kill a slow-decode stream "
+                        "(0 = off; default 15)")
+    p.add_argument("--replica-id", default=None,
+                   help="serve mode: identity stamped on every response "
+                        "(X-Replica-Id header + timings.replica) for "
+                        "end-to-end attribution through the router "
+                        "(default: host:port of the bound socket)")
+    p.add_argument("--replica", action="append", default=None,
+                   metavar="HOST:PORT",
+                   help="router mode (repeatable, at least one): an engine "
+                        "replica to front — a normal `dllama-tpu serve` "
+                        "process; the router handshakes its config, polls "
+                        "its health, and routes/fails-over across the set")
+    p.add_argument("--affinity", choices=["on", "off"], default="on",
+                   help="router mode: prefix-affinity routing — pin each "
+                        "request's prefix fingerprint (shared system "
+                        "prompt / leading prompt bytes) to the replica "
+                        "that served it last, so the radix prefix cache "
+                        "is warm (off = pure least-loaded, the A/B "
+                        "baseline)")
+    p.add_argument("--poll-s", type=float, default=0.5,
+                   help="router mode: replica /health poll cadence in "
+                        "seconds")
+    p.add_argument("--router-workers", type=int, default=16,
+                   help="router mode: worker threads (each in-flight "
+                        "proxied request occupies one for its upstream "
+                        "I/O)")
     p.add_argument("--slots", type=int, default=0,
                    help="serve mode: continuous-batching slots (0 = single-request + prefix cache)")
     p.add_argument("--overlap", choices=["on", "off"], default="on",
@@ -505,7 +562,7 @@ def cmd_serve(args) -> int:
     return run_server(
         m,
         host=args.host,
-        port=args.port,
+        port=args.port if args.port is not None else 9990,
         n_slots=args.slots,
         default_temperature=args.temperature,
         default_topp=args.topp,
@@ -532,11 +589,37 @@ def cmd_serve(args) -> int:
         tenant_weights=_parse_tenant_weights(args.tenant_weight),
         warmup=args.warmup,
         transfer_guard=args.transfer_guard,
+        frontend=args.frontend,
+        aio_workers=args.aio_workers,
+        sse_heartbeat_s=args.sse_heartbeat_s,
+        replica_id=args.replica_id,
+    )
+
+
+def cmd_router(args) -> int:
+    from dllama_tpu.serve.router import run_router
+
+    if not args.replica:
+        print("router mode requires at least one --replica HOST:PORT",
+              file=sys.stderr)
+        return 1
+    port = args.port if args.port is not None else 9980  # router's default
+    return run_router(
+        args.replica,
+        host=args.host,
+        port=port,
+        poll_s=args.poll_s,
+        affinity=args.affinity == "on",
+        workers=args.router_workers,
+        drain_timeout_s=args.drain_timeout_s,
     )
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.mode != "router" and not args.model:
+        print(f"{args.mode} mode requires --model", file=sys.stderr)
+        return 1
     from dllama_tpu.utils.logs import setup_logging
 
     # shared logger setup (utils/logs.py): --log-format json switches every
@@ -560,6 +643,7 @@ def main(argv=None) -> int:
         "inference": cmd_inference,
         "chat": cmd_chat,
         "serve": cmd_serve,
+        "router": cmd_router,
     }[args.mode](args)
 
 

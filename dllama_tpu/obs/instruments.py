@@ -119,6 +119,39 @@ RADIX_PAGES = metrics.gauge(
     "Radix prefix tree: KV pool pages the tree holds references to "
     "(reclaimable by LRU eviction before admissions defer)")
 
+# ------------------------------------- router & aio front-end (ISSUE 15)
+
+ROUTER_REQUESTS = metrics.counter(
+    "dllama_router_requests_total",
+    "Router-proxied completion requests, by replica and outcome (ok = "
+    "forwarded and answered, 4xx included — the replica spoke, the client "
+    "erred; error = replica answered a 5xx, passed through; busy = "
+    "replica shed 429/503, tried elsewhere; rerouted = replica failed "
+    "before any response byte, request moved to a survivor; stream_error "
+    "= replica died mid-stream, stream failed cleanly with "
+    "finish_reason=error; client_gone = client hung up mid-stream; shed = "
+    "no replica could take it, replica=none)",
+    ("replica", "outcome"))
+ROUTER_AFFINITY_HITS = metrics.counter(
+    "dllama_router_affinity_hits_total",
+    "Requests routed to the replica their prefix fingerprint was pinned "
+    "to (the radix-cache-warm replica) — hits/requests is the warm-routing "
+    "rate the router's TTFT win comes from")
+REPLICA_HEALTHY = metrics.gauge(
+    "dllama_replica_healthy",
+    "Router's live view of each replica (1 = /health reports live; 0 = "
+    "dead or unreachable — flips immediately on a failed proxy attempt, "
+    "not a poll later)",
+    ("replica",))
+FRONTEND_CONNECTIONS = metrics.gauge(
+    "dllama_frontend_connections",
+    "Open client connections per aio event loop, labeled by the server's "
+    "bound address (one process may host several loops: replica + router "
+    "fronts). Threads do NOT scale with this — compare "
+    "dllama_process_threads; the threads front-end does not move this "
+    "gauge",
+    ("server",))
+
 # ----------------------------------------------------------------- gauges
 
 BUILD_INFO = metrics.gauge(

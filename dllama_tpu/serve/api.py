@@ -175,11 +175,55 @@ class PrefixCache:
         self.bos_sent = False
 
 
+class TokenAssembler:
+    """Per-stream EOS/stop-string assembly of a batched token stream — the
+    detector + incremental decoder + held-prefix bookkeeping that used to
+    live inline in ``_run_batched``, extracted so the blocking tier and the
+    aio front-end's cooperative SSE pump (serve/aio.py) process tokens
+    identically (byte-identical text deltas either way)."""
+
+    __slots__ = ("detector", "decoder", "parts", "n", "eos")
+
+    def __init__(self, tokenizer, stops):
+        self.detector = EosDetector(tokenizer.eos_ids, stops,
+                                    padding_left=2, padding_right=2)
+        self.decoder = tokenizer.make_stream_decoder()
+        self.parts: list[str] = []
+        self.n = 0
+        self.eos = False
+
+    def feed(self, t) -> str:
+        """Process one token -> the text delta to emit now ("" while the
+        detector holds a possible stop prefix). Sets ``eos`` when the
+        token completed an EOS/stop sequence."""
+        self.n += 1
+        res = self.detector.append(t, self.decoder.decode(t))
+        text = self.detector.get_delta()
+        if text:
+            self.parts.append(text)
+        if res == EosResult.EOS:
+            self.eos = True
+        return text
+
+    def flush(self) -> str:
+        """End of stream without EOS (budget/timeout): release any held
+        stop-prefix -> the final text delta to emit."""
+        text = self.detector.flush()
+        if text:
+            self.parts.append(text)
+        return text
+
+    def content(self) -> str:
+        return "".join(self.parts)
+
+
 class ApiServer:
     def __init__(self, loaded, default_temperature=0.8, default_topp=0.9, default_seed=None,
                  scheduler=None, spec: int = 0,
                  slo_ttft_ms: float | None = None,
-                 slo_itl_ms: float | None = None):
+                 slo_itl_ms: float | None = None,
+                 replica_id: str = "",
+                 sse_heartbeat_s: float = 0.0):
         self.engine = loaded.engine
         self.tokenizer = loaded.tokenizer
         self.config = loaded.config
@@ -191,6 +235,16 @@ class ApiServer:
             temperature=default_temperature, topp=default_topp, seed=default_seed
         )
         self.cache = PrefixCache()
+        # multi-replica attribution (ISSUE 15): stamped on every response as
+        # the X-Replica-Id header and the `replica` field of `timings`, so a
+        # stream that crossed the router is attributable end to end. "" =
+        # standalone (no header, no field); make_server defaults it to
+        # host:port of the bound socket.
+        self.replica_id = str(replica_id or "")
+        # SSE keep-alive cadence (ISSUE 15): idle streams emit a `: keep-alive`
+        # comment frame at this period so router/LB idle timeouts cannot kill
+        # a slow-decode stream; 0 = off
+        self.sse_heartbeat_s = float(sse_heartbeat_s or 0.0)
         # prompt-lookup speculative decoding for greedy single-engine serving
         # (generate() ignores it for sampled requests and the batched tier)
         self.spec = int(spec)
@@ -298,6 +352,33 @@ class ApiServer:
         Returns the non-streaming response dict (also computed when
         streaming, for the final usage accounting)."""
         t_submit = time.monotonic()
+        if self.scheduler is not None:
+            # continuous-batching tier: one shared body parse (the same one
+            # the aio front-end's SSE machine uses), then the blocking
+            # submit/stream/finish loop
+            p = self.prepare_request(body, legacy=False)
+            content, finish, n_generated, timings = self._run_batched(
+                p, emit, probe=probe, req_id=req_id)
+            return {
+                "timings": timings,
+                "id": f"chatcmpl-{uuid.uuid4().hex[:16]}",
+                "object": "chat.completion",
+                "created": int(time.time()),
+                "model": body.get("model", self.model_name),
+                "choices": [
+                    {
+                        "index": 0,
+                        "message": {"role": "assistant", "content": content},
+                        "finish_reason": finish,
+                    }
+                ],
+                "usage": {
+                    "prompt_tokens": len(p["prompt_tokens"]),
+                    "completion_tokens": n_generated,
+                    "total_tokens": len(p["prompt_tokens"]) + n_generated,
+                },
+            }
+
         messages = [(m["role"], str(m["content"])) for m in body.get("messages", [])]
         if not messages:
             raise ApiError(400, "messages must be a non-empty array")
@@ -310,19 +391,11 @@ class ApiServer:
         max_tokens = int(body.get("max_tokens") or body.get("max_completion_tokens") or 0)
         timeout_s = _parse_timeout(body)
         spec_k = _parse_spec_k(body)
-        priority = _parse_priority(body)
-        tenant = _parse_tenant(body)
+        _parse_priority(body)  # accepted-but-inert on this tier: validate only
+        _parse_tenant(body)
         extra_stops = body.get("stop") or []
         if isinstance(extra_stops, str):
             extra_stops = [extra_stops]
-
-        if self.scheduler is not None:
-            return self._complete_batched(
-                body, messages, temperature, topp, max_tokens, extra_stops, emit,
-                seed=seed, presence=presence, frequency=frequency, probe=probe,
-                req_id=req_id, timeout_s=timeout_s, spec_k=spec_k,
-                priority=priority, tenant=tenant,
-            )
 
         self._trace_single_submit(req_id, t_submit)
         with self.lock:
@@ -435,9 +508,8 @@ class ApiServer:
         if tr.enabled and req_id:
             tr.req_submit(req_id, t=t_submit)
 
-    @staticmethod
-    def _single_tier_timings(req_id, t_submit, t_admit, t_first, n_generated,
-                             prompt_len, reused, finish,
+    def _single_tier_timings(self, req_id, t_submit, t_admit, t_first,
+                             n_generated, prompt_len, reused, finish,
                              timeout_s=None) -> dict:
         """Build the response `timings` object for a single-engine completion
         and close out its flight-recorder record (lock wait plays the role
@@ -457,6 +529,8 @@ class ApiServer:
         if timeout_s is not None:
             timings["timeout_s"] = timeout_s
             timings["deadline_exceeded"] = finish == "timeout"
+        if self.replica_id:
+            timings["replica"] = self.replica_id
         tr = trace.TRACER
         if tr.enabled and req_id:
             tr.req_admitted(req_id, t=t_admit)
@@ -546,128 +620,88 @@ class ApiServer:
                     emit(text)
         return "".join(parts), finish, n_generated, t_first
 
-    def _complete_batched(self, body, messages, temperature, topp, max_tokens,
-                          extra_stops, emit, seed=None, presence=0.0,
-                          frequency=0.0, probe=None, req_id: str = "",
-                          timeout_s=None, spec_k=None, priority=1,
-                          tenant="") -> dict:
-        """Continuous-batching completion: submit to the scheduler, stream from
-        the per-request queue. Per-request `seed` pins the slot's own PRNG
-        stream (reproducible regardless of batch-mates). Prefix reuse lives in
-        the scheduler here (token-level per-slot cache, Scheduler._pick_slot)
-        rather than in this handler — a multi-turn conversation prefills only
-        its delta whenever an idle slot still holds the matching rows."""
-        generated = self.template.generate(
-            [ChatItem(r, c) for r, c in messages], append_generation_prompt=True
-        )
-        prompt_tokens = self.tokenizer.encode(generated.content, add_bos=True)
-        content, finish, n_generated, timings = self._run_batched(
-            prompt_tokens, temperature, topp, max_tokens,
-            self.stops + list(extra_stops), emit,
-            seed=seed, presence=presence, frequency=frequency, probe=probe,
-            req_id=req_id, timeout_s=timeout_s, spec_k=spec_k,
-            priority=priority, tenant=tenant)
-        return {
-            "timings": timings,
-            "id": f"chatcmpl-{uuid.uuid4().hex[:16]}",
-            "object": "chat.completion",
-            "created": int(time.time()),
-            "model": body.get("model", self.model_name),
-            "choices": [
-                {
-                    "index": 0,
-                    "message": {"role": "assistant", "content": content},
-                    "finish_reason": finish,
-                }
-            ],
-            "usage": {
-                "prompt_tokens": len(prompt_tokens),
-                "completion_tokens": n_generated,
-                "total_tokens": len(prompt_tokens) + n_generated,
-            },
-        }
+    def prepare_request(self, body: dict, legacy: bool = False) -> dict:
+        """Parse a completions body into submit-ready params — ONE parser
+        for the blocking batched tier and the aio front-end's SSE machine
+        (serve/aio.py), so the two can never drift. Raises ApiError for
+        shape problems; stream callers therefore run it BEFORE response
+        headers go out. Returns the kwargs of :meth:`batched_submit` plus
+        ``stops`` (chat adds the template stops; the legacy raw-prompt
+        endpoint uses only explicit ones)."""
+        temperature = float(body.get("temperature", self.defaults["temperature"]))
+        topp = float(body.get("top_p", self.defaults["topp"]))
+        # `or 0.0`: OpenAI treats an explicit JSON null as "use default"
+        presence = float(body.get("presence_penalty") or 0.0)
+        frequency = float(body.get("frequency_penalty") or 0.0)
+        seed = body.get("seed", self.defaults["seed"])
+        timeout_s = _parse_timeout(body)
+        spec_k = _parse_spec_k(body)
+        priority = _parse_priority(body)
+        tenant = _parse_tenant(body)
+        extra_stops = body.get("stop") or []
+        if isinstance(extra_stops, str):
+            extra_stops = [extra_stops]
+        if legacy:
+            prompt = self._normalize_legacy_prompt(body)
+            prompt_tokens = self.tokenizer.encode(prompt, add_bos=True)
+            stops = list(extra_stops)
+            max_tokens = int(body.get("max_tokens") or 16)  # OpenAI legacy default
+        else:
+            messages = [(m["role"], str(m["content"]))
+                        for m in body.get("messages", [])]
+            if not messages:
+                raise ApiError(400, "messages must be a non-empty array")
+            generated = self.template.generate(
+                [ChatItem(r, c) for r, c in messages],
+                append_generation_prompt=True)
+            prompt_tokens = self.tokenizer.encode(generated.content,
+                                                  add_bos=True)
+            stops = self.stops + list(extra_stops)
+            max_tokens = int(body.get("max_tokens")
+                             or body.get("max_completion_tokens") or 0)
+        return dict(prompt_tokens=prompt_tokens, stops=stops,
+                    temperature=temperature, topp=topp,
+                    max_tokens=max_tokens, seed=seed, presence=presence,
+                    frequency=frequency, timeout_s=timeout_s, spec_k=spec_k,
+                    priority=priority, tenant=tenant)
 
-    def _run_batched(self, prompt_tokens, temperature, topp, max_tokens,
-                     stops, emit, seed=None, presence=0.0,
-                     frequency=0.0, probe=None, req_id: str = "",
-                     timeout_s=None, spec_k=None, priority=1,
-                     tenant="") -> tuple[str, str, int, dict]:
-        """Token-level core of a batched completion: submit, stream-decode
-        with EOS/stop detection, return (content, finish_reason, n_tokens,
-        timings) — `timings` is the request's span-sourced latency object
-        (queue wait / TTFT / e2e / token count) for the response body.
-        Shared by the chat and legacy-completions endpoints — the caller
-        decides the stop-string set (chat adds the template stops, the
-        legacy raw-prompt endpoint uses only explicit ones, matching its
-        single-engine tier)."""
+    def batched_submit(self, p: dict, req_id: str = ""):
+        """Budget-clamp + submit one parsed request (prepare_request's dict)
+        to the scheduler -> the live Request. Shared by the blocking tier
+        and the aio SSE machine; raises ApiError when the context window
+        cannot fit the prompt, and the SchedulerRejected family on
+        admission shed."""
+        prompt_tokens = p["prompt_tokens"]
         budget = self.scheduler.engine.seq_len - len(prompt_tokens) - 1
         if budget <= 0:
             raise ApiError(400, "context window exhausted")
-        if max_tokens > 0:
-            budget = min(budget, max_tokens)
-
-        detector = EosDetector(
+        if p["max_tokens"] > 0:
+            budget = min(budget, p["max_tokens"])
+        seed = p["seed"]
+        return self.scheduler.submit(
+            prompt_tokens, p["temperature"], p["topp"], budget,
             self.tokenizer.eos_ids,
-            stops,
-            padding_left=2,
-            padding_right=2,
-        )
-        decoder = self.tokenizer.make_stream_decoder()
-        req = self.scheduler.submit(
-            prompt_tokens, temperature, topp, budget, self.tokenizer.eos_ids,
-            presence=presence, frequency=frequency,
+            presence=p["presence"], frequency=p["frequency"],
             seed=int(seed) if seed is not None else None,
-            req_id=req_id, timeout_s=timeout_s,
+            req_id=req_id, timeout_s=p["timeout_s"],
             # None = the --spec-k serving default (the engine's compiled K);
             # the scheduler clamps explicit values to that capacity
-            spec_k=spec_k,
+            spec_k=p["spec_k"],
             # scheduling class + fair-queue tenant (ISSUE 12): the
             # scheduler's policy pick and preemption read these
-            priority=priority, tenant=tenant,
+            priority=p["priority"], tenant=p["tenant"],
         )
-        parts: list[str] = []
-        n_generated = 0
-        probe_at = time.monotonic() + 0.25
 
-        def probe_tick():
-            # runs from tokens() whenever the stream goes quiet (queued,
-            # mid-prefill, stalled device): a dead client cancels even
-            # before its first token exists
-            if probe():
-                raise ClientDisconnected()
-
-        ended_on_eos = False
-        try:
-            for t in req.tokens(poll=probe_tick if probe is not None else None):
-                if probe is not None and time.monotonic() >= probe_at:
-                    # ...and at 4 Hz while tokens ARE flowing (a select()+
-                    # MSG_PEEK syscall per token would dominate small models;
-                    # this bounds wasted generation to a quarter second)
-                    probe_at = time.monotonic() + 0.25
-                    if probe():
-                        raise ClientDisconnected()
-                n_generated += 1
-                res = detector.append(t, decoder.decode(t))
-                text = detector.get_delta()
-                if text:
-                    parts.append(text)
-                    if emit is not None:
-                        emit(text)
-                if res == EosResult.EOS:
-                    ended_on_eos = True
-                    break
-            if not ended_on_eos:
-                text = detector.flush()
-                if text:
-                    parts.append(text)
-                    if emit is not None:
-                        emit(text)
-        finally:
-            # a release after the detector saw a string stop-sequence is a
-            # SUCCESSFUL stop, not a client cancellation — label it so the
-            # finished{reason} metric matches what the client is told below
-            self.scheduler.cancel(
-                req, reason="stop" if ended_on_eos else "cancelled")
+    def finish_batched(self, req, ended_on_eos: bool,
+                       n_generated: int) -> tuple[str, dict]:
+        """Release a batched request's slot and derive the client-facing
+        (finish_reason, timings) pair — the one finalization site for the
+        blocking tier and the aio SSE machine. A release after the detector
+        saw a string stop-sequence is a SUCCESSFUL stop, not a client
+        cancellation — labeled so the finished{reason} metric matches what
+        the client is told."""
+        self.scheduler.cancel(
+            req, reason="stop" if ended_on_eos else "cancelled")
         # scheduler reasons: stop/length/timeout pass through; a cancel here
         # means the stream ended on a string stop-sequence -> "stop"
         finish = (req.finish_reason
@@ -682,7 +716,58 @@ class ApiServer:
         # what the CLIENT received — the scheduler's `produced` may include
         # a stop-string overrun token the stream never surfaced
         timings["decode_tokens"] = n_generated
-        return "".join(parts), finish, n_generated, timings
+        if self.replica_id:
+            # end-to-end attribution through the router (ISSUE 15): which
+            # replica actually served this stream
+            timings["replica"] = self.replica_id
+        return finish, timings
+
+    def _run_batched(self, p: dict, emit, probe=None,
+                     req_id: str = "") -> tuple[str, str, int, dict]:
+        """Token-level core of a BLOCKING batched completion: submit, stream-
+        decode with EOS/stop detection, return (content, finish_reason,
+        n_tokens, timings) — `timings` is the request's span-sourced latency
+        object (queue wait / TTFT / e2e / token count) for the response
+        body. `p` is prepare_request's dict. The aio front-end runs the same
+        submit/assemble/finish seams cooperatively instead (serve/aio.py)."""
+        asm = TokenAssembler(self.tokenizer, p["stops"])
+        req = self.batched_submit(p, req_id=req_id)
+        probe_at = time.monotonic() + 0.25
+
+        def probe_tick():
+            # runs from tokens() whenever the stream goes quiet (queued,
+            # mid-prefill, stalled device): a dead client cancels even
+            # before its first token exists
+            if probe():
+                raise ClientDisconnected()
+
+        try:
+            for t in req.tokens(poll=probe_tick if probe is not None else None):
+                if probe is not None and time.monotonic() >= probe_at:
+                    # ...and at 4 Hz while tokens ARE flowing (a select()+
+                    # MSG_PEEK syscall per token would dominate small models;
+                    # this bounds wasted generation to a quarter second)
+                    probe_at = time.monotonic() + 0.25
+                    if probe():
+                        raise ClientDisconnected()
+                text = asm.feed(t)
+                if text and emit is not None:
+                    emit(text)
+                if asm.eos:
+                    break
+            if not asm.eos:
+                text = asm.flush()
+                if text and emit is not None:
+                    emit(text)
+            finish, timings = self.finish_batched(req, asm.eos, asm.n)
+        except BaseException:
+            # disconnect/shed/crash: the slot must still be released, with
+            # the honest "cancelled"/terminal reason (finish_batched's
+            # labeling only applies to streams that ended cleanly)
+            self.scheduler.cancel(
+                req, reason="stop" if asm.eos else "cancelled")
+            raise
+        return asm.content(), finish, asm.n, timings
 
     def complete_legacy(self, body: dict, emit=None, probe=None,
                         req_id: str = "") -> dict:
@@ -691,30 +776,30 @@ class ApiServer:
         choices. Shares the sampling params and generation machinery with
         the chat endpoint."""
         t_submit = time.monotonic()
-        prompt = self._normalize_legacy_prompt(body)
-        temperature = float(body.get("temperature", self.defaults["temperature"]))
-        topp = float(body.get("top_p", self.defaults["topp"]))
-        presence = float(body.get("presence_penalty") or 0.0)
-        frequency = float(body.get("frequency_penalty") or 0.0)
-        seed = body.get("seed", self.defaults["seed"])
-        max_tokens = int(body.get("max_tokens") or 16)  # OpenAI legacy default
-        timeout_s = _parse_timeout(body)
-        spec_k = _parse_spec_k(body)
-        priority = _parse_priority(body)
-        tenant = _parse_tenant(body)
-        extra_stops = body.get("stop") or []
-        if isinstance(extra_stops, str):
-            extra_stops = [extra_stops]
-        prompt_tokens = self.tokenizer.encode(prompt, add_bos=True)
-
         if self.scheduler is not None:
+            # continuous-batching tier: one shared body parse (the same one
+            # the aio SSE machine uses) — no duplicate prompt tokenization
+            p = self.prepare_request(body, legacy=True)
+            prompt_tokens = p["prompt_tokens"]
             content, finish, n_generated, timings = self._run_batched(
-                prompt_tokens, temperature, topp, max_tokens,
-                list(extra_stops),  # raw prompt: no chat-template stops
-                emit, seed=seed, presence=presence, frequency=frequency,
-                probe=probe, req_id=req_id, timeout_s=timeout_s,
-                spec_k=spec_k, priority=priority, tenant=tenant)
+                p, emit, probe=probe, req_id=req_id)
         else:
+            prompt = self._normalize_legacy_prompt(body)
+            temperature = float(body.get("temperature",
+                                         self.defaults["temperature"]))
+            topp = float(body.get("top_p", self.defaults["topp"]))
+            presence = float(body.get("presence_penalty") or 0.0)
+            frequency = float(body.get("frequency_penalty") or 0.0)
+            seed = body.get("seed", self.defaults["seed"])
+            max_tokens = int(body.get("max_tokens") or 16)  # legacy default
+            timeout_s = _parse_timeout(body)
+            spec_k = _parse_spec_k(body)
+            _parse_priority(body)  # accepted-but-inert: validate only
+            _parse_tenant(body)
+            extra_stops = body.get("stop") or []
+            if isinstance(extra_stops, str):
+                extra_stops = [extra_stops]
+            prompt_tokens = self.tokenizer.encode(prompt, add_bos=True)
             self._trace_single_submit(req_id, t_submit)
             with self.lock:
                 t_admit = time.monotonic()
@@ -803,14 +888,67 @@ def _endpoint(path: str) -> str:
     return _KNOWN_PATHS.get(path, "other")
 
 
-class _Handler(BaseHTTPRequestHandler):
-    server_version = "dllama-tpu"
-    protocol_version = "HTTP/1.1"
-    api: ApiServer  # set by make_handler
-    _req_id: str | None = None  # minted per POST in do_POST
+#: SSE comment frame (spec: lines starting with ':' are ignored by
+#: EventSource parsers) — the keep-alive heartbeat idle streams emit so a
+#: router/LB idle timeout cannot kill a slow-decode stream (ISSUE 15)
+SSE_HEARTBEAT = b": keep-alive\n\n"
 
-    def log_message(self, fmt, *args):
-        log.info("%s %s", self.address_string(), fmt % args)
+
+def sse_chat_payload(cid: str, created: int, model: str, delta: dict,
+                     finish=None, timings=None) -> bytes:
+    """One `chat.completion.chunk` SSE data frame — single definition for
+    the blocking `_stream` and the aio SSE machine (byte-identical events
+    on both front-ends)."""
+    data = {
+        "id": cid,
+        "object": "chat.completion.chunk",
+        "created": created,
+        "model": model,
+        "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
+    }
+    if timings is not None:
+        # the final (done) event carries the request's span-sourced
+        # latency summary, like the non-stream response body
+        data["timings"] = timings
+    return b"data: " + json.dumps(data).encode() + b"\n\n"
+
+
+def sse_text_payload(cid: str, created: int, model: str, text: str,
+                     finish=None, timings=None) -> bytes:
+    """One legacy `text_completion` SSE data frame (see sse_chat_payload)."""
+    data = {
+        "id": cid,
+        "object": "text_completion",
+        "created": created,
+        "model": model,
+        "choices": [{"index": 0, "text": text, "finish_reason": finish}],
+    }
+    if timings is not None:
+        data["timings"] = timings
+    return b"data: " + json.dumps(data).encode() + b"\n\n"
+
+
+class RequestRoutes:
+    """Transport-neutral HTTP route handling — every endpoint the serving
+    surface speaks (completions, models, health probes, /metrics, the
+    /debug family, SSE streaming), written against a SIX-method transport
+    seam so the thread-per-connection tier (`_Handler`, stdlib
+    BaseHTTPRequestHandler) and the selectors event-loop tier
+    (serve/aio.py's context) serve byte-identical semantics from one
+    definition site. Subclasses provide:
+
+    * ``_send_raw(status, headers, body)`` — one complete response;
+    * ``_start_sse()`` — the 200/chunked SSE response headers;
+    * ``_write_chunk(payload)`` — one chunked-transfer frame (b"" ends);
+    * ``_read_body()`` — the POST body bytes (may raise ValueError/OSError);
+    * ``_drain_body()`` — keep-alive discipline for GETs with bodies;
+    * ``_client_gone()`` — the disconnect probe.
+
+    plus ``path``/``headers`` attributes of the current request."""
+
+    api: ApiServer  # set by make_handler / the aio context
+    _req_id: str | None = None  # minted per POST in do_POST
+    path: str = ""
 
     def _send_json(self, status: int, payload: dict,
                    headers: dict | None = None) -> None:
@@ -820,20 +958,16 @@ class _Handler(BaseHTTPRequestHandler):
             # client-side report alone is enough to find the server logs
             payload["error"].setdefault("request_id", rid)
         data = json.dumps(payload).encode()
-        self.send_response(status)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(data)))
+        hdrs = [("Content-Type", "application/json"),
+                ("Content-Length", str(len(data)))]
         if rid:
-            self.send_header("X-Request-Id", rid)
-        for k, v in (headers or {}).items():
-            self.send_header(k, v)
-        self.end_headers()
-        # counted before the body write: once the client has read the
-        # response, the counter has already moved (no scrape-after-response
-        # race for tests or tight operators)
-        ins.HTTP_RESPONSES.labels(endpoint=_endpoint(self.path),
-                                  code=str(status)).inc()
-        self.wfile.write(data)
+            hdrs.append(("X-Request-Id", rid))
+        if self.api.replica_id:
+            # which replica answered — the router forwards it to the client
+            # for end-to-end attribution (ISSUE 15)
+            hdrs.append(("X-Replica-Id", self.api.replica_id))
+        hdrs.extend((headers or {}).items())
+        self._send_raw(status, hdrs, data)
 
     def do_GET(self):
         self._req_id = None
@@ -852,13 +986,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self.api.scheduler.ledger.poke()
                 self.api.scheduler.perf.refresh_gauges()
             body = metrics.REGISTRY.render().encode()
-            self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-            ins.HTTP_RESPONSES.labels(endpoint="/metrics", code="200").inc()
+            self._send_raw(
+                200,
+                [("Content-Type", "text/plain; version=0.0.4; charset=utf-8"),
+                 ("Content-Length", str(len(body)))],
+                body)
         elif self.path.startswith("/debug/"):
             # the /debug family never touches admission (no request id is
             # minted, no scheduler counter moves) — pure read-side
@@ -875,22 +1007,6 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200 if h[key] else 503, h)
         else:
             self._send_json(404, {"error": {"message": "not found"}})
-
-    def _drain_body(self) -> None:
-        """Read and discard any request body. The /debug endpoints answer
-        early errors (404 unknown id, 404 tracing disabled, 409 profiler
-        busy) on this keep-alive server, where unread body bytes would be
-        parsed as the NEXT request line — the do_POST bug class, applied to
-        the debug family (GETs with bodies are legal, if unusual)."""
-        try:
-            length = int(self.headers.get("Content-Length") or 0)
-        except ValueError:
-            length = 0
-        if length > 0:
-            try:
-                self.rfile.read(length)
-            except OSError:
-                pass
 
     def _debug_kv(self) -> None:
         """GET /debug/kv — paged KV pool occupancy plus a full
@@ -1036,25 +1152,6 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send_json(404, {"error": {"message": "not found"}})
 
-    def _client_gone(self) -> bool:
-        """Disconnect probe for non-streamed completions: a readable socket
-        that MSG_PEEKs zero bytes is a closed peer (we never read mid-
-        completion, so pending bytes can only be a pipelined request — in
-        which case the client is certainly still there).
-
-        Known trade-off: a client that legally HALF-closes its write side
-        after the request body (shutdown(SHUT_WR), then reads) looks
-        identical to a full close at this layer and gets cancelled. That's
-        the same call Starlette/uvicorn make for their disconnect probes;
-        real OpenAI-style clients keep the socket open until the response."""
-        try:
-            r, _, _ = select.select([self.connection], [], [], 0)
-            if not r:
-                return False
-            return self.connection.recv(1, socket.MSG_PEEK) == b""
-        except (OSError, ValueError):
-            return True
-
     def _log_done(self, rid: str, result: dict) -> None:
         u = result.get("usage", {})
         log.info("completion %s done: %d prompt + %d completion tokens",
@@ -1068,12 +1165,12 @@ class _Handler(BaseHTTPRequestHandler):
         rid = self._req_id = new_request_id(self.headers.get("X-Request-Id"))
         chat = self.path in ("/v1/chat/completions", "/chat/completions")
         legacy = self.path in ("/v1/completions", "/completions")
-        # the body is consumed BEFORE any early-return response: on this
-        # keep-alive (HTTP/1.1) server, unread body bytes would be parsed as
-        # the NEXT request line — a 404'd POST must not poison its connection
+        # the body is consumed BEFORE any early-return response: on the
+        # keep-alive (HTTP/1.1) thread tier, unread body bytes would be
+        # parsed as the NEXT request line — a 404'd POST must not poison its
+        # connection (the aio tier buffers the body up front; same contract)
         try:
-            length = int(self.headers.get("Content-Length", 0))
-            raw = self.rfile.read(length)
+            raw = self._read_body()
         except (ValueError, OSError):
             self._send_json(400, {"error": {"message": "invalid request"}})
             return
@@ -1189,49 +1286,40 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _stream(self, body: dict, legacy: bool = False) -> None:
         """SSE chunked streaming (dllama-api.cpp:203-223's role). `legacy`
-        streams `text_completion` chunks (text field) instead of chat deltas."""
+        streams `text_completion` chunks (text field) instead of chat deltas.
+        BLOCKING implementation — the thread tier runs every stream through
+        it; the aio tier routes batched-tier streams to its cooperative SSE
+        machine instead and uses this only for the single-engine tier
+        (where the global engine lock serializes streams anyway)."""
         rid = self._req_id
-        self.send_response(200)
-        self.send_header("Content-Type", "text/event-stream")
-        self.send_header("Cache-Control", "no-cache")
-        self.send_header("Transfer-Encoding", "chunked")
-        if rid:
-            self.send_header("X-Request-Id", rid)
-        self.end_headers()
-        ins.HTTP_RESPONSES.labels(endpoint=_endpoint(self.path),
-                                  code="200").inc()
+        self._start_sse()
         cid = f"{'cmpl' if legacy else 'chatcmpl'}-{uuid.uuid4().hex[:16]}"
         created = int(time.time())
-
-        def chunk(payload: bytes) -> None:
-            self.wfile.write(f"{len(payload):x}\r\n".encode() + payload + b"\r\n")
-            self.wfile.flush()
+        model = body.get("model", self.api.model_name)
+        chunk = self._write_chunk
+        last_write = [time.monotonic()]
 
         def emit_chat(delta: dict, finish=None, timings=None) -> None:
-            data = {
-                "id": cid,
-                "object": "chat.completion.chunk",
-                "created": created,
-                "model": body.get("model", self.api.model_name),
-                "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
-            }
-            if timings is not None:
-                # the final (done) event carries the request's span-sourced
-                # latency summary, like the non-stream response body
-                data["timings"] = timings
-            chunk(b"data: " + json.dumps(data).encode() + b"\n\n")
+            chunk(sse_chat_payload(cid, created, model, delta,
+                                   finish=finish, timings=timings))
+            last_write[0] = time.monotonic()
 
         def emit_text(text: str, finish=None, timings=None) -> None:
-            data = {
-                "id": cid,
-                "object": "text_completion",
-                "created": created,
-                "model": body.get("model", self.api.model_name),
-                "choices": [{"index": 0, "text": text, "finish_reason": finish}],
-            }
-            if timings is not None:
-                data["timings"] = timings
-            chunk(b"data: " + json.dumps(data).encode() + b"\n\n")
+            chunk(sse_text_payload(cid, created, model, text,
+                                   finish=finish, timings=timings))
+            last_write[0] = time.monotonic()
+
+        hb = self.api.sse_heartbeat_s
+
+        def probe() -> bool:
+            # the disconnect probe doubles as the keep-alive clock: it runs
+            # at 4 Hz while tokens flow AND every poll interval while the
+            # stream is quiet (queued, mid-prefill) — exactly the windows an
+            # idle-timeout LB would kill (ISSUE 15)
+            if hb and time.monotonic() - last_write[0] >= hb:
+                chunk(SSE_HEARTBEAT)
+                last_write[0] = time.monotonic()
+            return self._client_gone()
 
         try:
             # streams get the disconnect probe too: a chunk write into a dead
@@ -1240,14 +1328,14 @@ class _Handler(BaseHTTPRequestHandler):
             # (no tokens flowing yet)
             if legacy:
                 result = self.api.complete_legacy(
-                    body, emit=emit_text, probe=self._client_gone, req_id=rid)
+                    body, emit=emit_text, probe=probe, req_id=rid)
                 emit_text("", finish=result["choices"][0]["finish_reason"],
                           timings=result.get("timings"))
             else:
                 emit_chat({"role": "assistant"})
                 result = self.api.complete(
                     body, emit=lambda text: emit_chat({"content": text}),
-                    probe=self._client_gone, req_id=rid)
+                    probe=probe, req_id=rid)
                 emit_chat({}, finish=result["choices"][0]["finish_reason"],
                           timings=result.get("timings"))
             self._log_done(rid or "-", result)
@@ -1273,11 +1361,95 @@ class _Handler(BaseHTTPRequestHandler):
         chunk(b"")  # terminating zero-length chunk
 
 
-def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) -> tuple[ThreadingHTTPServer, ApiServer]:
-    """n_slots > 0 enables the continuous-batching tier: a BatchEngine with
-    that many cache slots behind a Scheduler (concurrent requests share the
-    device). n_slots == 0 keeps the single-engine tier with the NaiveCache
-    prefix reuse (the reference server's semantics)."""
+class _Handler(RequestRoutes, BaseHTTPRequestHandler):
+    """The thread-per-connection transport (`--frontend threads`): stdlib
+    BaseHTTPRequestHandler provides parsing/keep-alive, RequestRoutes the
+    endpoints, and this class only the six transport primitives."""
+
+    server_version = "dllama-tpu"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        log.info("%s %s", self.address_string(), fmt % args)
+
+    def _send_raw(self, status: int, headers, body: bytes) -> None:
+        self.send_response(status)
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        # counted before the body write: once the client has read the
+        # response, the counter has already moved (no scrape-after-response
+        # race for tests or tight operators)
+        ins.HTTP_RESPONSES.labels(endpoint=_endpoint(self.path),
+                                  code=str(status)).inc()
+        self.wfile.write(body)
+
+    def _start_sse(self) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        if self._req_id:
+            self.send_header("X-Request-Id", self._req_id)
+        if self.api.replica_id:
+            self.send_header("X-Replica-Id", self.api.replica_id)
+        self.end_headers()
+        ins.HTTP_RESPONSES.labels(endpoint=_endpoint(self.path),
+                                  code="200").inc()
+
+    def _write_chunk(self, payload: bytes) -> None:
+        self.wfile.write(f"{len(payload):x}\r\n".encode() + payload + b"\r\n")
+        self.wfile.flush()
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(length)
+
+    def _drain_body(self) -> None:
+        """Read and discard any request body. The /debug endpoints answer
+        early errors (404 unknown id, 404 tracing disabled, 409 profiler
+        busy) on this keep-alive server, where unread body bytes would be
+        parsed as the NEXT request line — the do_POST bug class, applied to
+        the debug family (GETs with bodies are legal, if unusual)."""
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            length = 0
+        if length > 0:
+            try:
+                self.rfile.read(length)
+            except OSError:
+                pass
+
+    def _client_gone(self) -> bool:
+        """Disconnect probe for non-streamed completions: a readable socket
+        that MSG_PEEKs zero bytes is a closed peer (we never read mid-
+        completion, so pending bytes can only be a pipelined request — in
+        which case the client is certainly still there).
+
+        Known trade-off: a client that legally HALF-closes its write side
+        after the request body (shutdown(SHUT_WR), then reads) looks
+        identical to a full close at this layer and gets cancelled. That's
+        the same call Starlette/uvicorn make for their disconnect probes;
+        real OpenAI-style clients keep the socket open until the response."""
+        try:
+            r, _, _ = select.select([self.connection], [], [], 0)
+            if not r:
+                return False
+            return self.connection.recv(1, socket.MSG_PEEK) == b""
+        except (OSError, ValueError):
+            return True
+
+
+def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults):
+    """-> (server, api). n_slots > 0 enables the continuous-batching tier: a
+    BatchEngine with that many cache slots behind a Scheduler (concurrent
+    requests share the device). n_slots == 0 keeps the single-engine tier
+    with the NaiveCache prefix reuse (the reference server's semantics).
+    `frontend` in **defaults picks the transport: 'aio' (default — the
+    selectors event loop, serve/aio.py) or 'threads' (ThreadingHTTPServer);
+    both answer the same routes and expose serve_forever/shutdown/
+    server_close/server_address."""
     scheduler = None
     if n_slots <= 0 and any(
         defaults.get(k) is not None
@@ -1453,9 +1625,36 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults) 
         spec=defaults.get("spec", 0),
         slo_ttft_ms=defaults.get("slo_ttft_ms"),
         slo_itl_ms=defaults.get("slo_itl_ms"),
+        replica_id=defaults.get("replica_id") or "",
+        sse_heartbeat_s=defaults.get("sse_heartbeat_s") or 0.0,
     )
-    handler = type("Handler", (_Handler,), {"api": api})
-    httpd = ThreadingHTTPServer((host, port), handler)
+    # front-end selection (ISSUE 15): 'aio' (default) multiplexes every
+    # connection on a selectors event loop with a small fixed thread count;
+    # 'threads' keeps the thread-per-connection ThreadingHTTPServer as the
+    # A/B baseline. Same routes class either way — byte-identical semantics.
+    frontend = str(defaults.get("frontend") or "aio")
+    if frontend == "aio":
+        from dllama_tpu.serve.aio import AioHttpServer
+
+        httpd = AioHttpServer(
+            (host, port), api,
+            workers=int(defaults.get("aio_workers") or 0) or None)
+    elif frontend == "threads":
+        handler = type("Handler", (_Handler,), {"api": api})
+        httpd = ThreadingHTTPServer((host, port), handler)
+    else:
+        raise ValueError(f"unknown frontend {frontend!r} (aio|threads)")
+    if not api.replica_id:
+        # default replica identity: the bound address — unique per replica
+        # of a router mesh, stable for the life of the process. A wildcard
+        # bind (0.0.0.0/::) names every machine's replica identically and
+        # collapses the mesh's X-Replica-Id attribution to one bucket, so
+        # substitute the hostname there
+        ident = host
+        if host in ("0.0.0.0", "::", ""):
+            import socket as _socket
+            ident = _socket.gethostname()
+        api.replica_id = f"{ident}:{httpd.server_address[1]}"
     return httpd, api
 
 

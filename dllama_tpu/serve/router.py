@@ -1,0 +1,677 @@
+"""Multi-replica serving router (ISSUE 15) — `dllama-tpu router`.
+
+One engine process owns one device; serving "millions of users" needs N of
+them. This router is the separate process that fronts N engine replicas
+(each a normal `dllama-tpu serve --slots ...` process) and gives the fleet
+one OpenAI-compatible address, the way the reference's ROOT node fronts
+its `NnNetwork` worker mesh (SURVEY.md L4/L5: the root performs a config
+handshake with every worker, then scatter-gathers the actual work):
+
+* **replica registry + config handshake** — at registration the router
+  reads each replica's `/health` build payload and `/v1/models`; the first
+  replica's (model, version) pair becomes the mesh config, and a replica
+  that disagrees is quarantined (config_ok=False, never routed) instead of
+  silently serving a different model — the root/worker handshake verdict,
+  inverted for a pull-style mesh (replicas own their weights; the router
+  verifies instead of distributing).
+* **health polling + drain integration** — a poller thread GETs `/health`
+  on a short cadence: `ready:false` (draining or saturated) stops NEW
+  routing while in-flight requests finish; `live` flips the
+  `dllama_replica_healthy` gauge; connection failure marks the replica
+  down immediately at the first failed proxy attempt, not a poll later.
+* **prefix-affinity routing** — requests carry their prefix fingerprint
+  (the shared system prompt / leading prompt bytes, hashed); the router
+  pins a fingerprint to the replica that served it last, so multi-turn
+  chats and shared-template traffic land where PR 9's radix cache is
+  already warm (SGLang's cache-aware routing, one level up). Token-id
+  exactness lives in the replica's radix tree; the router only needs a
+  stable warm HINT, so a text-prefix hash is sufficient and tokenizer-free.
+  Capacity-aware: a warm replica that is overloaded relative to the
+  least-loaded one (or not ready) is overridden, and the fingerprint is
+  re-pinned to wherever the request actually lands.
+* **failover** — a replica that refuses/resets before any response byte
+  reached the client is NOT a client-visible failure: the request is
+  re-routed to a surviving replica (bounded attempts, exponential
+  backoff), the failed replica is marked down, and the reroute is counted.
+  A stream that already started can't be replayed (tokens are not
+  idempotent): it is failed CLEANLY, exactly once — a final SSE chunk with
+  `finish_reason:"error"`, an in-band error event, then `[DONE]` — never a
+  half-open socket. When every replica is down or shedding, the router
+  sheds with the worst upstream's `Retry-After` honored.
+
+Transport: the same selectors event loop as `--frontend aio`
+(serve/aio.AioHttpServer with a router context class); each in-flight
+proxied request occupies one worker-pool thread for its upstream I/O.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import logging
+import threading
+import time
+import uuid
+
+from dllama_tpu.obs import metrics, new_request_id
+from dllama_tpu.obs import instruments as ins
+from dllama_tpu.serve.aio import AioHttpServer, _AioContext
+from dllama_tpu.utils import locks
+
+log = logging.getLogger("dllama_tpu.serve.router")
+
+#: request paths the router proxies (completions surface only; /debug and
+#: /metrics are per-replica diagnostics an operator hits directly)
+_PROXY_POSTS = ("/v1/chat/completions", "/chat/completions",
+                "/v1/completions", "/completions")
+
+#: leading prompt characters the affinity fingerprint hashes — long enough
+#: to separate real system prompts, short enough that giant pastes don't
+#: dominate the hash cost
+AFFINITY_PREFIX_CHARS = 512
+
+#: how much busier (in-flight + queued) an affinity-warm replica may be
+#: than the least-loaded one before warmth loses to capacity
+AFFINITY_OVERLOAD = 8
+
+
+class Replica:
+    """Registry entry for one engine replica."""
+
+    __slots__ = ("rid", "host", "port", "live", "ready", "draining",
+                 "queue_depth", "busy_slots", "inflight", "build",
+                 "model", "config_ok", "handshaken", "last_poll",
+                 "last_picked", "fails")
+
+    def __init__(self, rid: str, host: str, port: int):
+        self.rid = rid
+        self.host = host
+        self.port = port
+        self.live = False
+        self.ready = False
+        self.draining = False
+        self.queue_depth = 0
+        self.busy_slots = 0
+        self.inflight = 0  # router-side in-flight proxied requests
+        self.build = None  # /health "build" payload from the handshake
+        self.model = None  # /v1/models first id
+        self.config_ok = True
+        self.handshaken = False
+        self.last_poll = 0.0
+        self.last_picked = 0.0
+        self.fails = 0
+
+    def load(self) -> int:
+        """The routing load signal: what's running here plus what's queued
+        (health-poll fresh) plus what this router already sent."""
+        return self.inflight + self.queue_depth + self.busy_slots
+
+    def snapshot(self) -> dict:
+        return {"id": self.rid, "address": f"{self.host}:{self.port}",
+                "live": self.live, "ready": self.ready,
+                "draining": self.draining, "config_ok": self.config_ok,
+                "queue_depth": self.queue_depth,
+                "busy_slots": self.busy_slots, "inflight": self.inflight,
+                "fails": self.fails, "model": self.model,
+                "build": self.build,
+                "last_poll_age_s": (round(time.monotonic() - self.last_poll,
+                                          3) if self.last_poll else None)}
+
+
+class _UpstreamDead(Exception):
+    """Connection-level failure before/while talking to a replica."""
+
+
+class _UpstreamBusy(Exception):
+    """Replica answered 429/503 — try elsewhere, honor Retry-After."""
+
+    def __init__(self, status: int, retry_after: float):
+        super().__init__(f"upstream {status}")
+        self.status = status
+        self.retry_after = retry_after
+
+
+def _parse_replica(spec: str) -> Replica:
+    """'host:port' or 'http://host:port' -> Replica (rid = host:port)."""
+    s = spec.strip()
+    if s.startswith("http://"):
+        s = s[len("http://"):]
+    s = s.rstrip("/")
+    host, _, port = s.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"replica spec {spec!r}: expected host:port")
+    return Replica(f"{host}:{port}", host, int(port))
+
+
+class Router:
+    """The replica mesh + routing policy (transport-independent: the
+    context class below adapts it onto the aio event loop)."""
+
+    # the aio context reads these off `server.api`
+    replica_id = ""
+    sse_heartbeat_s = 0.0
+    scheduler = None
+
+    def __init__(self, replicas: list[str], poll_s: float = 0.5,
+                 affinity: bool = True, connect_timeout_s: float = 2.0,
+                 stream_idle_timeout_s: float = 120.0,
+                 max_affinity_entries: int = 4096):
+        if not replicas:
+            raise ValueError("router needs at least one --replica")
+        self.replicas = [_parse_replica(s) for s in replicas]
+        if len({r.rid for r in self.replicas}) != len(self.replicas):
+            raise ValueError("duplicate --replica addresses")
+        self.poll_s = float(poll_s)
+        self.affinity_on = bool(affinity)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.stream_idle_timeout_s = float(stream_idle_timeout_s)
+        self.max_affinity_entries = int(max_affinity_entries)
+        self._mu = locks.make_lock("serve.router")
+        self._affinity: dict[str, str] = {}  # fingerprint -> replica rid
+        self._pick_seq = 0.0
+        self.draining = False
+        self._stop = threading.Event()
+        self._pollers: list[threading.Thread] = []  # one per replica
+        # mesh config (set by the first successful handshake): every other
+        # replica must agree or it is quarantined
+        self.mesh_model = None
+        self.mesh_version = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        # one synchronous poll round first so the router comes up knowing
+        # its mesh (the reference root performs its config handshake before
+        # serving, nn-network root/worker synchronize the same way).
+        # SEQUENTIAL in list order so mesh-config adoption is deterministic
+        # — "the first replica's (model, version) becomes the mesh config"
+        # must mean the first LISTED live replica, not a poll race winner.
+        for rep in self.replicas:
+            self._poll_one(rep)
+        # steady state: ONE persistent poller thread per replica — polls of
+        # the same replica are serialized by construction (a stale timed-out
+        # poll can never overwrite a fresher one's verdict), an unreachable
+        # replica's 2 s connect timeouts never stretch its neighbors'
+        # cadence, and nothing spawns per tick
+        for rep in self.replicas:
+            t = threading.Thread(target=self._poll_replica_loop, args=(rep,),
+                                 name=f"dllama-router-poll-{rep.rid}",
+                                 daemon=True)
+            self._pollers.append(t)
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def drain(self) -> None:
+        """Stop admitting NEW requests (503 + ready:false); in-flight
+        proxied requests keep streaming until they finish."""
+        self.draining = True
+
+    # ---------------------------------------------------------- health poll
+
+    def _poll_one(self, rep: Replica) -> None:
+        try:
+            conn = http.client.HTTPConnection(rep.host, rep.port,
+                                              timeout=self.connect_timeout_s)
+            conn.request("GET", "/health")
+            resp = conn.getresponse()
+            payload = json.loads(resp.read() or b"{}")
+            conn.close()
+        except (OSError, ValueError, http.client.HTTPException) as e:
+            # HTTPException (BadStatusLine/IncompleteRead from a replica
+            # mid-restart) is not an OSError — escaping here would kill the
+            # poller thread permanently
+            self._mark_down(rep, f"health poll failed: {e!r}")
+            return
+        rep.live = bool(payload.get("live"))
+        rep.ready = bool(payload.get("ready")) and not payload.get("draining")
+        rep.draining = bool(payload.get("draining"))
+        rep.queue_depth = int(payload.get("queue_depth") or 0)
+        rep.busy_slots = int(payload.get("busy_slots") or 0)
+        rep.last_poll = time.monotonic()
+        ins.REPLICA_HEALTHY.labels(replica=rep.rid).set(
+            1.0 if rep.live else 0.0)
+        if not rep.handshaken:
+            self._handshake(rep, payload)
+
+    def _handshake(self, rep: Replica, health: dict) -> None:
+        """Config handshake (reference root/worker wire protocol's role):
+        record the replica's build + served model, adopt the first
+        replica's pair as the mesh config, quarantine disagreement."""
+        rep.build = health.get("build")
+        try:
+            conn = http.client.HTTPConnection(rep.host, rep.port,
+                                              timeout=self.connect_timeout_s)
+            conn.request("GET", "/v1/models")
+            resp = conn.getresponse()
+            models = json.loads(resp.read() or b"{}")
+            conn.close()
+            rep.model = (models.get("data") or [{}])[0].get("id")
+        except (OSError, ValueError, IndexError, http.client.HTTPException):
+            return  # not handshaken yet; next poll retries
+        rep.handshaken = True
+        version = (rep.build or {}).get("version")
+        with self._mu:
+            if self.mesh_model is None:
+                self.mesh_model = rep.model
+                self.mesh_version = version
+                rep.config_ok = True
+                log.info("router mesh config from %s: model=%s version=%s",
+                         rep.rid, rep.model, version)
+                return
+        ok = rep.model == self.mesh_model and version == self.mesh_version
+        if not ok and rep.config_ok:
+            log.error("replica %s FAILED the config handshake: serves "
+                      "(%s, %s), mesh is (%s, %s) — quarantined",
+                      rep.rid, rep.model, version, self.mesh_model,
+                      self.mesh_version)
+        elif ok and not rep.config_ok:
+            # a formerly-quarantined replica came back (redeployed) with
+            # the mesh's config: re-admit it
+            log.info("replica %s re-passed the config handshake — "
+                     "re-admitted", rep.rid)
+        rep.config_ok = ok
+
+    def _mark_down(self, rep: Replica, why: str) -> None:
+        if rep.live or rep.ready:
+            log.warning("replica %s marked down: %s", rep.rid, why)
+        rep.live = False
+        rep.ready = False
+        # a down replica may come back as a DIFFERENT process (redeploy):
+        # its identity must be re-verified before it is routed again — this
+        # is also how a quarantined replica rejoins after being fixed
+        rep.handshaken = False
+        rep.fails += 1
+        rep.last_poll = time.monotonic()
+        ins.REPLICA_HEALTHY.labels(replica=rep.rid).set(0.0)
+
+    def _poll_replica_loop(self, rep: Replica) -> None:
+        while not self._stop.wait(self.poll_s):
+            self._poll_one(rep)
+
+    # -------------------------------------------------------------- routing
+
+    @staticmethod
+    def fingerprint(body: dict, legacy: bool) -> str | None:
+        """Prefix fingerprint of a completions body — the warm-cache hint.
+        Chat: the leading SYSTEM message when present (the shared-template
+        prefix real traffic reuses), else the first message; legacy: the
+        prompt's leading bytes. Deterministic text prefix => deterministic
+        token prefix => the replica's radix tree resolves the real hit."""
+        try:
+            if legacy:
+                text = str(body.get("prompt") or "")
+            else:
+                msgs = body.get("messages") or []
+                first = msgs[0] if msgs else {}
+                text = f"{first.get('role')}\x1f{first.get('content')}"
+            if not text:
+                return None
+            return hashlib.sha1(
+                text[:AFFINITY_PREFIX_CHARS].encode("utf-8", "replace")
+            ).hexdigest()
+        except (TypeError, AttributeError, IndexError):
+            return None
+
+    def _routable(self, exclude: set) -> list[Replica]:
+        # handshaken is required, not just config_ok: before the handshake
+        # completes the replica's identity is UNVERIFIED (config_ok still
+        # holds its default) — never route there yet
+        return [r for r in self.replicas
+                if r.ready and r.handshaken and r.config_ok
+                and r.rid not in exclude]
+
+    def pick(self, fp: str | None,
+             exclude: set) -> tuple[Replica | None, bool]:
+        """-> (replica, via_affinity). Affinity wins when the pinned
+        replica is routable and not overloaded relative to the least-
+        loaded candidate; otherwise least-loaded (LRU tie-break). The
+        fingerprint is (re)pinned to whatever is returned."""
+        with self._mu:
+            candidates = self._routable(exclude)
+            if not candidates:
+                return None, False
+            least = min(candidates, key=lambda r: (r.load(), r.last_picked))
+            chosen, warm = least, False
+            if self.affinity_on and fp is not None:
+                rid = self._affinity.get(fp)
+                if rid is not None:
+                    rep = next((r for r in candidates if r.rid == rid), None)
+                    if rep is not None and (
+                            rep.load() <= least.load() + AFFINITY_OVERLOAD):
+                        chosen, warm = rep, True
+                if len(self._affinity) >= self.max_affinity_entries \
+                        and fp not in self._affinity:
+                    # cheap cap: drop the oldest insertion (dict preserves
+                    # insertion order); a fingerprint that matters re-pins
+                    # on its next request
+                    self._affinity.pop(next(iter(self._affinity)))
+                self._affinity[fp] = chosen.rid
+            self._pick_seq += 1.0
+            chosen.last_picked = self._pick_seq
+            chosen.inflight += 1
+        if warm:
+            ins.ROUTER_AFFINITY_HITS.inc()
+        return chosen, warm
+
+    def release(self, rep: Replica) -> None:
+        with self._mu:
+            rep.inflight = max(0, rep.inflight - 1)
+
+    # ------------------------------------------------------------- snapshot
+
+    def health(self) -> dict:
+        reps = [r.snapshot() for r in self.replicas]
+        ready = any(r.ready and r.handshaken and r.config_ok
+                    for r in self.replicas) and not self.draining
+        return {"live": True, "ready": ready,
+                "status": "ok", "mode": "router",
+                "draining": self.draining,
+                "replicas": reps,
+                "mesh": {"model": self.mesh_model,
+                         "version": self.mesh_version},
+                "process": ins.refresh_process_gauges()}
+
+
+class _RouterContext(_AioContext):
+    """Router endpoints over the aio transport. `self.api` is the Router."""
+
+    def do_GET(self):
+        self._req_id = None
+        router: Router = self.api
+        if self.path in ("/health", "/health/live", "/health/ready"):
+            h = router.health()
+            key = "ready" if self.path.endswith("/ready") else "live"
+            self._send_json(200 if h[key] else 503, h)
+        elif self.path == "/router/replicas":
+            self._send_json(200, {"replicas": [r.snapshot()
+                                               for r in router.replicas]})
+        elif self.path == "/metrics":
+            ins.refresh_process_gauges()
+            body = metrics.REGISTRY.render().encode()
+            self._send_raw(
+                200,
+                [("Content-Type", "text/plain; version=0.0.4; charset=utf-8"),
+                 ("Content-Length", str(len(body)))],
+                body)
+        elif self.path == "/v1/models":
+            # answered from the handshake record — the mesh serves ONE model
+            # by construction, no upstream round-trip needed
+            self._send_json(200, {
+                "object": "list",
+                "data": [{"id": router.mesh_model or "dllama-tpu",
+                          "object": "model", "created": int(time.time()),
+                          "owned_by": "dllama-tpu"}]})
+        else:
+            self._send_json(404, {"error": {"message": "not found"}})
+
+    def do_POST(self):
+        router: Router = self.api
+        rid = self._req_id = new_request_id(self.headers.get("X-Request-Id"))
+        try:
+            raw = self._read_body()
+        except (ValueError, OSError):
+            self._send_json(400, {"error": {"message": "invalid request"}})
+            return
+        if self.path not in _PROXY_POSTS:
+            self._send_json(404, {"error": {"message": "not found"}})
+            return
+        if router.draining:
+            self._send_json(503, {"error": {"message": "router is draining"}},
+                            {"Retry-After": "5"})
+            ins.ROUTER_REQUESTS.labels(replica="none",
+                                       outcome="shed").inc()
+            return
+        _proxy(router, self, raw, rid)
+
+
+def _proxy(router: Router, ctx: _RouterContext, raw: bytes,
+           rid: str) -> None:
+    """Route one completions request: pick -> forward -> (maybe) failover.
+    Runs on a pool worker; a streamed response occupies the worker for the
+    stream's lifetime (upstream I/O is blocking)."""
+    legacy = ctx.path in ("/v1/completions", "/completions")
+    try:
+        body = json.loads(raw or b"{}")
+        if not isinstance(body, dict):
+            raise ValueError
+    except (ValueError, json.JSONDecodeError):
+        ctx._send_json(400, {"error": {"message": "invalid JSON body"}})
+        return
+    stream = bool(body.get("stream"))
+    fp = router.fingerprint(body, legacy)
+    tried: set[str] = set()
+    busy: list[_UpstreamBusy] = []
+    backoff = 0.05
+    attempts = len(router.replicas) + 1
+    for _ in range(attempts):
+        rep, warm = router.pick(fp, exclude=tried)
+        if rep is None:
+            break
+        try:
+            _forward(router, ctx, rep, raw, rid, stream, legacy)
+            return
+        except _UpstreamBusy as e:
+            # the replica is shedding (429 queue-full / 503 draining):
+            # honest capacity signal, not a crash — try the next one
+            busy.append(e)
+            tried.add(rep.rid)
+            ins.ROUTER_REQUESTS.labels(replica=rep.rid,
+                                       outcome="busy").inc()
+        except _UpstreamDead as e:
+            # connection refused/reset with ZERO client-visible bytes:
+            # idempotent from the client's seat — mark down, reroute
+            router._mark_down(rep, f"proxy failed: {e}")
+            tried.add(rep.rid)
+            ins.ROUTER_REQUESTS.labels(replica=rep.rid,
+                                       outcome="rerouted").inc()
+            log.warning("request %s: replica %s failed before response "
+                        "start; rerouting", rid, rep.rid,
+                        extra={"request_id": rid})
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 1.0)
+        finally:
+            router.release(rep)
+    # every replica tried/saturated: shed. Prefer the upstreams' own
+    # Retry-After (429 beats 503 as the status when any replica exists but
+    # is saturated — the client should back off and retry, not fail over).
+    ins.ROUTER_REQUESTS.labels(replica="none", outcome="shed").inc()
+    if busy:
+        retry_after = max(int(e.retry_after) for e in busy)
+        status = 429 if any(e.status == 429 for e in busy) else 503
+        ctx._send_json(status, {"error": {
+            "message": "all replicas are saturated"}},
+            {"Retry-After": str(max(retry_after, 1))})
+    else:
+        ctx._send_json(503, {"error": {
+            "message": "no ready replicas"}}, {"Retry-After": "5"})
+
+
+def _forward(router: Router, ctx: _RouterContext, rep: Replica,
+             raw: bytes, rid: str, stream: bool, legacy: bool) -> None:
+    """One forwarding attempt. Raises _UpstreamDead/_UpstreamBusy while the
+    attempt is still idempotent (no client-visible bytes); once the
+    response starts, failures terminate the client stream cleanly with
+    finish_reason="error" instead of raising."""
+    headers = {"Content-Type": "application/json", "X-Request-Id": rid}
+    tmo = ctx.headers.get("X-Request-Timeout")
+    if tmo:
+        headers["X-Request-Timeout"] = tmo
+    try:
+        # connect under the SHORT timeout so a black-holed replica (SYN
+        # dropped, no RST) fails over in ~connect_timeout_s instead of
+        # holding this worker for the whole read timeout; only the
+        # established socket gets the long read deadline
+        conn = http.client.HTTPConnection(rep.host, rep.port,
+                                          timeout=router.connect_timeout_s)
+        conn.connect()
+        conn.sock.settimeout(router.stream_idle_timeout_s if stream
+                             else max(router.stream_idle_timeout_s, 600.0))
+        conn.request("POST", ctx.path, raw, headers)
+        resp = conn.getresponse()
+    except (OSError, http.client.HTTPException) as e:
+        # HTTPException covers a replica dying mid-status-line
+        # (BadStatusLine & co.) — still zero client-visible bytes, still
+        # idempotent, still a reroute
+        raise _UpstreamDead(f"{e.__class__.__name__}: {e}") from None
+    ctype = resp.getheader("Content-Type") or ""
+    if resp.status in (429, 503):
+        try:
+            resp.read()  # drain so the connection closes cleanly
+        except (OSError, http.client.HTTPException):
+            pass  # verdict (status + Retry-After) is already in hand; a
+            # replica dying after its shed headers must still shed, not
+            # escape _proxy and drop the client with no response
+        conn.close()
+        try:
+            retry_after = float(resp.getheader("Retry-After") or 1)
+        except ValueError:
+            retry_after = 1.0
+        raise _UpstreamBusy(resp.status, retry_after)
+    replica_hdr = resp.getheader("X-Replica-Id") or rep.rid
+    if not (stream and resp.status == 200
+            and ctype.startswith("text/event-stream")):
+        # non-stream (or upstream error answered as JSON): buffer fully,
+        # THEN forward — a failure mid-read leaves the attempt idempotent
+        try:
+            data = resp.read()
+        except (OSError, http.client.HTTPException) as e:
+            conn.close()
+            raise _UpstreamDead(f"read failed: {e!r}") from None
+        conn.close()
+        hdrs = [("Content-Type", ctype or "application/json"),
+                ("Content-Length", str(len(data))),
+                ("X-Request-Id", resp.getheader("X-Request-Id") or rid),
+                ("X-Replica-Id", replica_hdr)]
+        ctx._send_raw(resp.status, hdrs, data)
+        ins.ROUTER_REQUESTS.labels(
+            replica=rep.rid,
+            outcome="ok" if resp.status < 500 else "error").inc()
+        return
+    # ---- streamed pass-through: client-visible from the headers on
+    hdrs = [("Content-Type", "text/event-stream"),
+            ("Cache-Control", "no-cache"),
+            ("Transfer-Encoding", "chunked"),
+            ("X-Request-Id", resp.getheader("X-Request-Id") or rid),
+            ("X-Replica-Id", replica_hdr)]
+    ins.HTTP_RESPONSES.labels(
+        endpoint="/v1/completions" if legacy else "/v1/chat/completions",
+        code="200").inc()
+    ctx.server.enqueue(ctx.conn, ctx._head(200, hdrs))
+    try:
+        while True:
+            # read1: forward whatever is available NOW. read(n) on a
+            # chunked response blocks until n bytes accumulate or EOF —
+            # it would hold ~100-byte token deltas (and keep-alive
+            # heartbeats) hostage until the stream ended, turning the
+            # router into a buffer that defeats streaming entirely
+            data = resp.read1(16384)
+            if not data:
+                break
+            ctx._write_chunk(data)
+            if ctx.conn.dead:
+                # client hung up mid-stream: stop pulling tokens and close
+                # the upstream socket so the REPLICA's disconnect probe
+                # fires and frees the slot
+                conn.close()
+                ins.ROUTER_REQUESTS.labels(replica=rep.rid,
+                                           outcome="client_gone").inc()
+                return
+        conn.close()
+        ctx._write_chunk(b"")  # upstream finished cleanly; end our chunks
+        ins.ROUTER_REQUESTS.labels(replica=rep.rid, outcome="ok").inc()
+    except (OSError, http.client.HTTPException) as e:
+        # replica died MID-STREAM: tokens already reached the client, so a
+        # replay would duplicate output — fail this stream exactly once,
+        # cleanly (final chunk with finish_reason="error", in-band error
+        # event, [DONE], chunk terminator)
+        conn.close()
+        router._mark_down(rep, f"died mid-stream: {e!r}")
+        ins.ROUTER_REQUESTS.labels(replica=rep.rid,
+                                   outcome="stream_error").inc()
+        log.warning("request %s: replica %s died mid-stream; closing the "
+                    "stream with finish_reason=error", rid, rep.rid,
+                    extra={"request_id": rid})
+        fail = {
+            "id": f"{'cmpl' if legacy else 'chatcmpl'}-"
+                  f"{uuid.uuid4().hex[:16]}",
+            "object": ("text_completion" if legacy
+                       else "chat.completion.chunk"),
+            "created": int(time.time()),
+            "model": router.mesh_model or "dllama-tpu",
+            "choices": [{"index": 0,
+                         **({"text": ""} if legacy else {"delta": {}}),
+                         "finish_reason": "error"}],
+        }
+        err = {"message": f"replica {rep.rid} failed mid-stream",
+               "type": "server_error", "request_id": rid}
+        ctx._write_chunk(b"data: " + json.dumps(fail).encode() + b"\n\n")
+        ctx._write_chunk(b"data: " + json.dumps({"error": err}).encode()
+                         + b"\n\n")
+        ctx._write_chunk(b"data: [DONE]\n\n")
+        ctx._write_chunk(b"")
+
+
+def make_router(replicas: list[str], host: str = "127.0.0.1", port: int = 0,
+                poll_s: float = 0.5, affinity: bool = True,
+                workers: int | None = None) -> tuple[AioHttpServer, Router]:
+    """Build (server, router) without starting either — the test seam.
+    Call router.start() for the handshake + poller, then serve_forever."""
+    router = Router(replicas, poll_s=poll_s, affinity=affinity)
+    server = AioHttpServer((host, port), router, workers=workers or 16,
+                           ctx_factory=_RouterContext)
+    return server, router
+
+
+def run_router(replicas: list[str], host: str = "127.0.0.1",
+               port: int = 9980, poll_s: float = 0.5, affinity: bool = True,
+               workers: int | None = None,
+               drain_timeout_s: float = 30.0) -> int:
+    """CLI entry: boot the router, install SIGTERM drain, serve forever."""
+    import signal
+
+    server, router = make_router(replicas, host, port, poll_s=poll_s,
+                                 affinity=affinity, workers=workers)
+    router.start()
+
+    fired = threading.Event()
+
+    def _term(signum, frame):
+        if fired.is_set():
+            return
+        fired.set()
+        log.info("SIGTERM: router draining (timeout %.0fs)", drain_timeout_s)
+
+        def _drain():
+            router.drain()
+            deadline = time.monotonic() + drain_timeout_s
+            while time.monotonic() < deadline:
+                if not any(r.inflight for r in router.replicas):
+                    break
+                time.sleep(0.1)
+            server.shutdown()
+
+        threading.Thread(target=_drain, name="dllama-router-drain",
+                         daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _term)
+    except ValueError:  # pragma: no cover - not the main thread
+        pass
+    n = len(router.replicas)
+    log.info("router serving on http://%s:%d over %d replica(s); "
+             "affinity=%s", host, server.server_address[1], n,
+             "on" if affinity else "off")
+    print(f"🔀 http://{host}:{server.server_address[1]}/v1/chat/completions "
+          f"(router, {n} replicas, affinity "
+          f"{'on' if affinity else 'off'})")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:  # pragma: no cover - interactive
+        pass
+    finally:
+        router.stop()
+        server.server_close()
+    return 0

@@ -248,6 +248,27 @@ class Request:
                 return
             yield item
 
+    def poll_tokens(self) -> tuple[list[int], bool]:
+        """NON-blocking drain of the token queue — the aio front-end's SSE
+        pump seam (one thread multiplexes every stream, so nothing may
+        block). Returns ``(tokens, done)``: every token available right
+        now, and whether the stream has ended (EOS/budget/cancel/timeout —
+        ``finish_reason`` is authoritative once True). A queued exception
+        (shed/shutdown/crash) raises exactly like :meth:`tokens`; tokens
+        drained before it are lost to the caller the same way the blocking
+        iterator loses them (the request is terminal either way)."""
+        toks: list[int] = []
+        while True:
+            try:
+                item = self.out.get_nowait()
+            except queue.Empty:
+                return toks, False
+            if item is _END:
+                return toks, True
+            if isinstance(item, Exception):
+                raise item
+            toks.append(item)
+
 
 class Scheduler:
     def __init__(self, engine: BatchEngine, chunk: int = 4, admit_timeout: float = 0.05,

@@ -53,9 +53,15 @@ ENV_VAR = "DLLAMA_LOCK_AUDIT"
 #: ``doc-ranks``), and the static lock graph's edges must all ascend it
 #: (rule ``lock-order``).
 LOCK_RANKS = {
+    # outermost: the router's replica-registry/affinity lock — routing
+    # decisions may consult anything below, nothing re-enters the router
+    "serve.router": 3,
     # outermost: the single-engine API tier's request serializer — held
     # across a whole generation, everything below nests under it
     "api.single": 5,
+    # the aio front-end's connection-registry/stream-list lock (held for
+    # dict/list mutation only — never across a handler or a device call)
+    "serve.frontend": 7,
     # the scheduler's completed-request/stall-sample ring
     "scheduler.metrics": 10,
     # the paged-KV allocator (PagePool._mu, reentrant: the radix tree

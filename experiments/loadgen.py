@@ -28,6 +28,20 @@ CLI (tiny synthetic model, CPU-friendly)::
 
 Library use: ``run_loadgen(sched, tenants, duration_s, seed)`` against any
 Scheduler — tests/test_hybrid.py and bench.py reuse pieces of it.
+
+**Router / HTTP target mode (ISSUE 15)**: ``--target http://host:port``
+drives the same open-loop schedule over the serving HTTP surface instead
+of an in-process Scheduler — point it at a single replica or at a
+`dllama-tpu router` front. Each tenant's requests share a per-tenant
+system prompt (so prefix-affinity routing has the fingerprint real
+traffic would give it), stream their completion (TTFT = first content
+event on the wire), and record the `X-Replica-Id` attribution header; the
+report adds a per-replica request/token breakdown. Scheduler-internal
+counters (preemptions, prefill budget) are absent in this mode — they
+live on the replicas' own /metrics::
+
+    python experiments/loadgen.py --target http://127.0.0.1:9980 \
+        --duration 20 --seed 0 --out /tmp/loadgen_router.json
 """
 
 from __future__ import annotations
@@ -214,6 +228,182 @@ def run_loadgen(sched, tenants: list[TenantSpec], duration_s: float,
     return out
 
 
+@dataclass
+class _HttpFlight:
+    tenant: str
+    t_submit: float
+    ttft_ms: float | None = None
+    e2e_ms: float | None = None
+    tokens: int = 0
+    replica: str = ""
+    finish: str | None = None
+    shed: str | None = None
+
+
+def _http_complete(host: str, port: int, fl: _HttpFlight, system: str,
+                   user: str, max_tokens: int, temperature: float,
+                   priority: int) -> None:
+    """One streamed chat completion over the wire; fills `fl` in place.
+    TTFT is clocked at the first content-bearing SSE event — the
+    client-seat number, queueing + routing + prefill included."""
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=120)
+    try:
+        conn.request("POST", "/v1/chat/completions", json.dumps({
+            "messages": [{"role": "system", "content": system},
+                         {"role": "user", "content": user}],
+            "max_tokens": max_tokens, "temperature": temperature,
+            "priority": priority, "tenant": fl.tenant, "stream": True,
+        }), {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            fl.shed = f"http_{resp.status}"
+            resp.read()
+            return
+        fl.replica = resp.getheader("X-Replica-Id") or ""
+        buf = b""
+        while True:
+            # read1, not read: read(n) on a chunked response blocks until
+            # n bytes or EOF, which would clock ttft_ms at the 4 KB
+            # boundary instead of the first token frame
+            chunk = resp.read1(4096)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n\n" in buf:
+                frame, _, buf = buf.partition(b"\n\n")
+                if not frame.startswith(b"data: "):
+                    continue  # keep-alive comment frames
+                payload = frame[6:]
+                if payload == b"[DONE]":
+                    fl.e2e_ms = (time.monotonic() - fl.t_submit) * 1000.0
+                    return
+                try:
+                    ev = json.loads(payload)
+                except ValueError:
+                    continue
+                if "error" in ev:
+                    fl.finish = fl.finish or "error"
+                    continue
+                choice = (ev.get("choices") or [{}])[0]
+                if choice.get("delta", {}).get("content"):
+                    if fl.ttft_ms is None:
+                        fl.ttft_ms = (time.monotonic()
+                                      - fl.t_submit) * 1000.0
+                    fl.tokens += 1
+                if choice.get("finish_reason"):
+                    fl.finish = choice["finish_reason"]
+        fl.e2e_ms = (time.monotonic() - fl.t_submit) * 1000.0
+    except OSError as e:
+        fl.shed = type(e).__name__
+    finally:
+        conn.close()
+
+
+def run_loadgen_http(target: str, tenants: list[TenantSpec],
+                     duration_s: float, seed: int = 0) -> dict:
+    """The open-loop schedule of :func:`run_loadgen`, driven over HTTP
+    against `target` (a replica or a router front). Per-tenant system
+    prompts give affinity routing its fingerprint; the report adds the
+    per-replica attribution breakdown."""
+    from dllama_tpu.serve.router import _parse_replica
+
+    try:
+        rep = _parse_replica(target)
+    except ValueError:
+        raise ValueError(f"--target {target!r}: expected http://host:port")
+    host, port = rep.host, rep.port
+    rng = random.Random(seed)
+    flights: list[_HttpFlight] = []
+    threads: list[threading.Thread] = []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def tenant_driver(spec: TenantSpec, sub_seed: int):
+        r = random.Random(sub_seed)
+        system = (f"You are serving tenant {spec.name}: a steady shared "
+                  f"preamble that every {spec.name} request reuses, so the "
+                  "router's prefix fingerprint matches real template "
+                  "traffic.")
+        t_end = time.monotonic() + duration_s
+        while not stop.is_set() and time.monotonic() < t_end:
+            time.sleep(min(r.expovariate(max(spec.rate_rps, 1e-6)), 5.0))
+            if stop.is_set() or time.monotonic() >= t_end:
+                return
+            fl = _HttpFlight(tenant=spec.name, t_submit=time.monotonic())
+            with lock:
+                flights.append(fl)
+            th = threading.Thread(
+                target=_http_complete,
+                args=(host, port, fl, system,
+                      f"request {r.randrange(1 << 20)}",
+                      r.randint(*spec.max_tokens), spec.temperature,
+                      spec.priority),
+                daemon=True)
+            with lock:
+                threads.append(th)
+            th.start()
+
+    drivers = [threading.Thread(target=tenant_driver,
+                                args=(s, seed * 977 + i), daemon=True)
+               for i, s in enumerate(tenants)]
+    t0 = time.monotonic()
+    for d in drivers:
+        d.start()
+    for d in drivers:
+        d.join(timeout=duration_s + 30)
+    # stop BEFORE the tail join: a driver that overran its join timeout must
+    # not keep launching requests (unjoined, skewing wall/tok_s) while the
+    # in-flight tail drains
+    stop.set()
+    with lock:
+        tail = list(threads)
+    for th in tail:  # bounded wait for the in-flight tail
+        th.join(timeout=60)
+    wall = time.monotonic() - t0
+
+    def report_for(sel: list[_HttpFlight]) -> dict:
+        done = [f for f in sel if f.shed is None and f.e2e_ms is not None]
+        reasons: dict[str, int] = {}
+        for f in sel:
+            key = f.shed or f.finish or "unfinished"
+            reasons[key] = reasons.get(key, 0) + 1
+        ttft = [f.ttft_ms for f in done if f.ttft_ms is not None]
+        return {
+            "offered": len(sel),
+            "completed": len(done),
+            "finish_reasons": reasons,
+            "ttft_ms": _percentiles(ttft),
+            "e2e_ms": _percentiles([f.e2e_ms for f in done]),
+            "tokens": sum(f.tokens for f in sel),
+            "slo_attainment": {
+                "ttft": _attainment(ttft, TTFT_TARGETS_MS),
+            },
+        }
+
+    with lock:
+        all_f = list(flights)
+    replicas = sorted({f.replica for f in all_f if f.replica})
+    return {
+        "seed": seed,
+        "target": target,
+        "duration_s": round(wall, 3),
+        "tenants": {s.name: {"rate_rps": s.rate_rps,
+                             "priority": s.priority,
+                             **report_for([f for f in all_f
+                                           if f.tenant == s.name])}
+                    for s in tenants},
+        "aggregate": report_for(all_f),
+        "replicas": {rid: {"requests": sum(1 for f in all_f
+                                           if f.replica == rid),
+                           "tokens": sum(f.tokens for f in all_f
+                                         if f.replica == rid)}
+                     for rid in replicas},
+        "tok_s": round(sum(f.tokens for f in all_f) / max(wall, 1e-9), 3),
+    }
+
+
 DEFAULT_TENANTS = [
     # interactive: short prompts, high priority, modest rate
     TenantSpec("interactive", rate_rps=2.0, prompt_len=(4, 10),
@@ -237,7 +427,23 @@ def main(argv=None) -> int:
     ap.add_argument("--slo-itl-ms", type=float, default=None)
     ap.add_argument("--slo-ttft-ms", type=float, default=None)
     ap.add_argument("--out", default=None, help="write the JSON report here")
+    ap.add_argument("--target", default=None, metavar="http://HOST:PORT",
+                    help="drive a live serving endpoint (a replica or a "
+                         "`dllama-tpu router` front) over HTTP instead of "
+                         "an in-process scheduler; --slots/--chunk/"
+                         "--prefill-budget/--slo-* are the server's "
+                         "business in this mode")
     args = ap.parse_args(argv)
+
+    if args.target:
+        report = run_loadgen_http(args.target, DEFAULT_TENANTS,
+                                  args.duration, seed=args.seed)
+        text = json.dumps(report, indent=2)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                f.write(text + "\n")
+        print(text)
+        return 0
 
     import jax.numpy as jnp
 

@@ -69,6 +69,12 @@ RULES: list[tuple[str, str, float]] = [
     ("spec_batch.repetitive.tok_s_ratio_spec_plain", "higher", 0.50),
     ("spec_batch.mixed.nonspec_tok_s_ratio", "higher", 0.50),
     ("spec_batch.repetitive.tokens_per_cycle", "higher", 0.50),
+    # ISSUE 15 router record: prefix-affinity must keep its warm-TTFT win
+    # over round-robin (ratio on/off < 1, normalized) and two replicas
+    # must keep out-scaling one (loose — CPU-fallback hosts share cores
+    # between the in-process replicas, so scaling is well under 2x)
+    ("router.affinity.warm_ttft_ratio_on_off", "lower", 0.50),
+    ("router.scale.agg_tok_s_ratio_2_1", "higher", 0.50),
     # ISSUE 9 radix record: warm TTFT must stay collapsed relative to cold
     # (ratio is normalized; loose tolerance — CPU hosts time compile-warm
     # suffix prefills against a chunked cold prefill)

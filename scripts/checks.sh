@@ -55,6 +55,9 @@ for name in metrics.REGISTRY.names():
 # ...and the compile-ledger / transfer series are what
 # scripts/compile_smoke.sh, the bench compile record, and the perfdiff
 # zero-ceilings assert on (ISSUE 13): removal must fail here too
+# ...and the router / aio-front-end series are what
+# scripts/router_smoke.sh, the bench router record, and the test_aio
+# bounded-thread drill assert on (ISSUE 15): removal must fail here too
 for name in ("dllama_kv_pages_total", "dllama_kv_pages_used",
              "dllama_kv_pages_shared",
              "dllama_radix_lookups_total", "dllama_radix_hit_tokens_total",
@@ -66,7 +69,10 @@ for name in ("dllama_kv_pages_total", "dllama_kv_pages_used",
              "dllama_jit_compiles_total", "dllama_jit_compile_seconds_total",
              "dllama_jit_unexpected_compiles_total",
              "dllama_transfers_total", "dllama_transfer_bytes_total",
-             "dllama_device_live_buffers", "dllama_device_live_bytes"):
+             "dllama_device_live_buffers", "dllama_device_live_bytes",
+             "dllama_router_requests_total",
+             "dllama_router_affinity_hits_total",
+             "dllama_replica_healthy", "dllama_frontend_connections"):
     if name not in metrics.REGISTRY.names():
         missing.append(f"unregistered:{name}")
 for name in sorted(trace.SPAN_CATALOG):

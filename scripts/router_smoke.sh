@@ -1,0 +1,171 @@
+#!/usr/bin/env bash
+# router_smoke.sh — end-to-end multi-replica router smoke target (ISSUE 15).
+#
+# Boots TWO `python -m dllama_tpu serve` replicas (the real CLI, tiny
+# fixture model, paged layout so the radix cache is ON) plus one
+# `python -m dllama_tpu router` process fronting them, and drills the
+# subsystem's three claims over the wire:
+#
+#   * prefix-affinity: concurrent completions sharing a system prompt all
+#     land on the SAME replica (X-Replica-Id agrees), and the router's
+#     /metrics shows dllama_router_affinity_hits_total advancing;
+#   * failover: SIGKILL of the pinned replica — the router's health view
+#     flips (dllama_replica_healthy 0, /router/replicas not ready) and the
+#     same-prefix traffic keeps completing on the survivor, zero failures;
+#   * drain: SIGTERM of the router and the surviving replica exits both
+#     cleanly (the graceful-drain path, exit code 0).
+#
+# SMOKE TARGET, not a pytest test (lives outside tests/, exempt from the
+# tier-1 run). CPU-only, ~2 min. Exit 0 = PASS.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import http.client
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.getcwd())
+from tests.test_serve import make_tiny_files  # the tier-1 fixture model
+
+tmp = tempfile.mkdtemp(prefix="dllama_router_smoke_")
+mpath, tpath, _cfg = make_tiny_files(__import__("pathlib").Path(tmp))
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+ports = [free_port(), free_port()]
+rport = free_port()
+
+replicas = [
+    subprocess.Popen(
+        [sys.executable, "-m", "dllama_tpu", "serve", "--model", mpath,
+         "--tokenizer", tpath, "--slots", "2", "--port", str(p),
+         "--kv-layout", "paged", "--page-size", "8"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    for p in ports
+]
+router = subprocess.Popen(
+    [sys.executable, "-m", "dllama_tpu", "router", "--port", str(rport),
+     "--replica", f"127.0.0.1:{ports[0]}",
+     "--replica", f"127.0.0.1:{ports[1]}",
+     "--poll-s", "0.2"],
+    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read().decode()
+    conn.close()
+    return r.status, body
+
+
+def metric(text, name):
+    m = re.search(rf"^{name} ([0-9.e+-]+)$", text, re.M)
+    return float(m.group(1)) if m else 0.0
+
+
+SHARED = ("You are a careful, thorough assistant who always answers in "
+          "complete sentences and cites sources whenever available.")
+
+
+def complete(user):
+    conn = http.client.HTTPConnection("127.0.0.1", rport, timeout=120)
+    conn.request("POST", "/v1/chat/completions",
+                 json.dumps({"messages": [
+                     {"role": "system", "content": SHARED},
+                     {"role": "user", "content": user}],
+                     "max_tokens": 6, "temperature": 0.0}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    rid = resp.getheader("X-Replica-Id") or ""
+    conn.close()
+    assert resp.status == 200, f"completion -> {resp.status}: {body}"
+    assert body["usage"]["completion_tokens"] > 0
+    assert body["timings"].get("replica") == rid, (
+        "timings.replica and X-Replica-Id must agree")
+    return rid
+
+
+procs = replicas + [router]
+try:
+    deadline = time.time() + 180  # two first-boot XLA compiles on CPU
+    while True:
+        try:
+            ready = get(rport, "/health/ready")[0] == 200
+        except OSError:
+            ready = False
+        if ready:
+            break
+        for proc in procs:
+            if proc.poll() is not None:
+                sys.exit("FAIL: a process exited before the mesh was ready")
+        if time.time() > deadline:
+            sys.exit("FAIL: router mesh never became ready")
+        time.sleep(0.25)
+
+    # (1) shared system prompt -> every completion lands on ONE replica
+    rids = {complete(f"question {i}") for i in range(4)}
+    assert len(rids) == 1, f"affinity scattered the shared prefix: {rids}"
+    pinned = rids.pop()
+    st, mtext = get(rport, "/metrics")
+    assert st == 200
+    hits = metric(mtext, "dllama_router_affinity_hits_total")
+    assert hits >= 3, f"affinity hits never advanced: {hits}"
+    assert re.search(r'dllama_router_requests_total\{[^}]*outcome="ok"',
+                     mtext), "no ok-labelled router request in /metrics"
+
+    # (2) SIGKILL the pinned replica: health flips, traffic survives
+    victim_idx = next(i for i, p in enumerate(ports)
+                      if f"127.0.0.1:{p}" == pinned)
+    replicas[victim_idx].kill()
+    replicas[victim_idx].wait(timeout=10)
+    survivor_rid = complete("after the kill")  # reroutes on first touch
+    assert survivor_rid != pinned, "request answered by a dead replica?"
+    for i in range(2):
+        assert complete(f"post-failover {i}") == survivor_rid
+    deadline = time.time() + 10
+    while True:  # poller flips the gauge within ~poll_s
+        st, mtext = get(rport, "/metrics")
+        down = re.search(
+            rf'dllama_replica_healthy\{{replica="{re.escape(pinned)}"\}} 0',
+            mtext)
+        if down:
+            break
+        if time.time() > deadline:
+            sys.exit("FAIL: dllama_replica_healthy never flipped to 0 "
+                     "for the killed replica")
+        time.sleep(0.25)
+    st, reg = get(rport, "/router/replicas")
+    reps = {r["id"]: r for r in json.loads(reg)["replicas"]}
+    assert reps[pinned]["ready"] is False, "registry still routes the dead"
+    st, _ = get(rport, "/health/ready")
+    assert st == 200, "router must stay ready on the surviving replica"
+
+    # (3) SIGTERM drains the router and the surviving replica cleanly
+    router.send_signal(signal.SIGTERM)
+    assert router.wait(timeout=30) == 0, "router drain exited non-zero"
+    survivor = replicas[1 - victim_idx]
+    survivor.send_signal(signal.SIGTERM)
+    assert survivor.wait(timeout=30) == 0, "replica drain exited non-zero"
+    print(f"PASS: router smoke OK — shared prefix pinned to {pinned} "
+          f"({hits:.0f} affinity hits), SIGKILL failover to {survivor_rid} "
+          "with zero failed requests, health flipped, drains clean")
+finally:
+    for proc in procs:
+        if proc.poll() is None:
+            proc.kill()
+PY

@@ -466,7 +466,10 @@ def test_timings_object_and_flight_recorder(mserver):
     body = json.loads(data)
     rid = body["request_id"]
     t = body["timings"]
-    assert set(t) == {"queue_wait_ms", "ttft_ms", "e2e_ms", "decode_tokens"}
+    # `replica` rides along since ISSUE 15: every response is
+    # attributable end to end through the router
+    assert set(t) == {"queue_wait_ms", "ttft_ms", "e2e_ms",
+                      "decode_tokens", "replica"}
     assert t["decode_tokens"] == body["usage"]["completion_tokens"]
     assert t["e2e_ms"] >= t["ttft_ms"] >= t["queue_wait_ms"] >= 0
 
@@ -505,7 +508,10 @@ def test_stream_final_event_carries_timings(mserver):
              if p.get("choices") and p["choices"][0].get("finish_reason")]
     assert final, "no finish event in the stream"
     t = final[-1]["timings"]
-    assert set(t) == {"queue_wait_ms", "ttft_ms", "e2e_ms", "decode_tokens"}
+    # `replica` rides along since ISSUE 15: every response is
+    # attributable end to end through the router
+    assert set(t) == {"queue_wait_ms", "ttft_ms", "e2e_ms",
+                      "decode_tokens", "replica"}
     assert t["decode_tokens"] >= 1
 
 

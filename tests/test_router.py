@@ -1,0 +1,494 @@
+"""Multi-replica router tests (ISSUE 15): affinity, least-loaded fallback,
+failover (mid-queue reroute, mid-stream clean error), drain redirection,
+all-saturated shedding — against controllable stub replicas for precise
+failure timing, plus one end-to-end test over two REAL engine replicas.
+"""
+
+import http.client
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dllama_tpu.obs import instruments as ins
+
+
+# --------------------------------------------------------------------------
+# stub replicas: the full surface the router consumes (/health, /v1/models,
+# completions stream + non-stream), with scripted failure modes
+# --------------------------------------------------------------------------
+
+class StubState:
+    def __init__(self, rid, model="stub-model", version="1.0"):
+        self.rid = rid
+        self.model = model
+        self.version = version
+        self.ready = True
+        self.draining = False
+        self.saturated = False      # completions answer 429 + Retry-After
+        self.abort_after = None     # stream: emit N events, then cut the socket
+        self.ntokens = 3
+        self.stream_delay = 0.0     # seconds between stream events
+        self.served = []            # parsed bodies, in arrival order
+        self.lock = threading.Lock()
+
+
+def make_stub(state: StubState):
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def _json(self, status, payload, headers=None):
+            data = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.send_header("X-Replica-Id", state.rid)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path.startswith("/health"):
+                self._json(200, {
+                    "live": True,
+                    "ready": state.ready and not state.draining,
+                    "draining": state.draining,
+                    "queue_depth": 0, "busy_slots": 0,
+                    "build": {"version": state.version},
+                })
+            elif self.path == "/v1/models":
+                self._json(200, {"object": "list",
+                                 "data": [{"id": state.model}]})
+            else:
+                self._json(404, {"error": {"message": "nope"}})
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            with state.lock:
+                state.served.append(body)
+            if state.saturated:
+                self._json(429, {"error": {"message": "queue full"}},
+                           {"Retry-After": "3"})
+                return
+            if body.get("stream"):
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.send_header("X-Replica-Id", state.rid)
+                self.end_headers()
+
+                def chunk(p: bytes):
+                    self.wfile.write(f"{len(p):x}\r\n".encode() + p + b"\r\n")
+                    self.wfile.flush()
+
+                for i in range(state.ntokens):
+                    if state.stream_delay:
+                        time.sleep(state.stream_delay)
+                    if state.abort_after is not None \
+                            and i >= state.abort_after:
+                        # mid-stream death: cut the connection, no [DONE].
+                        # shutdown() (not close()) — rfile/wfile still hold
+                        # fd refs, so close() alone would defer the FIN
+                        self.connection.shutdown(socket.SHUT_RDWR)
+                        return
+                    chunk(b'data: {"choices": [{"index": 0, "delta": '
+                          b'{"content": "t"}, "finish_reason": null}]}\n\n')
+                chunk(b'data: {"choices": [{"index": 0, "delta": {}, '
+                      b'"finish_reason": "stop"}]}\n\n')
+                chunk(b"data: [DONE]\n\n")
+                chunk(b"")
+            else:
+                self._json(200, {
+                    "object": "chat.completion", "model": state.model,
+                    "choices": [{"index": 0, "message":
+                                 {"role": "assistant", "content": "ok"},
+                                 "finish_reason": "stop"}],
+                    "usage": {"prompt_tokens": 1, "completion_tokens": 1,
+                              "total_tokens": 2},
+                    "timings": {"replica": state.rid},
+                })
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+@pytest.fixture
+def mesh():
+    """Two stub replicas + a started router (poller effectively inert:
+    poll_s=30 — tests drive _poll_one directly when they need a refresh)."""
+    from dllama_tpu.serve.router import make_router
+
+    a, b = StubState("stub-a"), StubState("stub-b")
+    ha, hb = make_stub(a), make_stub(b)
+    server, router = make_router(
+        [f"127.0.0.1:{ha.server_address[1]}",
+         f"127.0.0.1:{hb.server_address[1]}"],
+        poll_s=30.0)
+    router.start()
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    port = server.server_address[1]
+    yield port, router, (a, b), (ha, hb)
+    router.stop()
+    server.shutdown()
+    server.server_close()
+    for h in (ha, hb):
+        try:
+            h.shutdown()
+            h.server_close()
+        except OSError:
+            pass
+
+
+def rpost(port, path, body, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", path, json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, data, headers
+
+
+def rget(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+SHARED = [{"role": "system", "content":
+           "You are a helpful assistant with a long shared preamble."},
+          {"role": "user", "content": "hi"}]
+
+
+def test_handshake_and_health(mesh):
+    port, router, (a, b), _ = mesh
+    st, data = rget(port, "/health")
+    assert st == 200
+    h = json.loads(data)
+    assert h["mode"] == "router" and h["ready"]
+    assert len(h["replicas"]) == 2
+    assert all(r["ready"] and r["config_ok"] for r in h["replicas"])
+    assert h["mesh"]["model"] == "stub-model"
+    st, data = rget(port, "/v1/models")
+    assert st == 200
+    assert json.loads(data)["data"][0]["id"] == "stub-model"
+    st, data = rget(port, "/router/replicas")
+    assert st == 200 and len(json.loads(data)["replicas"]) == 2
+
+
+def test_affinity_pins_shared_prefix(mesh):
+    port, router, (a, b), _ = mesh
+    hits0 = ins.ROUTER_AFFINITY_HITS.value()
+    for i in range(4):
+        msgs = [SHARED[0], {"role": "user", "content": f"turn {i}"}]
+        st, data, headers = rpost(port, "/v1/chat/completions",
+                                  {"messages": msgs, "max_tokens": 4})
+        assert st == 200
+        assert headers.get("X-Replica-Id") in ("stub-a", "stub-b")
+    served = (len(a.served), len(b.served))
+    # every request shares the system prompt -> one replica got ALL of them
+    assert sorted(served) == [0, 4], served
+    assert ins.ROUTER_AFFINITY_HITS.value() - hits0 >= 3
+
+
+def test_least_loaded_spreads_distinct_prefixes(mesh):
+    port, router, (a, b), _ = mesh
+    for i in range(6):
+        msgs = [{"role": "system", "content": f"totally distinct prefix {i}"},
+                {"role": "user", "content": "hi"}]
+        st, _, _ = rpost(port, "/v1/chat/completions",
+                         {"messages": msgs, "max_tokens": 4})
+        assert st == 200
+    # distinct fingerprints have no warm pin: load-based pick with LRU
+    # tie-break must use BOTH replicas
+    assert len(a.served) >= 1 and len(b.served) >= 1
+
+
+def test_replica_kill_mid_queue_reroutes_zero_lost(mesh):
+    port, router, (a, b), (ha, hb) = mesh
+    # pin the shared prefix to whichever replica answers first
+    st, _, h1 = rpost(port, "/v1/chat/completions",
+                      {"messages": SHARED, "max_tokens": 4})
+    assert st == 200
+    pinned = h1["X-Replica-Id"]
+    victim, survivor = ((a, ha), (b, hb)) if pinned == "stub-a" \
+        else ((b, hb), (a, ha))
+    # kill the pinned replica outright: connections now refused
+    victim[1].shutdown()
+    victim[1].server_close()
+    # every queued/new request still completes — rerouted, zero lost
+    for i in range(3):
+        st, data, h2 = rpost(port, "/v1/chat/completions",
+                             {"messages": SHARED, "max_tokens": 4})
+        assert st == 200, data
+        assert h2["X-Replica-Id"] == survivor[0].rid
+    # the failed attempt was counted and the replica marked down (registry
+    # ids are host:port — map the victim stub through its server port)
+    victim_reg = f"127.0.0.1:{victim[1].server_address[1]}"
+    st, data = rget(port, "/router/replicas")
+    reps = {r["id"]: r for r in json.loads(data)["replicas"]}
+    assert reps[victim_reg]["ready"] is False
+    assert ins.REPLICA_HEALTHY.labels(replica=victim_reg).value() == 0.0
+
+
+def test_replica_death_mid_stream_fails_exactly_once(mesh):
+    port, router, (a, b), _ = mesh
+    # pin, then script the pinned stub to die after 2 stream events
+    st, _, h1 = rpost(port, "/v1/chat/completions",
+                      {"messages": SHARED, "max_tokens": 4})
+    pinned = a if h1["X-Replica-Id"] == "stub-a" else b
+    pinned.abort_after = 2
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("POST", "/v1/chat/completions",
+                 json.dumps({"messages": SHARED, "stream": True,
+                             "max_tokens": 8}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200  # stream started before the death
+    raw = resp.read().decode()
+    conn.close()
+    events = [line[6:] for line in raw.splitlines()
+              if line.startswith("data: ")]
+    assert events[-1] == "[DONE]"  # the stream ENDED cleanly
+    finishes = [json.loads(e)["choices"][0].get("finish_reason")
+                for e in events[:-1] if "choices" in e]
+    # exactly one terminal finish, and it is "error"
+    assert [f for f in finishes if f] == ["error"]
+    # in-band error event carries the request id
+    errs = [json.loads(e) for e in events[:-1] if "error" in e]
+    assert errs and errs[-1]["error"].get("request_id")
+
+
+def test_drain_redirects_new_traffic(mesh):
+    port, router, (a, b), _ = mesh
+    st, _, h1 = rpost(port, "/v1/chat/completions",
+                      {"messages": SHARED, "max_tokens": 4})
+    pinned, other = (a, b) if h1["X-Replica-Id"] == "stub-a" else (b, a)
+    served_before = len(other.served)
+    # drain the pinned replica and refresh the router's view synchronously
+    pinned.draining = True
+    for rep in router.replicas:
+        router._poll_one(rep)
+    for i in range(2):
+        st, _, h2 = rpost(port, "/v1/chat/completions",
+                          {"messages": SHARED, "max_tokens": 4})
+        assert st == 200
+        assert h2["X-Replica-Id"] == other.rid  # redirected while draining
+    assert len(other.served) == served_before + 2
+
+
+def test_all_saturated_sheds_with_retry_after(mesh):
+    port, router, (a, b), _ = mesh
+    a.saturated = b.saturated = True
+    st, data, headers = rpost(port, "/v1/chat/completions",
+                              {"messages": SHARED, "max_tokens": 4})
+    assert st == 429
+    assert int(headers.get("Retry-After", 0)) >= 3  # upstream's hint honored
+    assert b"saturated" in data
+
+
+def test_router_drain_sheds_503(mesh):
+    port, router, _, _ = mesh
+    router.drain()
+    st, data, headers = rpost(port, "/v1/chat/completions",
+                              {"messages": SHARED, "max_tokens": 4})
+    assert st == 503 and headers.get("Retry-After")
+    st, _ = rget(port, "/health/ready")
+    assert st == 503
+
+
+def test_stream_passthrough_forwards_tokens_incrementally(mesh):
+    """The router must forward SSE frames as they arrive, not buffer the
+    stream: http.client's read(n) on a chunked response blocks until n
+    bytes or EOF, which would hold every token delta (and heartbeat)
+    hostage until the stream ended — the read1 regression this pins."""
+    port, router, (a, b), _ = mesh
+    for stub in (a, b):
+        stub.ntokens = 20
+        stub.stream_delay = 0.1  # ~2s stream end to end
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("POST", "/v1/chat/completions",
+                 json.dumps({"messages": SHARED, "stream": True,
+                             "max_tokens": 30}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    t0 = time.monotonic()
+    first = resp.read1(4096)
+    t_first = time.monotonic() - t0
+    rest = resp.read()
+    conn.close()
+    assert first.startswith(b"data: ")
+    assert t_first < 1.0, f"first frame buffered for {t_first:.2f}s"
+    assert b"[DONE]" in (first + rest)
+
+
+def test_health_answers_while_streams_saturate_workers():
+    """Control-plane GETs ride the aio front-end's dedicated pool: /health
+    and /metrics must answer even when EVERY request worker is parked on a
+    long-lived proxied stream — an LB probe queued behind them would flag
+    a healthy router dead and restart it, killing the streams."""
+    from dllama_tpu.serve.router import make_router
+
+    a = StubState("stub-a")
+    a.ntokens = 100
+    a.stream_delay = 0.05  # ~5s per stream
+    ha = make_stub(a)
+    server, router = make_router([f"127.0.0.1:{ha.server_address[1]}"],
+                                 poll_s=30.0, workers=2)
+    try:
+        router.start()
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        port = server.server_address[1]
+
+        def stream():
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+            conn.request("POST", "/v1/chat/completions",
+                         json.dumps({"messages": SHARED, "stream": True,
+                                     "max_tokens": 50}),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            conn.close()
+
+        streams = [threading.Thread(target=stream, daemon=True)
+                   for _ in range(2)]
+        for t in streams:
+            t.start()
+        time.sleep(0.5)  # both workers now own a live stream
+        t0 = time.monotonic()
+        st, _ = rget(port, "/health/ready")
+        assert st == 200
+        assert time.monotonic() - t0 < 2.0, "probe starved behind streams"
+        st, _ = rget(port, "/metrics")
+        assert st == 200
+    finally:
+        router.stop()
+        server.shutdown()
+        server.server_close()
+        ha.shutdown()
+        ha.server_close()
+
+
+def test_config_handshake_quarantines_mismatch():
+    """A replica serving a different (model, version) than the mesh must
+    never be routed to — the root/worker handshake verdict."""
+    from dllama_tpu.serve.router import make_router
+
+    a = StubState("stub-a")
+    c = StubState("stub-c", model="other-model", version="9.9")
+    ha, hc = make_stub(a), make_stub(c)
+    server, router = make_router(
+        [f"127.0.0.1:{ha.server_address[1]}",
+         f"127.0.0.1:{hc.server_address[1]}"], poll_s=30.0)
+    try:
+        router.start()
+        bad = router.replicas[1]
+        assert bad.config_ok is False
+        assert router.mesh_model == "stub-model"
+        rep, _ = router.pick(None, exclude=set())
+        assert rep is router.replicas[0]  # quarantined never picked
+        router.release(rep)
+    finally:
+        router.stop()
+        server.server_close()
+        for h in (ha, hc):
+            h.shutdown()
+            h.server_close()
+
+
+# --------------------------------------------------------------------------
+# end-to-end: two REAL engine replicas behind the router
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def real_mesh(tmp_path_factory):
+    from dllama_tpu.engine.loader import load_model
+    from dllama_tpu.serve.api import make_server
+    from dllama_tpu.serve.router import make_router
+    from tests.test_serve import make_tiny_files
+
+    tmp = tmp_path_factory.mktemp("router_real")
+    mpath, tpath, _cfg = make_tiny_files(tmp)
+    servers = []
+    for i in range(2):
+        loaded = load_model(mpath, tpath, mesh=None)
+        httpd, api = make_server(loaded, host="127.0.0.1", port=0,
+                                 n_slots=2, kv_layout="paged", page_size=8)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        servers.append((httpd, api))
+    rserver, router = make_router(
+        [f"127.0.0.1:{h.server_address[1]}" for h, _ in servers],
+        poll_s=30.0)
+    router.start()
+    threading.Thread(target=rserver.serve_forever, daemon=True).start()
+    yield rserver.server_address[1], router, servers
+    router.stop()
+    rserver.shutdown()
+    rserver.server_close()
+    for httpd, api in servers:
+        try:
+            if api.scheduler is not None:
+                api.scheduler.shutdown()
+            httpd.shutdown()
+            httpd.server_close()
+        except OSError:
+            pass
+
+
+def test_real_mesh_affinity_and_failover(real_mesh):
+    port, router, servers = real_mesh
+    # (1) shared system prompt pins every request to ONE warm replica
+    ids = set()
+    for i in range(3):
+        msgs = [{"role": "system", "content":
+                 "Shared preamble for the warm-path routing test."},
+                {"role": "user", "content": f"q{i}"}]
+        st, data, headers = rpost(port, "/v1/chat/completions",
+                                  {"messages": msgs, "max_tokens": 4,
+                                   "temperature": 0.0})
+        assert st == 200, data
+        body = json.loads(data)
+        assert body["choices"][0]["finish_reason"] in ("stop", "length")
+        assert headers.get("X-Replica-Id") == body["timings"]["replica"]
+        ids.add(headers["X-Replica-Id"])
+    assert len(ids) == 1, f"affinity scattered the shared prefix: {ids}"
+    warm_rid = ids.pop()
+    # (2) kill the warm replica: same-prefix traffic fails over, zero lost
+    victim = next((h, a) for h, a in servers
+                  if f"127.0.0.1:{h.server_address[1]}" == warm_rid
+                  or a.replica_id == warm_rid)
+    victim[0].shutdown()
+    victim[0].server_close()
+    st, data, headers = rpost(port, "/v1/chat/completions",
+                              {"messages": [
+                                  {"role": "system", "content":
+                                   "Shared preamble for the warm-path "
+                                   "routing test."},
+                                  {"role": "user", "content": "after"}],
+                               "max_tokens": 4, "temperature": 0.0})
+    assert st == 200, data
+    survivor_rid = headers["X-Replica-Id"]
+    assert survivor_rid != warm_rid
+    # (3) the survivor's paged-KV allocator stayed clean through it all
+    shost, sport = survivor_rid.split(":")
+    conn = http.client.HTTPConnection(shost, int(sport), timeout=10)
+    conn.request("GET", "/debug/kv")
+    resp = conn.getresponse()
+    kv = json.loads(resp.read())
+    conn.close()
+    assert resp.status == 200
+    assert kv["layout"] == "paged" and kv["audit"]["ok"] is True

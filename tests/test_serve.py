@@ -47,15 +47,20 @@ def make_tiny_files(tmp_path, seed=0):
     return mpath, tpath, cfg
 
 
-@pytest.fixture(scope="module")
-def server(tmp_path_factory):
+@pytest.fixture(scope="module", params=["aio", "threads"])
+def server(tmp_path_factory, request):
+    """The whole HTTP contract matrix runs against BOTH front-ends (ISSUE
+    15): the selectors event loop (`aio`, the default) and the
+    thread-per-connection baseline (`threads`) must serve identical
+    semantics."""
     from dllama_tpu.engine.loader import load_model
     from dllama_tpu.serve.api import make_server
 
     tmp_path = tmp_path_factory.mktemp("serve")
     mpath, tpath, cfg = make_tiny_files(tmp_path)
     loaded = load_model(mpath, tpath, mesh=None)
-    httpd, api = make_server(loaded, host="127.0.0.1", port=0)
+    httpd, api = make_server(loaded, host="127.0.0.1", port=0,
+                             frontend=request.param)
     thread = threading.Thread(target=httpd.serve_forever, daemon=True)
     thread.start()
     yield httpd.server_address[1], api
