@@ -58,7 +58,8 @@ BENCH_DEFS = ("bench_hybrid", "bench_compile", "bench_router")
 
 #: smoke scripts the gates cite (path, must-be-executable)
 GATED_SCRIPTS = ("scripts/hybrid_smoke.sh", "scripts/compile_smoke.sh",
-                 "scripts/analysis_smoke.sh", "scripts/router_smoke.sh")
+                 "scripts/analysis_smoke.sh", "scripts/router_smoke.sh",
+                 "scripts/failover_smoke.sh", "scripts/chaos_soak.sh")
 
 
 def _line_of(src, needle: str, default: int = 1) -> int:

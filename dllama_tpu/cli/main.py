@@ -108,6 +108,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "capacity as dense). Smaller pools overcommit "
                         "capacity: more slots than HBM could densely hold, "
                         "admission-gated by actual page demand")
+    p.add_argument("--kv-host-pages", type=int, default=0,
+                   help="paged KV cache + radix cache: host-RAM spill tier "
+                        "size in pages (0 = off). Radix LRU eviction swaps "
+                        "cold pages device-to-host instead of discarding; a "
+                        "returning prompt re-uploads them at admission and "
+                        "re-prefills only what the tiers can't cover. "
+                        "Transfers are billed (dllama_kv_spill_total, "
+                        "kv_spill/kv_restore transfer sites); occupancy at "
+                        "dllama_kv_host_pages_{total,used}")
     p.add_argument("--radix-cache", choices=["auto", "on", "off"],
                    default="auto",
                    help="serve mode, needs --slots > 0: cross-request radix "
@@ -176,6 +185,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="router mode: worker threads (each in-flight "
                         "proxied request occupies one for its upstream "
                         "I/O)")
+    p.add_argument("--failover-max", type=int, default=2,
+                   help="router mode: mid-stream failover budget — resume "
+                        "attempts per journaled stream when its replica "
+                        "dies mid-SSE (capped exponential backoff with "
+                        "jitter; 0 = fail the stream exactly once with "
+                        "finish_reason=error, the pre-failover contract)")
     p.add_argument("--slots", type=int, default=0,
                    help="serve mode: continuous-batching slots (0 = single-request + prefix cache)")
     p.add_argument("--overlap", choices=["on", "off"], default="on",
@@ -583,6 +598,7 @@ def cmd_serve(args) -> int:
         kv_layout=args.kv_layout,
         page_size=args.page_size,
         kv_pages=args.kv_pages,
+        kv_host_pages=args.kv_host_pages,
         radix_cache=args.radix_cache,
         prefill_budget=prefill_budget,
         preempt=args.preempt,
@@ -612,6 +628,7 @@ def cmd_router(args) -> int:
         affinity=args.affinity == "on",
         workers=args.router_workers,
         drain_timeout_s=args.drain_timeout_s,
+        failover_max=args.failover_max,
     )
 
 

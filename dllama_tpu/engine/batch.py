@@ -28,6 +28,7 @@ import logging
 import os
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass
 from functools import partial
 
@@ -122,6 +123,9 @@ class PagePool:
         # that range would leak draft garbage into a radix- or
         # sibling-shared prefix.
         self.write_horizons = None
+        # host-RAM spill tier (--kv-host-pages, ISSUE 16): audit() and
+        # stats() reconcile it alongside the device pages when attached
+        self.host: "HostKVPool | None" = None
         self._publish()
 
     # ----------------------------------------------------------- accounting
@@ -232,6 +236,12 @@ class PagePool:
                 problems.append(
                     f"leaked pages (refcount 0 but not on the free list): "
                     f"{sorted(orphan)[:8]}")
+            if self.host is not None:
+                # host-tier reconciliation: the spill tier's entries are
+                # audited with the same rigor as device pages — capacity
+                # respected, one entry per token path, page-aligned keys,
+                # payload geometry intact, gauges matching the recount
+                problems.extend(self.host.audit_problems())
             shared = int(np.count_nonzero(self.refcount > 1))
             # gauge consistency vs what THIS pool last published (the global
             # series itself may belong to another pool instance in
@@ -251,6 +261,8 @@ class PagePool:
                       "used": self.n_pages - len(self._free),
                       "shared": shared, "page_size": self.page_size,
                       "radix_pages": radix_pages}
+            if self.host is not None:
+                report["host"] = self.host.stats()
         if problems:
             ins.KV_AUDIT_FAILURES.inc()
             if raise_on_fail:
@@ -451,6 +463,132 @@ class PagePool:
         )
 
 
+class HostKVPool:
+    """Host-RAM KV spill tier behind :class:`PagePool` (``--kv-host-pages``,
+    ISSUE 16). A bounded LRU of page payloads keyed by the FULL token-id
+    prefix the page's rows encode: when radix LRU eviction (or preempt-to-
+    pages pressure routed through it) drops the last reference to a cold
+    page, the engine copies its KV rows d2h into this pool instead of
+    discarding them; a later admission whose prompt walks past the tree's
+    resident prefix pops matching pages back h2d (restore-on-hit), so a
+    multi-turn chat returning after eviction re-prefills only its partial
+    boundary page. Entries are numpy (host) copies — the reference's
+    root→worker framing where state that left the device is never the only
+    copy (nn-network.hpp's named-tensor ship), applied to the KV tier.
+
+    Keying by the token path (not the page id) is what makes the tier safe
+    across warm restarts of the DEVICE pool: page ids die with the pool, a
+    token prefix is meaningful forever — but a restart drops BOTH tiers
+    (warm_restart) because a half-poisoned chunk may have corrupted the
+    very rows a spill would preserve.
+
+    Shares the pool's reentrant lock: spills happen under radix eviction
+    (already inside the lock), restores under admission lookup, and
+    ``audit_problems()`` is re-entered by ``PagePool.audit()`` from HTTP
+    handler threads. Owns the dllama_kv_host_pages_{total,used} gauges."""
+
+    def __init__(self, n_pages: int, page_size: int, mu):
+        if n_pages < 1:
+            raise ValueError(f"kv_host_pages={n_pages}: the host tier "
+                             "needs at least one page slot")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self._mu = mu
+        # token-path key (tuple[int], len % page_size == 0, last page_size
+        # entries are the page's rows) -> (k_page, v_page) numpy payloads
+        self._entries: "OrderedDict[tuple, tuple]" = OrderedDict()
+        # cumulative accounting (stats/debug; chaos reconciles spill counts)
+        self.spilled = 0
+        self.restored = 0
+        self.dropped = 0  # LRU pressure evictions of the HOST tier itself
+        self._publish()
+
+    def _publish(self) -> None:
+        self._published_used = len(self._entries)
+        ins.KV_HOST_PAGES_TOTAL.set(self.n_pages)
+        ins.KV_HOST_PAGES_USED.set(self._published_used)
+
+    @property
+    def used(self) -> int:
+        with self._mu:
+            return len(self._entries)
+
+    def put(self, key: tuple, payload: tuple) -> None:
+        """Admit one spilled page; the coldest entry makes room when full
+        (the host tier is itself an LRU — losing ITS coldest page merely
+        restores the pre-tier discard behavior for that prefix)."""
+        with self._mu:
+            key = tuple(int(t) for t in key)
+            self._entries.pop(key, None)
+            while len(self._entries) >= self.n_pages:
+                self._entries.popitem(last=False)
+                self.dropped += 1
+            self._entries[key] = payload
+            self.spilled += 1
+            self._publish()
+
+    def peek(self, key: tuple) -> tuple | None:
+        """Payload for `key` without removing it (restore uploads first,
+        then commits the take — a failed device alloc must not lose the
+        host copy)."""
+        with self._mu:
+            return self._entries.get(tuple(int(t) for t in key))
+
+    def take(self, key: tuple) -> None:
+        """Commit a restore: the page is device-resident (tree-owned)
+        again, so the host copy retires — keeping both would double-count
+        the prefix and stale the host bytes once the page is COW'd."""
+        with self._mu:
+            if self._entries.pop(tuple(int(t) for t in key), None) is not None:
+                self.restored += 1
+                self._publish()
+
+    def clear(self) -> int:
+        with self._mu:
+            n = len(self._entries)
+            self._entries.clear()
+            self._publish()
+            return n
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"total": self.n_pages, "used": len(self._entries),
+                    "page_size": self.page_size, "spilled": self.spilled,
+                    "restored": self.restored, "dropped": self.dropped}
+
+    def audit_problems(self) -> list[str]:
+        """Invariant recount for ``PagePool.audit()``: capacity respected,
+        keys page-aligned, payload geometry intact (a corrupt payload would
+        restore garbage KV rows), published gauge matching the recount."""
+        with self._mu:
+            problems: list[str] = []
+            if len(self._entries) > self.n_pages:
+                problems.append(
+                    f"host tier holds {len(self._entries)} pages over its "
+                    f"{self.n_pages}-page capacity")
+            for key, payload in self._entries.items():
+                if not key or len(key) % self.page_size:
+                    problems.append(
+                        f"host tier key of {len(key)} tokens is not "
+                        f"page-aligned (page_size {self.page_size})")
+                    break
+            for key, payload in self._entries.items():
+                if (not isinstance(payload, tuple) or len(payload) != 2
+                        or any(getattr(b, "shape", None) is None
+                               or b.shape[-2] != self.page_size
+                               for b in payload)):
+                    problems.append(
+                        "host tier payload geometry corrupt (expected "
+                        f"(k, v) arrays of {self.page_size} rows)")
+                    break
+            if self._published_used != len(self._entries):
+                problems.append(
+                    f"dllama_kv_host_pages_used published as "
+                    f"{self._published_used} != recount "
+                    f"{len(self._entries)} (a mutation skipped _publish)")
+            return problems
+
+
 def _sample_rows(logits, keys, temps, topps):
     """Per-row sampling with per-row keys: [B, V] x [B, 2] -> [B]."""
     return jax.vmap(lambda lg, k, t, p: sample_logits(lg[None], k, t, p)[0])(
@@ -575,6 +713,12 @@ class BatchEngine:
         # auto = on whenever the layout is paged; the tree only acts through
         # the radix_* methods the serving scheduler drives, so direct add/
         # decode/release library use is unchanged either way.
+        kv_host_pages: int = 0,  # host-RAM KV spill tier (--kv-host-pages,
+        # ISSUE 16): page slots in the pinned host pool radix eviction
+        # spills cold pages into (d2h) instead of discarding them, restored
+        # h2d on an admission prefix hit. 0 = off; > 0 requires the paged
+        # layout with the radix cache on (the tree's token-path keys ARE
+        # the host tier's addressing).
         transfer_guard: str = "off",  # 'off' | 'log' | 'strict'
         # (--transfer-guard, ISSUE 13): steady-state decode/spec jit calls
         # run under jax.transfer_guard_host_to_device — their operands are
@@ -638,6 +782,16 @@ class BatchEngine:
             from dllama_tpu.engine.radix import RadixCache
 
             self.radix = RadixCache(self.pool)
+        self.kv_host_pages = int(kv_host_pages)
+        if self.kv_host_pages > 0:
+            if self.radix is None:
+                raise ValueError(
+                    "kv_host_pages > 0 requires the paged KV layout with "
+                    "the radix cache on (host-tier pages are keyed by the "
+                    "tree's token paths)")
+            self.pool.host = HostKVPool(self.kv_host_pages, self.page_size,
+                                        self.pool._mu)
+            self.radix.spill = self._host_spill
         if shardings is not None:
             if shardings.mesh.shape["sp"] > 1 or shardings.mesh.shape["pp"] > 1:
                 # per-slot vector positions don't fit the sp shard_map masks or
@@ -786,6 +940,11 @@ class BatchEngine:
         )
         self._copy_rows = jax.jit(self._copy_rows_impl, donate_argnums=(0,))
         self._copy_page = jax.jit(self._copy_page_impl, donate_argnums=(0,))
+        # host-tier restore upload: write one page's (k, v) host payload
+        # into a freshly allocated pool page (the h2d counterpart of the
+        # spill's d2h slice; boundary-attributed like the COW clone)
+        self._write_page = jax.jit(self._write_page_impl, donate_argnums=(0,))
+        self._read_page = jax.jit(self._read_page_impl)
 
         # batched speculative decoding (see spec_step): per-slot on-device
         # token history feeds the n-gram proposer; one verify forward per
@@ -922,6 +1081,30 @@ class BatchEngine:
             return jax.lax.dynamic_update_index_in_dim(buf, pg, dst, axis=1)
 
         return PagedKVCache(one(cache.k), one(cache.v), cache.tables)
+
+    @staticmethod
+    def _write_page_impl(cache, kpg, vpg, dst):
+        """Install a host-restored page payload into pool page `dst` across
+        all layers — the h2d counterpart of _copy_page_impl. Traced index:
+        one compile serves every destination page."""
+
+        def one(buf, pg):  # [L, P, H, page, hd] <- [L, H, page, hd]
+            return jax.lax.dynamic_update_index_in_dim(buf, pg, dst, axis=1)
+
+        return PagedKVCache(one(cache.k, kpg), one(cache.v, vpg),
+                            cache.tables)
+
+    @staticmethod
+    def _read_page_impl(cache, src):
+        """Slice one pool page's (k, v) rows across all layers for the d2h
+        spill copy. Traced index — a plain `cache.k[:, p]` would bake the
+        page id into the executable and compile once per distinct page."""
+
+        def one(buf):  # [L, P, H, page, hd] -> [L, H, page, hd]
+            return jax.lax.dynamic_index_in_dim(buf, src, axis=1,
+                                                keepdims=False)
+
+        return one(cache.k), one(cache.v)
 
     @staticmethod
     def _decode_impl(cfg, attn_fn, col_fn, mm, mm_in, moe_impl, params, cache, tokens,
@@ -1389,8 +1572,14 @@ class BatchEngine:
 
     def kv_page_stats(self) -> dict | None:
         """Pool occupancy snapshot for /health and latency_summary(); None
-        on the dense layout."""
-        return None if self.pool is None else self.pool.stats()
+        on the dense layout. Gains a "host" sub-dict when the spill tier
+        is on (GET /debug/kv surfaces it next to the device pages)."""
+        if self.pool is None:
+            return None
+        st = self.pool.stats()
+        if self.pool.host is not None:
+            st["host"] = self.pool.host.stats()
+        return st
 
     # ------------------------------------------------------ radix prefix api
     # (engine/radix.RadixCache over the page pool; the serving scheduler is
@@ -1398,10 +1587,20 @@ class BatchEngine:
 
     def radix_lookup(self, toks) -> tuple[int, object | None]:
         """(reusable_rows, hit-handle) for `toks` against the global radix
-        tree; (0, None) when the cache is off."""
+        tree; (0, None) when the cache is off. With the host tier on, a
+        walk that ends short of the prompt first tries to graft spilled
+        pages back (restore-on-hit, h2d), then re-walks — so an evicted
+        multi-turn prefix costs O(partial boundary page), not a full
+        re-prefill."""
         if self.radix is None:
             return 0, None
         hit = self.radix.lookup(toks)
+        host = None if self.pool is None else self.pool.host
+        if host is not None and host.used and hit.rows < len(toks) - 1:
+            if self.radix.restore_prefix(toks, host.peek,
+                                         self._host_restore_install,
+                                         host.take):
+                hit = self.radix.lookup(toks, count=False)
         return hit.rows, hit
 
     def radix_map(self, slot: int, hit) -> None:
@@ -1462,6 +1661,57 @@ class BatchEngine:
     def radix_stats(self) -> dict | None:
         """Tree occupancy + cumulative hit accounting; None when off."""
         return None if self.radix is None else self.radix.stats()
+
+    # --------------------------------------------------- host KV spill tier
+
+    def _host_spill(self, key: tuple, page: int) -> bool:
+        """RadixCache.spill hook, called under the pool lock right before an
+        evicted leaf's last-reference page is dropped: copy the page's KV
+        rows d2h into the host tier, keyed by the full token path. Returns
+        True when captured. Any failure — an armed ``pool.spill`` fault or
+        a real copy error — degrades to the old discard, which is always
+        correct: the prefix just re-prefills when it returns."""
+        host = self.pool.host
+        if host is None:
+            return False
+        try:
+            faults.fire("pool.spill")
+            with compile_obs.LEDGER.scope("boundary", "page_spill"):
+                kpg_d, vpg_d = self._read_page(self.cache, jnp.int32(page))
+            kpg, vpg = np.asarray(kpg_d), np.asarray(vpg_d)
+        except faults.InjectedFault:
+            return False
+        compile_obs.note_transfer("d2h", "kv_spill",
+                                  int(kpg.nbytes + vpg.nbytes))
+        ins.KV_SPILL.labels(direction="out").inc()
+        host.put(key, (kpg, vpg))
+        return True
+
+    def _host_restore_install(self, payload) -> int | None:
+        """restore_prefix's device-install callback: allocate a pool page
+        and upload the host payload's (k, v) rows into it. Returns the page
+        index the tree should graft, or None when the pool has no free page
+        or an armed ``pool.restore`` fault fires — the caller stops
+        grafting and the remaining suffix re-prefills as before. The host
+        copy is untouched here (peek→install→take ordering: a failed
+        install must not lose the only copy)."""
+        pool = self.pool
+        try:
+            faults.fire("pool.restore")
+            with pool._mu:
+                if not pool._free:
+                    return None
+                page = pool._alloc_page()
+        except faults.InjectedFault:
+            return None
+        kpg, vpg = payload
+        with compile_obs.LEDGER.scope("boundary", "page_restore"):
+            self.cache = self._write_page(self.cache, jnp.asarray(kpg),
+                                          jnp.asarray(vpg), jnp.int32(page))
+        compile_obs.note_transfer("h2d", "kv_restore",
+                                  int(kpg.nbytes + vpg.nbytes))
+        ins.KV_SPILL.labels(direction="in").inc()
+        return page
 
     def chunk_cost_model(self):
         """Frozen obs/perf.ChunkCostModel pricing THIS engine's decode
@@ -1795,6 +2045,14 @@ class BatchEngine:
                 from dllama_tpu.engine.radix import RadixCache
 
                 self.radix = RadixCache(self.pool, carry_from=self.radix)
+            if self.kv_host_pages > 0:
+                # both tiers die together: a half-poisoned chunk may have
+                # corrupted the very rows a spill preserved, and restoring
+                # pre-crash bytes into a rebuilt pool would smuggle the
+                # corruption past the restart
+                self.pool.host = HostKVPool(self.kv_host_pages,
+                                            self.page_size, self.pool._mu)
+                self.radix.spill = self._host_spill
         else:
             self.cache = KVCache.create(self.cfg, self.n_slots,
                                         self.cache_dtype, self.seq_len)

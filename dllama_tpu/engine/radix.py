@@ -132,6 +132,11 @@ class RadixCache:
             self.hit_tokens = carry_from.hit_tokens
             self.inserted_pages = carry_from.inserted_pages
             self.evicted_pages = carry_from.evicted_pages
+        # host-tier spill hook (BatchEngine._host_spill when --kv-host-pages
+        # is on): called under the pool lock with (token_path_key, page_id)
+        # for each last-reference page right before eviction drops it; a
+        # False/failed spill degrades to the plain discard
+        self.spill = None
         pool.radix_refs = self.audit_refs  # audit reconciliation hook
         self._publish()
 
@@ -163,6 +168,20 @@ class RadixCache:
         self.n_nodes += 1
         return prefix
 
+    def _abs_tokens(self, node: RadixNode) -> tuple:
+        """The absolute token path from the root through ``node`` (its own
+        edge included) — the host-tier key space. O(depth) parent-chain
+        walk; only taken on the eviction path when a spill hook is wired."""
+        parts = []
+        n = node
+        while n is not None and n.parent is not None:
+            parts.append(n.tokens)
+            n = n.parent
+        out: list[int] = []
+        for t in reversed(parts):
+            out.extend(t)
+        return tuple(out)
+
     def _drop(self, node: RadixNode) -> int:
         """Remove a leaf; decref its pages. Returns pages actually freed."""
         before = self.pool.free_count
@@ -176,16 +195,19 @@ class RadixCache:
 
     # ------------------------------------------------------------------ api
 
-    def lookup(self, toks) -> RadixHit:
+    def lookup(self, toks, count: bool = True) -> RadixHit:
         """Longest mappable prefix of ``toks``, capped at ``len(toks) - 1``
         (at least one token must prefill to produce logits — the same rule
-        the per-slot LCP scan enforced)."""
+        the per-slot LCP scan enforced). ``count=False`` skips the lookup /
+        hit accounting — the post-restore re-walk is the same logical
+        lookup, not a second one."""
         page = self.page
         toks = [int(t) for t in toks]
         cap = len(toks) - 1
         now = time.monotonic()
         with self._mu:
-            self.lookups += 1
+            if count:
+                self.lookups += 1
             node, depth = self.root, 0
             pages: list[int] = []
             path = [self.root]
@@ -233,9 +255,11 @@ class RadixCache:
                     best.last_used = now
                     path.append(best)
             rows = depth + part
-            if rows > 0:
+            if rows > 0 and count:
                 self.hits += 1
-        ins.RADIX_LOOKUPS.labels(outcome="hit" if rows > 0 else "miss").inc()
+        if count:
+            ins.RADIX_LOOKUPS.labels(
+                outcome="hit" if rows > 0 else "miss").inc()
         return RadixHit(rows=rows, pages=pages, part=part, boundary=boundary,
                         path=tuple(path), tokens=toks[:rows])
 
@@ -329,6 +353,18 @@ class RadixCache:
                     # skipping is final for this call)
                     continue
                 parent = victim.parent
+                if self.spill is not None:
+                    # host-tier capture BEFORE the drop, while the pages
+                    # are still allocated and their KV rows intact. Only
+                    # last-reference pages spill: a shared page lives on in
+                    # some slot's block table and re-enters the tree at
+                    # that slot's release. Keys are absolute token paths —
+                    # page i's rows encode the prefix through its last row.
+                    full = self._abs_tokens(victim)
+                    start = len(full) - len(victim.tokens)
+                    for i, p in enumerate(victim.pages):
+                        if self.pool.refcount[p] == 1:
+                            self.spill(full[: start + (i + 1) * self.page], p)
                 freed += self._drop(victim)
                 if (parent is not self.root and not parent.children
                         and id(parent) not in prot_ids):
@@ -341,6 +377,70 @@ class RadixCache:
                 self.pool._publish()
                 self._publish()
         return freed
+
+    def restore_prefix(self, toks, peek, install, take) -> int:
+        """Graft host-tier pages for ``toks`` back into the tree
+        (restore-on-hit, the inverse of the eviction spill). Walks like
+        :meth:`insert`; wherever the resident tree runs out but the host
+        tier holds the next full page of the prompt (``peek`` by absolute
+        token path), ``install`` uploads it into a fresh pool page, a
+        single-page node adopts that page (the tree owns its one
+        reference — ``_alloc_page`` set it), and ``take`` retires the host
+        copy. Stops at the first miss or failed install (peek→install→take:
+        a failed device alloc never loses the only copy). Returns pages
+        grafted; the caller re-walks with ``lookup(count=False)``."""
+        page = self.page
+        toks = [int(t) for t in toks]
+        # a grafted page only helps if lookup can map it whole, and lookup
+        # caps matched rows at len(toks) - 1
+        limit = ((len(toks) - 1) // page) * page
+        if limit <= 0:
+            return 0
+        grafted = 0
+        now = time.monotonic()
+        with self._mu:
+            node, depth = self.root, 0
+            while depth < limit:
+                child = node.children.get(tuple(toks[depth:depth + page]))
+                if child is not None:
+                    k = 0
+                    while (k < len(child.pages)
+                           and depth + (k + 1) * page <= limit
+                           and tuple(child.tokens[k * page:(k + 1) * page])
+                           == tuple(toks[depth + k * page:
+                                         depth + (k + 1) * page])):
+                        k += 1
+                    depth += k * page
+                    if k < len(child.pages):
+                        if depth + page > limit:
+                            break
+                        # diverged mid-edge with restorable room left:
+                        # split at the page boundary (k >= 1 — the dict
+                        # key IS page 0) so a restored sibling can graft
+                        node = self._split(node, child, k)
+                        continue
+                    node = child
+                    continue
+                key = tuple(toks[:depth + page])
+                payload = peek(key)
+                if payload is None:
+                    break
+                pg = install(payload)
+                if pg is None:
+                    break
+                new = RadixNode(tuple(toks[depth:depth + page]), [pg], node)
+                new.last_used = now
+                node.children[new.tokens[:page]] = new
+                self.n_nodes += 1
+                self.n_pages += 1
+                take(key)
+                grafted += 1
+                node = new
+                depth += page
+            if grafted:
+                self.pool._publish()
+                self._publish()
+        return grafted
 
     def clear(self) -> int:
         """Drop the whole tree (drain/diagnostics; a warm restart instead

@@ -109,7 +109,8 @@ COMPILE_FNS = {
 #: transfer-accounting site labels (bounded cardinality; the README
 #: transfer table documents each)
 TRANSFER_SITES = ("vectors", "prefill", "history", "commit",
-                  "decode_tokens", "spec_counts", "nan_guard")
+                  "decode_tokens", "spec_counts", "nan_guard",
+                  "kv_spill", "kv_restore")
 
 
 def sig_of(*args, max_leaves: int = 12) -> str:

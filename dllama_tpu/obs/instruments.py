@@ -151,6 +151,16 @@ FRONTEND_CONNECTIONS = metrics.gauge(
     "dllama_process_threads; the threads front-end does not move this "
     "gauge",
     ("server",))
+ROUTER_FAILOVERS = metrics.counter(
+    "dllama_router_failovers_total",
+    "Mid-stream cross-replica failovers, by outcome (resumed = the stream "
+    "was resubmitted to a survivor and finished from its journal position; "
+    "retried = one resume attempt was dispatched, whatever came of it; "
+    "exhausted = the per-stream --failover-max budget ran out and the "
+    "stream failed with today's exactly-once error; unresumable = no "
+    "journal entry / terminal frame already relayed / journal ring full — "
+    "same exactly-once error contract)",
+    ("outcome",))
 
 # ----------------------------------------------------------------- gauges
 
@@ -182,6 +192,21 @@ KV_PAGES_SHARED = metrics.gauge(
     "dllama_kv_pages_shared",
     "Paged KV cache: pages with more than one referent — several slots, "
     "or a slot plus the radix prefix tree (copy-on-write prefix sharing)")
+KV_HOST_PAGES_TOTAL = metrics.gauge(
+    "dllama_kv_host_pages_total",
+    "Host-RAM KV spill tier (--kv-host-pages): page slots in the pinned "
+    "host buffer pool (0 = tier off; radix eviction discards cold pages)")
+KV_HOST_PAGES_USED = metrics.gauge(
+    "dllama_kv_host_pages_used",
+    "Host-RAM KV spill tier: spilled pages currently resident on the "
+    "host — restore-on-hit pops them back to the device at admission, "
+    "LRU pressure drops the coldest")
+KV_SPILL = metrics.counter(
+    "dllama_kv_spill_total",
+    "Host-tier page movements by direction (out = device page spilled "
+    "d2h at a radix eviction instead of being discarded; in = host page "
+    "restored h2d into the radix tree at an admission lookup)",
+    ("direction",))
 
 # ------------------------------------------------------------- histograms
 

@@ -122,33 +122,58 @@ class _SseMachine:
         self.model = body.get("model", api.model_name)
         p = api.prepare_request(body, legacy=legacy)
         self.asm = api_mod.TokenAssembler(api.tokenizer, p["stops"])
-        self.req = api.batched_submit(p, req_id=self.rid or "")
-        self.cid = (f"{'cmpl' if legacy else 'chatcmpl'}-"
-                    f"{uuid.uuid4().hex[:16]}")
-        self.created = int(time.time())
+        # failover resume (ISSUE 16): replay the journaled prefix through
+        # the fresh assembler (no emission — those deltas already reached
+        # the client) so detector/decoder state and the position counter
+        # continue exactly where the dead upstream stopped; keep its
+        # stream identity. Mirrors the blocking tier's _run_batched seam.
+        self.want_ids = bool(p.get("token_ids"))
+        resume = p.get("resume_tokens")
+        self.resumed_done = False
+        if resume:
+            for t in resume:
+                self.asm.feed(t)
+                if self.asm.eos:
+                    break
+            self.asm.take_ids()
+        if resume and self.asm.eos:
+            # the journaled tokens already complete a stop sequence: the
+            # stream is over — no engine submit at all, just the finish
+            # frame (pump() terminates on resumed_done)
+            self.req = None
+            self.resumed_done = True
+        else:
+            self.req = api.batched_submit(p, req_id=self.rid or "")
+        self.cid = ((p.get("resume_id") or None) if resume else None) or (
+            f"{'cmpl' if legacy else 'chatcmpl'}-{uuid.uuid4().hex[:16]}")
+        self.created = int((p.get("resume_created") or 0) if resume else 0
+                           ) or int(time.time())
         self.hb = api.sse_heartbeat_s
         self.done = False
         ctx._start_sse()
-        if not legacy:
+        if not legacy and not resume:
+            # a resumed stream's client already got the role delta
             self._emit({"role": "assistant"})
         self.last_write = time.monotonic()
 
     # ------------------------------------------------------------- emission
 
-    def _emit(self, delta_or_text, finish=None, timings=None) -> None:
+    def _emit(self, delta_or_text, finish=None, timings=None,
+              ids=None) -> None:
         if self.legacy:
             payload = api_mod.sse_text_payload(
                 self.cid, self.created, self.model, delta_or_text,
-                finish=finish, timings=timings)
+                finish=finish, timings=timings, ids=ids)
         else:
             payload = api_mod.sse_chat_payload(
                 self.cid, self.created, self.model, delta_or_text,
-                finish=finish, timings=timings)
+                finish=finish, timings=timings, ids=ids)
         self.ctx._write_chunk(payload)
         self.last_write = time.monotonic()
 
     def _emit_text(self, text: str) -> None:
-        self._emit(text if self.legacy else {"content": text})
+        self._emit(text if self.legacy else {"content": text},
+                   ids=self.asm.take_ids() if self.want_ids else None)
 
     def _terminate(self) -> None:
         self.ctx._write_chunk(b"data: [DONE]\n\n")
@@ -173,8 +198,19 @@ class _SseMachine:
             # IS the probe (ISSUE 15 satellite)
             log.info("client disconnected; request %s cancelled", self.rid,
                      extra={"request_id": self.rid})
-            self.api.scheduler.cancel(self.req, reason="cancelled")
+            if self.req is not None:
+                self.api.scheduler.cancel(self.req, reason="cancelled")
             self._complete()
+            return True
+        if self.resumed_done:
+            # resume whose journaled tokens already completed the stream:
+            # nothing was submitted — emit the finish frame and close
+            timings: dict = {"e2e_ms": 0.0, "decode_tokens": 0}
+            if self.api.replica_id:
+                timings["replica"] = self.api.replica_id
+            self._emit("" if self.legacy else {},
+                       finish="stop", timings=timings)
+            self._terminate()
             return True
         try:
             toks, ended = self.req.poll_tokens()

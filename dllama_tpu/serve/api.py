@@ -182,7 +182,8 @@ class TokenAssembler:
     aio front-end's cooperative SSE pump (serve/aio.py) process tokens
     identically (byte-identical text deltas either way)."""
 
-    __slots__ = ("detector", "decoder", "parts", "n", "eos")
+    __slots__ = ("detector", "decoder", "parts", "n", "eos", "pending_ids",
+                 "taken")
 
     def __init__(self, tokenizer, stops):
         self.detector = EosDetector(tokenizer.eos_ids, stops,
@@ -191,12 +192,18 @@ class TokenAssembler:
         self.parts: list[str] = []
         self.n = 0
         self.eos = False
+        # token-id journal feed (ISSUE 16): raw ids fed since the last
+        # take_ids(), and the count already taken — the (position, ids)
+        # pairs SSE frames carry so the router can journal resume state
+        self.pending_ids: list[int] = []
+        self.taken = 0
 
     def feed(self, t) -> str:
         """Process one token -> the text delta to emit now ("" while the
         detector holds a possible stop prefix). Sets ``eos`` when the
         token completed an EOS/stop sequence."""
         self.n += 1
+        self.pending_ids.append(int(t))
         res = self.detector.append(t, self.decoder.decode(t))
         text = self.detector.get_delta()
         if text:
@@ -204,6 +211,18 @@ class TokenAssembler:
         if res == EosResult.EOS:
             self.eos = True
         return text
+
+    def take_ids(self) -> tuple[int, list[int]]:
+        """Drain the pending raw ids for the frame about to go out:
+        ``(position, ids)`` where ``position`` counts the ids taken by all
+        PRIOR frames — a journaling router appends exactly when position
+        matches its journal length, which makes duplicate frames after a
+        failover self-suppressing. Ids held with a stop-prefix ride the
+        NEXT emitted frame (frames and the text they carry stay atomic)."""
+        pos, ids = self.taken, self.pending_ids
+        self.taken += len(ids)
+        self.pending_ids = []
+        return pos, ids
 
     def flush(self) -> str:
         """End of stream without EOS (budget/timeout): release any held
@@ -379,6 +398,8 @@ class ApiServer:
                 },
             }
 
+        if body.get("resume") is not None:
+            raise ApiError(400, "resume requires the batched scheduler tier")
         messages = [(m["role"], str(m["content"])) for m in body.get("messages", [])]
         if not messages:
             raise ApiError(400, "messages must be a non-empty array")
@@ -659,11 +680,42 @@ class ApiServer:
             stops = self.stops + list(extra_stops)
             max_tokens = int(body.get("max_tokens")
                              or body.get("max_completion_tokens") or 0)
+        # mid-stream failover support (ISSUE 16): `include_token_ids` makes
+        # every SSE frame carry the raw (position, token_ids) it consumed
+        # (the router injects it so it can journal resume state); `resume`
+        # re-enters a journaled stream on THIS replica — the emitted prefix
+        # re-prefills via the radix/resume_commit path and the PRNG chain is
+        # replayed from the request seed, so the continuation is bit-exact
+        resume = body.get("resume")
+        resume_tokens = resume_id = resume_created = None
+        if resume is not None:
+            if not isinstance(resume, dict):
+                raise ApiError(400, "resume must be an object")
+            # EMPTY tokens is legal: a stream that died after its role
+            # delta but before any token resumes with tokens=[] purely to
+            # keep its id/created and suppress the duplicate role delta
+            toks = resume.get("tokens")
+            if (not isinstance(toks, list)
+                    or not all(isinstance(t, int) for t in toks)):
+                raise ApiError(400, "resume.tokens must be an int array")
+            if temperature > 0.0 and seed is None:
+                # an unseeded sampled stream has no replayable key chain —
+                # the router pins a seed at first proxy precisely so its
+                # journal stays resumable; reject rather than silently
+                # diverge from the already-emitted prefix
+                raise ApiError(
+                    400, "sampled resume requires the original seed")
+            resume_tokens = [int(t) for t in toks]
+            resume_id = str(resume.get("id") or "")
+            resume_created = int(resume.get("created") or 0)
         return dict(prompt_tokens=prompt_tokens, stops=stops,
                     temperature=temperature, topp=topp,
                     max_tokens=max_tokens, seed=seed, presence=presence,
                     frequency=frequency, timeout_s=timeout_s, spec_k=spec_k,
-                    priority=priority, tenant=tenant)
+                    priority=priority, tenant=tenant,
+                    token_ids=bool(body.get("include_token_ids")),
+                    resume_tokens=resume_tokens, resume_id=resume_id,
+                    resume_created=resume_created)
 
     def batched_submit(self, p: dict, req_id: str = ""):
         """Budget-clamp + submit one parsed request (prepare_request's dict)
@@ -690,6 +742,9 @@ class ApiServer:
             # scheduling class + fair-queue tenant (ISSUE 12): the
             # scheduler's policy pick and preemption read these
             priority=p["priority"], tenant=p["tenant"],
+            # cross-replica failover (ISSUE 16): the journaled emitted
+            # prefix to re-prefill before the stream continues
+            resume_tokens=p.get("resume_tokens"),
         )
 
     def finish_batched(self, req, ended_on_eos: bool,
@@ -731,6 +786,30 @@ class ApiServer:
         body. `p` is prepare_request's dict. The aio front-end runs the same
         submit/assemble/finish seams cooperatively instead (serve/aio.py)."""
         asm = TokenAssembler(self.tokenizer, p["stops"])
+        want_ids = bool(p.get("token_ids"))
+        resume = p.get("resume_tokens")
+        if resume:
+            # failover re-entry (ISSUE 16): replay the journaled prefix
+            # through a FRESH assembler so the stop detector / incremental
+            # decoder reach the exact state the dead replica held — without
+            # re-emitting anything (those deltas already reached the
+            # client; the journal records only relayed frames). The
+            # take_ids() drain keeps the position counter continuous, so
+            # the continuation's first frame carries position = len(resume).
+            for t in resume:
+                asm.feed(t)
+                if asm.eos:
+                    break
+            asm.take_ids()
+            if asm.eos:
+                # the journaled tokens already complete a stop sequence
+                # (the replica died between the stop-completing frame and
+                # its finish frame): the stream is over — finish now, no
+                # engine work left
+                timings: dict = {"e2e_ms": 0.0, "decode_tokens": 0}
+                if self.replica_id:
+                    timings["replica"] = self.replica_id
+                return asm.content(), "stop", asm.n, timings
         req = self.batched_submit(p, req_id=req_id)
         probe_at = time.monotonic() + 0.25
 
@@ -752,13 +831,19 @@ class ApiServer:
                         raise ClientDisconnected()
                 text = asm.feed(t)
                 if text and emit is not None:
-                    emit(text)
+                    if want_ids:
+                        emit(text, ids=asm.take_ids())
+                    else:
+                        emit(text)
                 if asm.eos:
                     break
             if not asm.eos:
                 text = asm.flush()
                 if text and emit is not None:
-                    emit(text)
+                    if want_ids:
+                        emit(text, ids=asm.take_ids())
+                    else:
+                        emit(text)
             finish, timings = self.finish_batched(req, asm.eos, asm.n)
         except BaseException:
             # disconnect/shed/crash: the slot must still be released, with
@@ -784,6 +869,9 @@ class ApiServer:
             content, finish, n_generated, timings = self._run_batched(
                 p, emit, probe=probe, req_id=req_id)
         else:
+            if body.get("resume") is not None:
+                raise ApiError(
+                    400, "resume requires the batched scheduler tier")
             prompt = self._normalize_legacy_prompt(body)
             temperature = float(body.get("temperature",
                                          self.defaults["temperature"]))
@@ -895,10 +983,13 @@ SSE_HEARTBEAT = b": keep-alive\n\n"
 
 
 def sse_chat_payload(cid: str, created: int, model: str, delta: dict,
-                     finish=None, timings=None) -> bytes:
+                     finish=None, timings=None, ids=None) -> bytes:
     """One `chat.completion.chunk` SSE data frame — single definition for
     the blocking `_stream` and the aio SSE machine (byte-identical events
-    on both front-ends)."""
+    on both front-ends). ``ids`` (``include_token_ids`` requests only) is
+    TokenAssembler.take_ids()'s ``(position, token_ids)`` — the raw ids
+    this frame's text consumed plus their stream offset, which is what the
+    router journals for mid-stream failover (ISSUE 16)."""
     data = {
         "id": cid,
         "object": "chat.completion.chunk",
@@ -906,6 +997,8 @@ def sse_chat_payload(cid: str, created: int, model: str, delta: dict,
         "model": model,
         "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
     }
+    if ids is not None:
+        data["position"], data["token_ids"] = ids[0], list(ids[1])
     if timings is not None:
         # the final (done) event carries the request's span-sourced
         # latency summary, like the non-stream response body
@@ -914,7 +1007,7 @@ def sse_chat_payload(cid: str, created: int, model: str, delta: dict,
 
 
 def sse_text_payload(cid: str, created: int, model: str, text: str,
-                     finish=None, timings=None) -> bytes:
+                     finish=None, timings=None, ids=None) -> bytes:
     """One legacy `text_completion` SSE data frame (see sse_chat_payload)."""
     data = {
         "id": cid,
@@ -923,6 +1016,8 @@ def sse_text_payload(cid: str, created: int, model: str, text: str,
         "model": model,
         "choices": [{"index": 0, "text": text, "finish_reason": finish}],
     }
+    if ids is not None:
+        data["position"], data["token_ids"] = ids[0], list(ids[1])
     if timings is not None:
         data["timings"] = timings
     return b"data: " + json.dumps(data).encode() + b"\n\n"
@@ -1293,20 +1388,29 @@ class RequestRoutes:
         (where the global engine lock serializes streams anyway)."""
         rid = self._req_id
         self._start_sse()
-        cid = f"{'cmpl' if legacy else 'chatcmpl'}-{uuid.uuid4().hex[:16]}"
-        created = int(time.time())
+        # a failover resume keeps the dead upstream's stream identity: the
+        # client already saw this id/created on the journaled frames, and a
+        # mid-stream identity change would break strict SSE consumers
+        resume = body.get("resume") if isinstance(body.get("resume"), dict) \
+            else None
+        cid = ((resume.get("id") if resume else None)
+               or f"{'cmpl' if legacy else 'chatcmpl'}-{uuid.uuid4().hex[:16]}")
+        created = int((resume.get("created") if resume else 0)
+                      or time.time())
         model = body.get("model", self.api.model_name)
         chunk = self._write_chunk
         last_write = [time.monotonic()]
 
-        def emit_chat(delta: dict, finish=None, timings=None) -> None:
+        def emit_chat(delta: dict, finish=None, timings=None,
+                      ids=None) -> None:
             chunk(sse_chat_payload(cid, created, model, delta,
-                                   finish=finish, timings=timings))
+                                   finish=finish, timings=timings, ids=ids))
             last_write[0] = time.monotonic()
 
-        def emit_text(text: str, finish=None, timings=None) -> None:
+        def emit_text(text: str, finish=None, timings=None,
+                      ids=None) -> None:
             chunk(sse_text_payload(cid, created, model, text,
-                                   finish=finish, timings=timings))
+                                   finish=finish, timings=timings, ids=ids))
             last_write[0] = time.monotonic()
 
         hb = self.api.sse_heartbeat_s
@@ -1332,9 +1436,14 @@ class RequestRoutes:
                 emit_text("", finish=result["choices"][0]["finish_reason"],
                           timings=result.get("timings"))
             else:
-                emit_chat({"role": "assistant"})
+                if resume is None:
+                    # a resumed stream's client already got the role delta
+                    # from the dead upstream — re-sending it would duplicate
+                    emit_chat({"role": "assistant"})
                 result = self.api.complete(
-                    body, emit=lambda text: emit_chat({"content": text}),
+                    body,
+                    emit=lambda text, ids=None: emit_chat(
+                        {"content": text}, ids=ids),
                     probe=probe, req_id=rid)
                 emit_chat({}, finish=result["choices"][0]["finish_reason"],
                           timings=result.get("timings"))
@@ -1473,6 +1582,9 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults):
     if n_slots <= 0 and defaults.get("radix_cache") == "on":
         log.warning("--radix-cache on needs --slots > 0; the single-engine "
                     "tier's NaiveCache has no page pool to share — ignored")
+    if n_slots <= 0 and defaults.get("kv_host_pages"):
+        log.warning("--kv-host-pages needs --slots > 0; the single-engine "
+                    "tier has no page pool to spill from — ignored")
     if n_slots <= 0 and (defaults.get("prefill_budget") not in (None, "auto")
                          or defaults.get("preempt") not in (None, "auto")
                          or defaults.get("tenant_weights")):
@@ -1549,6 +1661,18 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults):
                         "engine resolved dense — the per-slot prefix cache "
                         "serves instead")
             radix_cache = "off"
+        # host-RAM KV spill tier (--kv-host-pages, ISSUE 16): needs the
+        # paged layout with the radix tree on (its token paths key the host
+        # tier); warn-and-drop on an incompatible resolution rather than
+        # failing startup, same policy as --radix-cache above
+        kv_host_pages = int(defaults.get("kv_host_pages") or 0)
+        if kv_host_pages > 0 and (kv_layout != "paged"
+                                  or radix_cache == "off"):
+            log.warning("--kv-host-pages requires the paged KV layout with "
+                        "the radix cache on; this engine resolved "
+                        "%s/radix=%s — the host spill tier stays off",
+                        kv_layout, radix_cache)
+            kv_host_pages = 0
         be = BatchEngine(
             loaded.config,
             loaded.engine.params,
@@ -1562,6 +1686,7 @@ def make_server(loaded, host="127.0.0.1", port=0, n_slots: int = 0, **defaults):
             page_size=page_size,
             kv_pages=int(defaults.get("kv_pages") or 0),
             radix_cache=radix_cache,
+            kv_host_pages=kv_host_pages,
             # steady-state upload enforcement (--transfer-guard): 'strict'
             # turns an implicit per-chunk host->device transfer inside the
             # decode/spec dispatch window into an error

@@ -31,12 +31,25 @@ handshake with every worker, then scatter-gathers the actual work):
   re-pinned to wherever the request actually lands.
 * **failover** — a replica that refuses/resets before any response byte
   reached the client is NOT a client-visible failure: the request is
-  re-routed to a surviving replica (bounded attempts, exponential
-  backoff), the failed replica is marked down, and the reroute is counted.
-  A stream that already started can't be replayed (tokens are not
-  idempotent): it is failed CLEANLY, exactly once — a final SSE chunk with
-  `finish_reason:"error"`, an in-band error event, then `[DONE]` — never a
-  half-open socket. When every replica is down or shedding, the router
+  re-routed to a surviving replica (bounded attempts, exponential backoff
+  with jitter), the failed replica is marked down, and the reroute is
+  counted.
+* **mid-stream failover** (ISSUE 16) — the router sees every SSE frame it
+  relays, so it JOURNALS each stream's resume state: the raw token ids the
+  frames carried (`include_token_ids` is injected into every proxied
+  stream body), the stream id/created, and a pinned per-request seed. When
+  a replica dies mid-stream, the router resubmits to a survivor with a
+  `resume` body — prompt plus the journaled emitted prefix, which the
+  replica re-prefills through its radix/resume_commit path and whose PRNG
+  chain it replays from the seed — so greedy AND sampled streams continue
+  BIT-EXACT vs the uninterrupted run, duplicate-suppressed by journal
+  position, with at most one in-band `: retrying` comment visible.
+  Bounded by `--failover-max` resume attempts per stream under capped
+  exponential backoff with jitter. Unresumable streams (journal ring
+  full, journal over its token bound, no survivor, budget spent) keep the
+  old exactly-once contract: a final SSE chunk with
+  `finish_reason:"error"`, an in-band error event, then `[DONE]` — never
+  a half-open socket. When every replica is down or shedding, the router
   sheds with the worst upstream's `Retry-After` honored.
 
 Transport: the same selectors event loop as `--frontend aio`
@@ -50,6 +63,7 @@ import hashlib
 import http.client
 import json
 import logging
+import random
 import threading
 import time
 import uuid
@@ -57,7 +71,7 @@ import uuid
 from dllama_tpu.obs import metrics, new_request_id
 from dllama_tpu.obs import instruments as ins
 from dllama_tpu.serve.aio import AioHttpServer, _AioContext
-from dllama_tpu.utils import locks
+from dllama_tpu.utils import faults, locks
 
 log = logging.getLogger("dllama_tpu.serve.router")
 
@@ -119,6 +133,67 @@ class Replica:
                                           3) if self.last_poll else None)}
 
 
+class _StreamJournal:
+    """Per-stream resume state (ISSUE 16), built from the frames the router
+    relays: the raw token ids (`token_ids`/`position` fields the injected
+    ``include_token_ids`` makes every frame carry), the stream identity the
+    client saw, and terminal-frame tracking. ``valid`` drops to False when
+    the journal can no longer vouch for the client's view (ring full at
+    admission, token bound exceeded, a position gap) — the stream then
+    fails with the pre-failover exactly-once error contract."""
+
+    __slots__ = ("tokens", "cid", "created", "finished", "valid", "counted")
+
+    def __init__(self, valid: bool = True):
+        self.tokens: list[int] = []
+        self.cid: str | None = None
+        self.created = 0
+        self.finished = False  # terminal frame relayed (finish/error/[DONE])
+        self.valid = valid
+        self.counted = valid  # held a slot in the router's journal ring
+
+    def note_frame(self, frame: bytes, max_tokens: int) -> bool:
+        """Account one complete SSE frame -> whether to RELAY it (False =
+        a duplicate the client already has, drop it). Appends ids only at
+        the exact journal position, which makes replayed/overlapping
+        frames after a failover self-suppressing."""
+        if not frame.startswith(b"data: "):
+            return True  # comment/heartbeat frames pass through
+        payload = frame[len(b"data: "):].strip()
+        if payload == b"[DONE]":
+            self.finished = True
+            return True
+        try:
+            obj = json.loads(payload)
+        except ValueError:
+            return True
+        if "error" in obj:
+            self.finished = True
+            return True
+        if self.cid is None:
+            self.cid = obj.get("id")
+            self.created = int(obj.get("created") or 0)
+        ids = obj.get("token_ids")
+        pos = obj.get("position")
+        if ids and isinstance(pos, int):
+            if pos == len(self.tokens):
+                self.tokens.extend(int(t) for t in ids)
+                if len(self.tokens) > max_tokens:
+                    self.valid = False  # over the ring bound: stop vouching
+            elif pos + len(ids) <= len(self.tokens):
+                # the survivor replayed a frame the dead replica already
+                # delivered: the client has these bytes — suppress
+                return False
+            else:
+                self.valid = False  # gap: the journal lost sync
+        try:
+            if (obj.get("choices") or [{}])[0].get("finish_reason"):
+                self.finished = True
+        except (TypeError, AttributeError, IndexError):
+            pass
+        return True
+
+
 class _UpstreamDead(Exception):
     """Connection-level failure before/while talking to a replica."""
 
@@ -156,7 +231,10 @@ class Router:
     def __init__(self, replicas: list[str], poll_s: float = 0.5,
                  affinity: bool = True, connect_timeout_s: float = 2.0,
                  stream_idle_timeout_s: float = 120.0,
-                 max_affinity_entries: int = 4096):
+                 max_affinity_entries: int = 4096,
+                 failover_max: int = 2,
+                 max_live_journals: int = 1024,
+                 max_journal_tokens: int = 16384):
         if not replicas:
             raise ValueError("router needs at least one --replica")
         self.replicas = [_parse_replica(s) for s in replicas]
@@ -167,6 +245,14 @@ class Router:
         self.connect_timeout_s = float(connect_timeout_s)
         self.stream_idle_timeout_s = float(stream_idle_timeout_s)
         self.max_affinity_entries = int(max_affinity_entries)
+        # mid-stream failover (ISSUE 16): resume attempts per stream
+        # (--failover-max; 0 restores the fail-exactly-once contract), the
+        # live-journal ring bound (streams admitted past it relay fine but
+        # are unresumable), and the per-journal token bound
+        self.failover_max = int(failover_max)
+        self.max_live_journals = int(max_live_journals)
+        self.max_journal_tokens = int(max_journal_tokens)
+        self._live_journals = 0
         self._mu = locks.make_lock("serve.router")
         self._affinity: dict[str, str] = {}  # fingerprint -> replica rid
         self._pick_seq = 0.0
@@ -360,6 +446,26 @@ class Router:
         with self._mu:
             rep.inflight = max(0, rep.inflight - 1)
 
+    # ------------------------------------------------------ failover journal
+
+    def journal_acquire(self) -> _StreamJournal:
+        """One journal per live proxied stream, bounded: past the ring cap
+        the stream still relays normally but starts unresumable (valid =
+        False) — bounded memory beats a failover promise the router could
+        only keep by buffering without limit."""
+        with self._mu:
+            if self._live_journals >= self.max_live_journals:
+                return _StreamJournal(valid=False)
+            self._live_journals += 1
+            return _StreamJournal()
+
+    def journal_release(self, js: _StreamJournal) -> None:
+        if not js.counted:
+            return  # cap-rejected at acquire: never held a ring slot
+        js.counted = False
+        with self._mu:
+            self._live_journals = max(0, self._live_journals - 1)
+
     # ------------------------------------------------------------- snapshot
 
     def health(self) -> dict:
@@ -434,6 +540,15 @@ def _proxy(router: Router, ctx: _RouterContext, raw: bytes,
     stream's lifetime (upstream I/O is blocking)."""
     legacy = ctx.path in ("/v1/completions", "/completions")
     try:
+        # shed drill (faults: router.proxy): a raise here is a clean 503
+        # before any replica is picked — the chaos mesh's router-shed path
+        faults.fire("router.proxy")
+    except faults.InjectedFault:
+        ins.ROUTER_REQUESTS.labels(replica="none", outcome="shed").inc()
+        ctx._send_json(503, {"error": {"message": "router shed (fault)"}},
+                       {"Retry-After": "1"})
+        return
+    try:
         body = json.loads(raw or b"{}")
         if not isinstance(body, dict):
             raise ValueError
@@ -441,6 +556,16 @@ def _proxy(router: Router, ctx: _RouterContext, raw: bytes,
         ctx._send_json(400, {"error": {"message": "invalid JSON body"}})
         return
     stream = bool(body.get("stream"))
+    if stream:
+        # mid-stream failover needs two body amendments BEFORE the first
+        # attempt: frames must carry their raw token ids (the journal
+        # feed), and sampled streams must have a pinned seed — an unseeded
+        # stream's PRNG chain exists only on the replica that started it,
+        # so nothing could replay it bit-exact after a death
+        body["include_token_ids"] = True
+        if body.get("seed") is None:
+            body["seed"] = random.getrandbits(31)
+        raw = json.dumps(body).encode()
     fp = router.fingerprint(body, legacy)
     tried: set[str] = set()
     busy: list[_UpstreamBusy] = []
@@ -451,7 +576,7 @@ def _proxy(router: Router, ctx: _RouterContext, raw: bytes,
         if rep is None:
             break
         try:
-            _forward(router, ctx, rep, raw, rid, stream, legacy)
+            _forward(router, ctx, rep, raw, rid, stream, legacy, body, fp)
             return
         except _UpstreamBusy as e:
             # the replica is shedding (429 queue-full / 503 draining):
@@ -470,7 +595,10 @@ def _proxy(router: Router, ctx: _RouterContext, raw: bytes,
             log.warning("request %s: replica %s failed before response "
                         "start; rerouting", rid, rep.rid,
                         extra={"request_id": rid})
-            time.sleep(backoff)
+            # jittered: after a replica kill every pinned stream lands
+            # here at once — synchronized retries would hammer the same
+            # survivor at the same instant (thundering herd)
+            time.sleep(backoff * (0.5 + random.random() / 2.0))
             backoff = min(backoff * 2, 1.0)
         finally:
             router.release(rep)
@@ -490,11 +618,14 @@ def _proxy(router: Router, ctx: _RouterContext, raw: bytes,
 
 
 def _forward(router: Router, ctx: _RouterContext, rep: Replica,
-             raw: bytes, rid: str, stream: bool, legacy: bool) -> None:
+             raw: bytes, rid: str, stream: bool, legacy: bool,
+             body: dict | None = None, fp: str | None = None) -> None:
     """One forwarding attempt. Raises _UpstreamDead/_UpstreamBusy while the
-    attempt is still idempotent (no client-visible bytes); once the
-    response starts, failures terminate the client stream cleanly with
-    finish_reason="error" instead of raising."""
+    attempt is still idempotent (no client-visible bytes); once a streamed
+    response starts, an upstream death enters the mid-stream failover path
+    (journal resume on a survivor, bounded by --failover-max) and — only
+    when that is exhausted or unresumable — terminates the client stream
+    cleanly with finish_reason="error" instead of raising."""
     headers = {"Content-Type": "application/json", "X-Request-Id": rid}
     tmo = ctx.headers.get("X-Request-Timeout")
     if tmo:
@@ -560,6 +691,16 @@ def _forward(router: Router, ctx: _RouterContext, rep: Replica,
         endpoint="/v1/completions" if legacy else "/v1/chat/completions",
         code="200").inc()
     ctx.server.enqueue(ctx.conn, ctx._head(200, hdrs))
+    _relay_with_failover(router, ctx, rep, conn, resp, rid, legacy,
+                         body or {}, fp)
+
+
+def _relay_stream(ctx: _RouterContext, resp, js: _StreamJournal,
+                  max_tokens: int) -> str:
+    """Relay one upstream SSE response frame-by-frame, feeding the journal.
+    -> "done" (terminal frame relayed), "client_gone", or "died: <why>"
+    (socket error, or EOF before any terminal frame)."""
+    buf = b""
     try:
         while True:
             # read1: forward whatever is available NOW. read(n) on a
@@ -569,57 +710,189 @@ def _forward(router: Router, ctx: _RouterContext, rep: Replica,
             # router into a buffer that defeats streaming entirely
             data = resp.read1(16384)
             if not data:
-                break
-            ctx._write_chunk(data)
+                # EOF on a journaled stream that never delivered a terminal
+                # frame IS a death (the old pass-through silently truncated
+                # here) — a SIGKILLed replica's socket just closes
+                return ("done" if js.finished
+                        else "died: eof before terminal frame")
+            buf += data
+            # relay COMPLETE frames only (the incomplete tail waits for
+            # more bytes): the journal must account a frame's ids before
+            # its bytes reach the client, or a death between the two
+            # would resume short and duplicate tokens
+            while True:
+                frame, sep, rest = buf.partition(b"\n\n")
+                if not sep:
+                    break
+                buf = rest
+                if js.note_frame(frame + sep, max_tokens):
+                    ctx._write_chunk(frame + sep)
             if ctx.conn.dead:
-                # client hung up mid-stream: stop pulling tokens and close
-                # the upstream socket so the REPLICA's disconnect probe
-                # fires and frees the slot
-                conn.close()
-                ins.ROUTER_REQUESTS.labels(replica=rep.rid,
+                return "client_gone"
+    except (OSError, http.client.HTTPException) as e:
+        return f"died: {e.__class__.__name__}: {e}"
+
+
+def _resume_raw(body: dict, js: _StreamJournal) -> bytes:
+    """The resume request body a survivor replica re-enters the stream
+    with: the ORIGINAL prompt/params (max_tokens included — the replica's
+    produced-counter starts at the journal length) plus the journaled
+    emitted prefix and the stream identity the client already saw."""
+    b2 = dict(body)
+    b2["resume"] = {"tokens": list(js.tokens), "id": js.cid or "",
+                    "created": int(js.created or 0)}
+    b2["include_token_ids"] = True
+    return json.dumps(b2).encode()
+
+
+def _fail_stream(ctx: _RouterContext, rid: str, legacy: bool,
+                 model: str, why: str) -> None:
+    """The exactly-once terminal error sequence for an unresumable or
+    exhausted stream: finish_reason="error" chunk, in-band error event,
+    [DONE], chunk terminator — never a half-open socket."""
+    fail = {
+        "id": f"{'cmpl' if legacy else 'chatcmpl'}-{uuid.uuid4().hex[:16]}",
+        "object": "text_completion" if legacy else "chat.completion.chunk",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{"index": 0,
+                     **({"text": ""} if legacy else {"delta": {}}),
+                     "finish_reason": "error"}],
+    }
+    err = {"message": why, "type": "server_error", "request_id": rid}
+    ctx._write_chunk(b"data: " + json.dumps(fail).encode() + b"\n\n")
+    ctx._write_chunk(b"data: " + json.dumps({"error": err}).encode()
+                     + b"\n\n")
+    ctx._write_chunk(b"data: [DONE]\n\n")
+    ctx._write_chunk(b"")
+
+
+def _relay_with_failover(router: Router, ctx: _RouterContext, rep: Replica,
+                         conn, resp, rid: str, legacy: bool, body: dict,
+                         fp: str | None) -> None:
+    """Own a streamed response end-to-end: relay + journal, and on an
+    upstream death resume on a survivor (at most --failover-max times,
+    capped exponential backoff with jitter, one `: retrying` comment)."""
+    js = router.journal_acquire()
+    model = router.mesh_model or "dllama-tpu"
+    cur_rep, cur_conn, cur_resp = rep, conn, resp
+    retries = 0
+    commented = False
+    try:
+        while True:
+            verdict = _relay_stream(ctx, cur_resp, js,
+                                    router.max_journal_tokens)
+            cur_conn.close()
+            if verdict == "client_gone":
+                # client hung up mid-stream: stop pulling tokens; closing
+                # the upstream socket makes the REPLICA's disconnect probe
+                # fire and free the slot
+                ins.ROUTER_REQUESTS.labels(replica=cur_rep.rid,
                                            outcome="client_gone").inc()
                 return
-        conn.close()
-        ctx._write_chunk(b"")  # upstream finished cleanly; end our chunks
-        ins.ROUTER_REQUESTS.labels(replica=rep.rid, outcome="ok").inc()
-    except (OSError, http.client.HTTPException) as e:
-        # replica died MID-STREAM: tokens already reached the client, so a
-        # replay would duplicate output — fail this stream exactly once,
-        # cleanly (final chunk with finish_reason="error", in-band error
-        # event, [DONE], chunk terminator)
-        conn.close()
-        router._mark_down(rep, f"died mid-stream: {e!r}")
-        ins.ROUTER_REQUESTS.labels(replica=rep.rid,
-                                   outcome="stream_error").inc()
-        log.warning("request %s: replica %s died mid-stream; closing the "
-                    "stream with finish_reason=error", rid, rep.rid,
-                    extra={"request_id": rid})
-        fail = {
-            "id": f"{'cmpl' if legacy else 'chatcmpl'}-"
-                  f"{uuid.uuid4().hex[:16]}",
-            "object": ("text_completion" if legacy
-                       else "chat.completion.chunk"),
-            "created": int(time.time()),
-            "model": router.mesh_model or "dllama-tpu",
-            "choices": [{"index": 0,
-                         **({"text": ""} if legacy else {"delta": {}}),
-                         "finish_reason": "error"}],
-        }
-        err = {"message": f"replica {rep.rid} failed mid-stream",
-               "type": "server_error", "request_id": rid}
-        ctx._write_chunk(b"data: " + json.dumps(fail).encode() + b"\n\n")
-        ctx._write_chunk(b"data: " + json.dumps({"error": err}).encode()
-                         + b"\n\n")
-        ctx._write_chunk(b"data: [DONE]\n\n")
-        ctx._write_chunk(b"")
+            if verdict == "done":
+                # count BEFORE the terminating chunk: the client observes
+                # stream end the instant that write lands, and a scrape
+                # (or test) right after must already see the outcome
+                ins.ROUTER_REQUESTS.labels(replica=cur_rep.rid,
+                                           outcome="ok").inc()
+                if retries:
+                    ins.ROUTER_FAILOVERS.labels(outcome="resumed").inc()
+                ctx._write_chunk(b"")  # clean upstream end; end our chunks
+                return
+            # ---- upstream death mid-stream
+            router._mark_down(cur_rep, f"died mid-stream: {verdict}")
+            ins.ROUTER_REQUESTS.labels(replica=cur_rep.rid,
+                                       outcome="stream_error").inc()
+            log.warning("request %s: replica %s died mid-stream (%s); "
+                        "journal holds %d tokens", rid, cur_rep.rid,
+                        verdict, len(js.tokens),
+                        extra={"request_id": rid})
+            if js.finished:
+                # death AFTER the terminal frame was relayed: from the
+                # client's seat the stream already ended — just close
+                ctx._write_chunk(b"")
+                return
+            if not js.valid:
+                ins.ROUTER_FAILOVERS.labels(outcome="unresumable").inc()
+                _fail_stream(ctx, rid, legacy, model,
+                             f"replica {cur_rep.rid} failed mid-stream")
+                return
+            # ---- resume on a survivor, bounded + jittered
+            nxt = None
+            while retries < router.failover_max and nxt is None:
+                retries += 1
+                delay = min(0.05 * (2 ** (retries - 1)), 1.0)
+                time.sleep(delay * (0.5 + random.random() / 2.0))
+                cand, _ = router.pick(fp, exclude={cur_rep.rid})
+                if cand is None:
+                    continue
+                if not commented:
+                    # the ONE client-visible failover artifact: an SSE
+                    # comment (ignored by EventSource parsers)
+                    ctx._write_chunk(b": retrying\n\n")
+                    commented = True
+                ins.ROUTER_FAILOVERS.labels(outcome="retried").inc()
+                try:
+                    c2 = http.client.HTTPConnection(
+                        cand.host, cand.port,
+                        timeout=router.connect_timeout_s)
+                    c2.connect()
+                    c2.sock.settimeout(router.stream_idle_timeout_s)
+                    c2.request("POST", ctx.path, _resume_raw(body, js),
+                               {"Content-Type": "application/json",
+                                "X-Request-Id": rid})
+                    r2 = c2.getresponse()
+                    ctype2 = r2.getheader("Content-Type") or ""
+                    if (r2.status != 200
+                            or not ctype2.startswith("text/event-stream")):
+                        # shed or rejected the resume (e.g. its own 4xx/
+                        # 5xx): drain the verdict, try the next candidate
+                        try:
+                            r2.read()
+                        except (OSError, http.client.HTTPException):
+                            pass
+                        c2.close()
+                        router.release(cand)
+                        continue
+                    nxt = (cand, c2, r2)
+                except (OSError, http.client.HTTPException) as e:
+                    router._mark_down(cand, f"resume connect failed: {e!r}")
+                    router.release(cand)
+            if nxt is None:
+                ins.ROUTER_FAILOVERS.labels(outcome="exhausted").inc()
+                log.warning("request %s: failover budget spent (%d/%d); "
+                            "failing the stream exactly once", rid,
+                            retries, router.failover_max,
+                            extra={"request_id": rid})
+                _fail_stream(ctx, rid, legacy, model,
+                             f"replica {cur_rep.rid} failed mid-stream")
+                return
+            # hand accounting to the survivor. The ORIGINAL pick is the
+            # caller's to release (its finally does); any replica WE
+            # switched to is ours — release it before taking the next
+            if cur_rep is not rep:
+                router.release(cur_rep)
+            cur_rep, cur_conn, cur_resp = nxt
+            log.info("request %s: resumed on %s at token %d", rid,
+                     cur_rep.rid, len(js.tokens),
+                     extra={"request_id": rid})
+    finally:
+        router.journal_release(js)
+        if cur_rep is not rep:
+            # _proxy's finally releases `rep`; any replica we switched to
+            # is ours to release
+            router.release(cur_rep)
 
 
 def make_router(replicas: list[str], host: str = "127.0.0.1", port: int = 0,
                 poll_s: float = 0.5, affinity: bool = True,
-                workers: int | None = None) -> tuple[AioHttpServer, Router]:
+                workers: int | None = None,
+                failover_max: int = 2) -> tuple[AioHttpServer, Router]:
     """Build (server, router) without starting either — the test seam.
     Call router.start() for the handshake + poller, then serve_forever."""
-    router = Router(replicas, poll_s=poll_s, affinity=affinity)
+    router = Router(replicas, poll_s=poll_s, affinity=affinity,
+                    failover_max=failover_max)
     server = AioHttpServer((host, port), router, workers=workers or 16,
                            ctx_factory=_RouterContext)
     return server, router
@@ -628,12 +901,14 @@ def make_router(replicas: list[str], host: str = "127.0.0.1", port: int = 0,
 def run_router(replicas: list[str], host: str = "127.0.0.1",
                port: int = 9980, poll_s: float = 0.5, affinity: bool = True,
                workers: int | None = None,
-               drain_timeout_s: float = 30.0) -> int:
+               drain_timeout_s: float = 30.0,
+               failover_max: int = 2) -> int:
     """CLI entry: boot the router, install SIGTERM drain, serve forever."""
     import signal
 
     server, router = make_router(replicas, host, port, poll_s=poll_s,
-                                 affinity=affinity, workers=workers)
+                                 affinity=affinity, workers=workers,
+                                 failover_max=failover_max)
     router.start()
 
     fired = threading.Event()

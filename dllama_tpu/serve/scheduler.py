@@ -516,7 +516,8 @@ class Scheduler:
                frequency: float = 0.0, req_id: str = "",
                timeout_s: float | None = None,
                spec_k: int | None = None,
-               priority: int = 1, tenant: str = "") -> Request:
+               priority: int = 1, tenant: str = "",
+               resume_tokens=None) -> Request:
         self.check_admission()
         # per-request speculation: None keeps the engine default (every
         # greedy request speculates at the engine's K — the pre-ISSUE-11
@@ -529,6 +530,22 @@ class Scheduler:
                       frequency=float(frequency), submitted_at=time.monotonic(),
                       req_id=req_id, spec_k=spec_k,
                       priority=int(priority), tenant=str(tenant))
+        if resume_tokens:
+            # cross-replica failover (ISSUE 16): the router replays a dead
+            # upstream's journal here. Stamp the same resume record a warm
+            # restart builds (_record_resume), except the key chain starts
+            # from the REQUEST seed: this replica never held the stream, so
+            # the post-commit key is reconstructed as advance(PRNGKey(seed),
+            # n) — commit's own split is advance #1, each emitted decode
+            # token past the first is one more. Greedy streams ignore the
+            # key entirely, so an unseeded greedy resume pins seed 0.
+            n = len(resume_tokens)
+            req.resume_tokens = [int(t) for t in resume_tokens]
+            req.produced = n
+            req.key_advances = n - 1
+            req.resume_key = self._advance_key(
+                jax.random.PRNGKey(int(seed) if seed is not None else 0), n)
+            req.recovered = True
         if timeout_s is not None and timeout_s > 0:
             req.timeout_s = float(timeout_s)
             req.deadline_at = req.submitted_at + req.timeout_s

@@ -28,6 +28,18 @@ Injection points (armed sites call :func:`fire` with their point name):
                         logits went non-finite (``raise`` armed, consumed via
                         :func:`flag`): the scheduler's NaN guard fails THAT
                         request with finish_reason="error", not the engine
+``pool.spill``          before a radix-evicted page's d2h copy into the host
+                        KV tier (BatchEngine._host_spill) — ``raise`` degrades
+                        the eviction to the old discard (page lost, stream
+                        correct), ``delay`` stretches the release boundary
+``pool.restore``        before a host-tier page's device re-allocation + h2d
+                        upload at admission (BatchEngine radix restore) —
+                        ``raise`` falls back to re-prefilling the suffix,
+                        ``delay`` stretches the admission
+``router.proxy``        top of the router's proxy path (serve/router._proxy),
+                        before any replica pick — ``raise`` sheds the request
+                        with a clean 503, ``delay`` holds it (client-timeout
+                        and thundering-herd drills)
 ======================  =====================================================
 
 Actions: ``raise`` (throw :class:`InjectedFault`) and ``delay`` (sleep
@@ -71,6 +83,9 @@ POINTS = frozenset({
     "pool.alloc",
     "engine.restart",
     "decode.nan",
+    "pool.spill",
+    "pool.restore",
+    "router.proxy",
 })
 
 ACTIONS = frozenset({"raise", "delay"})

@@ -58,6 +58,10 @@ FAULT_MENU = (
     ("scheduler.queue", "raise"),
     ("pool.alloc", "raise"),
     ("decode.nan", "raise"),
+    # host spill tier (ISSUE 16): a failed spill degrades to a discard, a
+    # failed restore degrades to a re-prefill — never a corrupt page
+    ("pool.spill", "raise"),
+    ("pool.restore", "raise"),
 )
 
 #: finish reasons that count as "reached a terminal state"
@@ -75,7 +79,7 @@ def run_chaos(n_requests: int = 200, seed: int = 0, n_slots: int = 3,
               kv_pages: int = 12, page_size: int = 8, chunk: int = 3,
               clients: int = 4, fault_gap_s: tuple = (0.02, 0.15),
               timeout_frac: float = 0.15, client_deadline_s: float = 120.0,
-              verbose: bool = False) -> dict:
+              kv_host_pages: int = 6, verbose: bool = False) -> dict:
     """Run one seeded soak; returns a report dict with ``ok`` plus every
     assertion's inputs. Raises AssertionError on any robustness violation."""
     import jax.numpy as jnp
@@ -98,9 +102,13 @@ def run_chaos(n_requests: int = 200, seed: int = 0, n_slots: int = 3,
     prev_tracer = trace.TRACER
     tracer = trace.configure(1 << 16, max_requests=max(256, 2 * n_requests))
 
+    # kv_host_pages > 0 puts the ISSUE 16 host spill tier under the fault
+    # schedule too: the undersized device pool forces radix evictions all
+    # soak long, so spills/restores interleave with crashes and restarts
     eng = BatchEngine(cfg, params, n_slots=n_slots, cache_dtype=jnp.float32,
                       kv_layout="paged", page_size=page_size,
-                      kv_pages=kv_pages, spec=4)
+                      kv_pages=kv_pages, spec=4,
+                      kv_host_pages=kv_host_pages)
     eng.pool.audit_on_release = True  # every release audited, crash-adjacent
     sched = Scheduler(eng, chunk=chunk, restart_max=1_000_000,
                       restart_window_s=2.0, restart_backoff_s=0.005)
@@ -283,6 +291,14 @@ def run_chaos(n_requests: int = 200, seed: int = 0, n_slots: int = 3,
             problems.append("slots still active after all clients finished")
         elif leaked:
             problems.append(f"{leaked} page(s) leaked after dropping caches")
+        host = eng.pool.host
+        if host is not None:
+            hs = host.stats()
+            report["host"] = hs
+            # put/take/drop bookkeeping must close: what went down minus
+            # what came back (or was LRU-dropped) is exactly what's resident
+            if hs["spilled"] != hs["used"] + hs["restored"] + hs["dropped"]:
+                problems.append(f"host tier counters do not reconcile: {hs}")
         audit_fails = _sample("dllama_kv_audit_failures_total") - base["audit_fail"]
         report["audit_failures"] = audit_fails
         if audit_fails:
@@ -348,18 +364,326 @@ def run_chaos(n_requests: int = 200, seed: int = 0, n_slots: int = 3,
         trace.TRACER = prev_tracer
 
 
+def run_mesh_chaos(n_replicas: int = 3, n_requests: int = 30, seed: int = 0,
+                   clients: int = 3, kv_host_pages: int = 4,
+                   failover_max: int = 3, boot_deadline_s: float = 420.0,
+                   verbose: bool = False) -> dict:
+    """Multi-replica chaos mesh (ISSUE 16): one router subprocess over N
+    real `dllama-tpu serve` CLI replicas (tiny fixture model, paged KV +
+    host spill tier), while a seeded scheduler of process-level faults —
+    SIGKILL (with respawn), SIGSTOP/SIGCONT stalls, slow-poll windows —
+    runs against them. Streaming clients verify per stream:
+
+    * a terminal outcome ALWAYS arrives (finish_reason/error + [DONE]);
+    * token positions are exactly 0..n-1 — zero duplicated, zero dropped
+      tokens across however many mid-stream failovers the stream ate.
+
+    Afterwards: every live replica's /debug/kv audit must be clean (device
+    AND host tier reconciled), and the router's failover counters must
+    reconcile with what the clients observed (every error-finished stream
+    is exactly one exhausted/unresumable failover verdict)."""
+    import http.client
+    import json
+    import pathlib
+    import re
+    import signal
+    import socket
+    import subprocess
+    import tempfile
+
+    from tests.test_serve import make_tiny_files
+
+    rng = np.random.default_rng(seed)
+    tmp = tempfile.mkdtemp(prefix="dllama_chaos_mesh_")
+    mpath, tpath, _cfg = make_tiny_files(pathlib.Path(tmp))
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    ports = [free_port() for _ in range(n_replicas)]
+    rport = free_port()
+
+    def spawn_replica(port):
+        return subprocess.Popen(
+            [sys.executable, "-m", "dllama_tpu", "serve", "--model", mpath,
+             "--tokenizer", tpath, "--slots", "2", "--port", str(port),
+             # 56 device pages: two concurrent ~170-token chat prompts
+             # (~22 pages each) fit, but retained radix prefixes don't —
+             # evictions (and with the host tier on, spills) all soak long
+             "--kv-layout", "paged", "--page-size", "8",
+             "--kv-pages", "56", "--kv-host-pages", str(kv_host_pages)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+    replicas = {p: spawn_replica(p) for p in ports}
+    router = subprocess.Popen(
+        [sys.executable, "-m", "dllama_tpu", "router", "--port", str(rport),
+         *[a for p in ports for a in ("--replica", f"127.0.0.1:{p}")],
+         "--poll-s", "0.2", "--failover-max", str(failover_max)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+    def get(port, path, timeout=10):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+        conn.request("GET", path)
+        r = conn.getresponse()
+        body = r.read().decode()
+        conn.close()
+        return r.status, body
+
+    def wait_ready(deadline_s, want_all=False):
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            try:
+                st, body = get(rport, "/router/replicas")
+                if st == 200:
+                    reps = json.loads(body)["replicas"]
+                    ok = [r for r in reps if r["ready"] and r["config_ok"]]
+                    if (len(ok) == n_replicas) if want_all else ok:
+                        return True
+            except OSError:
+                pass
+            time.sleep(0.25)
+        return False
+
+    report: dict = {"ok": False, "mode": "mesh", "replicas": n_replicas,
+                    "requests": n_requests, "seed": seed}
+    stop_chaos = threading.Event()
+    chaos_log: list[tuple] = []
+    mu = threading.Lock()
+
+    def chaos_agent():
+        """Seeded process-level fault schedule. Never reduces the live set
+        below 2 (someone must survive to resume onto)."""
+        rng_c = np.random.default_rng(seed + 99)
+        while not stop_chaos.is_set():
+            time.sleep(float(rng_c.uniform(1.0, 2.5)))
+            if stop_chaos.is_set():
+                return
+            action = ("kill", "stop", "slow")[int(rng_c.integers(3))]
+            with mu:
+                live = [p for p, proc in replicas.items()
+                        if proc.poll() is None]
+                if len(live) < 2:
+                    continue
+                victim = live[int(rng_c.integers(len(live)))]
+                proc = replicas[victim]
+            if action == "kill":
+                proc.kill()
+                proc.wait(timeout=10)
+                chaos_log.append(("kill", victim))
+                time.sleep(float(rng_c.uniform(0.5, 1.5)))
+                with mu:
+                    replicas[victim] = spawn_replica(victim)  # rejoin later
+            elif action == "stop":
+                # a frozen replica: in-flight reads stall, health polls
+                # time out, then the world resumes mid-flight
+                try:
+                    proc.send_signal(signal.SIGSTOP)
+                    chaos_log.append(("stop", victim))
+                    time.sleep(float(rng_c.uniform(0.3, 1.2)))
+                finally:
+                    try:
+                        proc.send_signal(signal.SIGCONT)
+                    except OSError:
+                        pass
+            else:
+                # slow-poll window: brief freeze, long enough to make the
+                # router's next poll verdict stale but not to kill streams
+                try:
+                    proc.send_signal(signal.SIGSTOP)
+                    chaos_log.append(("slow", victim))
+                    time.sleep(0.15)
+                finally:
+                    try:
+                        proc.send_signal(signal.SIGCONT)
+                    except OSError:
+                        pass
+
+    results: list[dict] = [None] * n_requests  # type: ignore[list-item]
+    next_idx = {"i": 0}
+    idx_lock = threading.Lock()
+
+    def stream_one(i):
+        greedy = (i % 2 == 0)
+        body = {"messages": [
+                    {"role": "system",
+                     "content": f"mesh soak shared preamble {i % 4}"},
+                    {"role": "user", "content": f"request {i}"}],
+                "stream": True, "max_tokens": int(6 + (i % 6)),
+                "temperature": 0.0 if greedy else 0.9,
+                "seed": 1000 + i}
+        conn = http.client.HTTPConnection("127.0.0.1", rport, timeout=120)
+        try:
+            conn.request("POST", "/v1/chat/completions", json.dumps(body),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if resp.status != 200:
+                resp.read()
+                return {"finish": "shed", "status": resp.status,
+                        "positions_ok": True}
+            raw = resp.read().decode()
+        except (OSError, http.client.HTTPException) as e:
+            return {"finish": "HUNG", "error": repr(e), "positions_ok": False}
+        finally:
+            conn.close()
+        finish, err, poss = None, False, []
+        for line in raw.splitlines():
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            try:
+                ev = json.loads(line[6:])
+            except ValueError:
+                continue
+            if "error" in ev:
+                err = True
+                continue
+            if "token_ids" in ev:
+                poss.extend(range(ev["position"],
+                                  ev["position"] + len(ev["token_ids"])))
+            fr = (ev.get("choices") or [{}])[0].get("finish_reason")
+            if fr:
+                finish = fr
+        return {"finish": finish or ("error" if err else "NONE"),
+                "done": raw.rstrip().endswith("data: [DONE]"),
+                "positions_ok": poss == list(range(len(poss))),
+                "tokens": len(poss)}
+
+    def client():
+        while True:
+            with idx_lock:
+                i = next_idx["i"]
+                if i >= n_requests:
+                    return
+                next_idx["i"] = i + 1
+            results[i] = stream_one(i)
+
+    t0 = time.monotonic()
+    procs = lambda: list(replicas.values()) + [router]  # noqa: E731
+    try:
+        if not wait_ready(boot_deadline_s, want_all=True):
+            raise AssertionError("mesh never became ready "
+                                 f"(replica boot > {boot_deadline_s:.0f}s)")
+        agent = threading.Thread(target=chaos_agent, name="chaos-mesh-agent",
+                                 daemon=True)
+        workers = [threading.Thread(target=client, daemon=True,
+                                    name=f"mesh-client-{c}")
+                   for c in range(clients)]
+        agent.start()
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join(timeout=600.0)
+        stop_chaos.set()
+        agent.join(timeout=15.0)
+
+        problems: list[str] = []
+        if any(w.is_alive() for w in workers):
+            problems.append("client thread(s) never finished")
+
+        finishes: dict[str, int] = {}
+        for i, r in enumerate(results):
+            if r is None:
+                problems.append(f"request {i} has no result record")
+                continue
+            finishes[r["finish"]] = finishes.get(r["finish"], 0) + 1
+            if r["finish"] in ("NONE", "HUNG"):
+                problems.append(f"request {i} non-terminal: {r}")
+            if not r["positions_ok"]:
+                problems.append(f"request {i} duplicated/dropped tokens: {r}")
+        report["finish_reasons"] = finishes
+
+        # the mesh heals: at least the floor of survivors is ready again
+        if not wait_ready(60.0):
+            problems.append("mesh did not recover after the fault schedule")
+
+        # every live replica's device + host KV tiers audit clean
+        audits = {}
+        with mu:
+            live = [p for p, proc in replicas.items() if proc.poll() is None]
+        for p in live:
+            try:
+                st, body = get(p, "/debug/kv", timeout=30)
+                kv = json.loads(body)
+                audits[p] = kv.get("audit", {}).get("ok")
+                if st != 200 or audits[p] is not True:
+                    problems.append(f"replica :{p} KV audit not clean")
+            except (OSError, ValueError) as e:
+                problems.append(f"replica :{p} /debug/kv unreachable: {e!r}")
+        report["audits"] = audits
+
+        # router counters reconcile with the client view: every
+        # error-finished stream is exactly one exhausted/unresumable verdict
+        st, mtext = get(rport, "/metrics", timeout=30)
+        fov = {m.group(1): float(m.group(2)) for m in re.finditer(
+            r'dllama_router_failovers_total\{outcome="(\w+)"\} ([0-9.e+-]+)',
+            mtext)}
+        report["failovers"] = fov
+        errors_seen = finishes.get("error", 0)
+        if errors_seen != fov.get("exhausted", 0) + fov.get("unresumable", 0):
+            problems.append(
+                f"error streams ({errors_seen}) != exhausted+unresumable "
+                f"({fov})")
+        if fov.get("resumed", 0) > fov.get("retried", 0):
+            problems.append(f"resumed > retried: {fov}")
+
+        report["chaos_events"] = len(chaos_log)
+        report["elapsed_s"] = round(time.monotonic() - t0, 2)
+        report["problems"] = problems
+        report["ok"] = not problems
+        if verbose or problems:
+            print(f"chaos mesh: {n_requests} streams over {n_replicas} "
+                  f"replicas, {len(chaos_log)} process faults "
+                  f"({[e[0] for e in chaos_log]}), finishes={finishes}, "
+                  f"failovers={fov}, {report['elapsed_s']}s")
+            for p in problems:
+                print(f"chaos mesh VIOLATION: {p}")
+        assert not problems, "; ".join(problems)
+        return report
+    finally:
+        stop_chaos.set()
+        for proc in procs():
+            if proc.poll() is None:
+                try:
+                    proc.send_signal(signal.SIGCONT)  # un-freeze first
+                except OSError:
+                    pass
+                proc.kill()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=200)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--slots", type=int, default=3)
     ap.add_argument("--kv-pages", type=int, default=12)
+    ap.add_argument("--kv-host-pages", type=int, default=6)
     ap.add_argument("--clients", type=int, default=4)
     ap.add_argument("--timeout-frac", type=float, default=0.15)
+    ap.add_argument("--mesh", type=int, default=0, metavar="N",
+                    help="run the MULTI-REPLICA mesh soak instead: a router "
+                         "+ N real CLI replicas under randomized SIGKILL/"
+                         "SIGSTOP/slow-poll (ISSUE 16); --requests then "
+                         "means streamed requests through the router")
+    ap.add_argument("--failover-max", type=int, default=3)
     args = ap.parse_args(argv)
     try:
+        if args.mesh > 0:
+            report = run_mesh_chaos(
+                n_replicas=args.mesh, n_requests=args.requests,
+                seed=args.seed, clients=args.clients,
+                kv_host_pages=args.kv_host_pages,
+                failover_max=args.failover_max, verbose=True)
+            print(f"chaos mesh PASSED (seed {args.seed}): "
+                  f"{report['requests']} streams 100% terminal with zero "
+                  f"duplicate/dropped tokens, audits clean, "
+                  f"failovers={report['failovers']}")
+            return 0
         report = run_chaos(n_requests=args.requests, seed=args.seed,
                            n_slots=args.slots, kv_pages=args.kv_pages,
+                           kv_host_pages=args.kv_host_pages,
                            clients=args.clients,
                            timeout_frac=args.timeout_frac, verbose=True)
     except AssertionError as e:

@@ -20,7 +20,8 @@ cp README.md bench.py "$tmp/"
 mkdir -p "$tmp/experiments" "$tmp/scripts"
 cp experiments/perfdiff.py experiments/aot_check.py "$tmp/experiments/"
 cp scripts/hybrid_smoke.sh scripts/compile_smoke.sh \
-   scripts/analysis_smoke.sh "$tmp/scripts/"
+   scripts/analysis_smoke.sh scripts/router_smoke.sh \
+   scripts/failover_smoke.sh scripts/chaos_soak.sh "$tmp/scripts/"
 
 echo "analysis_smoke: pristine copy must pass"
 python -m dllama_tpu.analysis --root "$tmp"

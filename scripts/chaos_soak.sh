@@ -11,14 +11,36 @@
 #
 # CPU-only and hermetic (tiny random-weight model, no model files). The
 # fast bounded variant runs in tier-1 as tests/test_chaos.py.
+#
+# ISSUE 16 additions: the in-proc soak now runs with the host-RAM KV spill
+# tier armed (CHAOS_HOST_PAGES device-overflow pages, default 6) so every
+# radix eviction under pool pressure exercises the d2h spill path, and
+# CHAOS_MESH=N (N>=2) runs a SECOND, multi-replica soak: a router over N
+# real CLI replica subprocesses under randomized SIGKILL/SIGSTOP/slow-poll,
+# asserting 100% terminal streams with zero duplicate/dropped token
+# positions, clean /debug/kv audits on every survivor (device AND host
+# tier), and router failover counters reconciled against the client view.
+#
+#   CHAOS_REQUESTS=200 CHAOS_SEED=0 CHAOS_MESH=3 scripts/chaos_soak.sh
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 # DLLAMA_LOCK_AUDIT=1 (ISSUE 14): the soak's five-plus concurrent threads
 # (clients, worker, watchdog, scrapes) run with the lock-order sanitizer
 # armed — a rank inversion raises at the acquisition, with both sites named
-exec env JAX_PLATFORMS=cpu DLLAMA_POOL_AUDIT=1 DLLAMA_LOCK_AUDIT=1 \
+env JAX_PLATFORMS=cpu DLLAMA_POOL_AUDIT=1 DLLAMA_LOCK_AUDIT=1 \
     python experiments/chaos.py \
     --requests "${CHAOS_REQUESTS:-200}" \
     --seed "${CHAOS_SEED:-0}" \
-    --clients "${CHAOS_CLIENTS:-4}"
+    --clients "${CHAOS_CLIENTS:-4}" \
+    --kv-host-pages "${CHAOS_HOST_PAGES:-6}"
+
+if [ "${CHAOS_MESH:-0}" -gt 0 ]; then
+    env JAX_PLATFORMS=cpu DLLAMA_POOL_AUDIT=1 \
+        python experiments/chaos.py \
+        --mesh "${CHAOS_MESH}" \
+        --requests "${CHAOS_MESH_REQUESTS:-30}" \
+        --seed "${CHAOS_SEED:-0}" \
+        --clients "${CHAOS_CLIENTS:-3}" \
+        --kv-host-pages "${CHAOS_HOST_PAGES:-4}"
+fi

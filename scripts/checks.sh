@@ -58,6 +58,9 @@ for name in metrics.REGISTRY.names():
 # ...and the router / aio-front-end series are what
 # scripts/router_smoke.sh, the bench router record, and the test_aio
 # bounded-thread drill assert on (ISSUE 15): removal must fail here too
+# ...and the failover / host-spill-tier series are what
+# scripts/failover_smoke.sh, the chaos mesh, and the test_paged_kv host
+# drills assert on (ISSUE 16): removal must fail here too
 for name in ("dllama_kv_pages_total", "dllama_kv_pages_used",
              "dllama_kv_pages_shared",
              "dllama_radix_lookups_total", "dllama_radix_hit_tokens_total",
@@ -72,7 +75,10 @@ for name in ("dllama_kv_pages_total", "dllama_kv_pages_used",
              "dllama_device_live_buffers", "dllama_device_live_bytes",
              "dllama_router_requests_total",
              "dllama_router_affinity_hits_total",
-             "dllama_replica_healthy", "dllama_frontend_connections"):
+             "dllama_replica_healthy", "dllama_frontend_connections",
+             "dllama_router_failovers_total",
+             "dllama_kv_host_pages_total", "dllama_kv_host_pages_used",
+             "dllama_kv_spill_total"):
     if name not in metrics.REGISTRY.names():
         missing.append(f"unregistered:{name}")
 for name in sorted(trace.SPAN_CATALOG):

@@ -1,0 +1,207 @@
+#!/usr/bin/env bash
+# failover_smoke.sh — the ISSUE 16 acceptance drill: ZERO-LOSS mid-stream
+# failover over the real wire.
+#
+# Boots TWO `python -m dllama_tpu serve` replicas (real CLI, tiny fixture
+# model, paged layout + a small host-RAM KV spill tier) plus one
+# `python -m dllama_tpu router` fronting them with --failover-max 2, then:
+#
+#   1. streams a pinned greedy completion to an uninterrupted baseline
+#      (include_token_ids on, fixed seed) and records every token id;
+#   2. re-streams the SAME request through the router and SIGKILLs the
+#      replica serving it the moment its first content frames arrive;
+#   3. asserts the client's single SSE stream still completed with EXACTLY
+#      the baseline's token ids and text — zero lost, zero duplicated —
+#      with at most one in-band `: retrying` comment as the only evidence,
+#      the router's failovers{outcome="resumed"} counter advancing, and the
+#      survivor's /debug/kv audit clean (device AND host tier reconciled)
+#      after the resume re-prefilled the journaled prefix.
+#
+# SMOKE TARGET, not a pytest test (lives outside tests/, exempt from the
+# tier-1 run). CPU-only, ~2 min. Exit 0 = PASS.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import http.client
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.getcwd())
+from tests.test_serve import make_tiny_files  # the tier-1 fixture model
+
+tmp = tempfile.mkdtemp(prefix="dllama_failover_smoke_")
+mpath, tpath, _cfg = make_tiny_files(__import__("pathlib").Path(tmp))
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+ports = [free_port(), free_port()]
+rport = free_port()
+
+replicas = {
+    p: subprocess.Popen(
+        [sys.executable, "-m", "dllama_tpu", "serve", "--model", mpath,
+         "--tokenizer", tpath, "--slots", "2", "--port", str(p),
+         "--kv-layout", "paged", "--page-size", "8",
+         "--kv-pages", "56", "--kv-host-pages", "4"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    for p in ports
+}
+router = subprocess.Popen(
+    [sys.executable, "-m", "dllama_tpu", "router", "--port", str(rport),
+     "--replica", f"127.0.0.1:{ports[0]}",
+     "--replica", f"127.0.0.1:{ports[1]}",
+     "--poll-s", "0.2", "--failover-max", "2"],
+    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read().decode()
+    conn.close()
+    return r.status, body
+
+
+BODY = {"messages": [
+            {"role": "system", "content": "You are a terse assistant."},
+            {"role": "user", "content": "stream me a dozen tokens"}],
+        "stream": True, "max_tokens": 12, "temperature": 0.0, "seed": 11,
+        "include_token_ids": True}
+
+
+def parse(raw):
+    """-> (token_ids, text, finish_reason, saw_done, retry_comments)"""
+    ids, text, finish = [], [], None
+    for line in raw.splitlines():
+        if not line.startswith("data: ") or line == "data: [DONE]":
+            continue
+        ev = json.loads(line[6:])
+        if "error" in ev:
+            finish = "error"
+            continue
+        ids.extend(ev.get("token_ids") or [])
+        ch = (ev.get("choices") or [{}])[0]
+        text.append((ch.get("delta") or {}).get("content") or "")
+        finish = ch.get("finish_reason") or finish
+    return (ids, "".join(text), finish,
+            raw.rstrip().endswith("data: [DONE]"),
+            raw.count(": retrying"))
+
+
+def stream(port, body, on_frames=None):
+    """Stream a completion; call on_frames(n_data_frames) after each read."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", "/v1/chat/completions", json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200, f"stream -> {resp.status}: {resp.read()!r}"
+    raw = b""
+    while True:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        raw += chunk
+        if on_frames is not None:
+            on_frames(raw.count(b"data: "))
+    conn.close()
+    return raw.decode()
+
+
+procs = list(replicas.values()) + [router]
+try:
+    deadline = time.time() + 300  # two first-boot XLA compiles on CPU
+    while True:
+        try:
+            st, body = get(rport, "/router/replicas")
+            reps = json.loads(body)["replicas"] if st == 200 else []
+        except (OSError, ValueError):
+            reps = []
+        if len(reps) == 2 and all(r["ready"] and r["config_ok"]
+                                  for r in reps):
+            break
+        for proc in procs:
+            if proc.poll() is not None:
+                sys.exit("FAIL: a process exited before the mesh was ready")
+        if time.time() > deadline:
+            sys.exit("FAIL: router mesh never became ready")
+        time.sleep(0.25)
+
+    # (1) uninterrupted baseline, straight off a replica
+    base_ids, base_text, base_fin, base_done, _ = parse(
+        stream(ports[0], BODY))
+    assert base_done and base_fin in ("stop", "length"), (
+        f"baseline did not terminate cleanly: {base_fin}")
+    assert base_ids, "baseline produced no token ids"
+
+    st, mtext = get(rport, "/metrics")
+    resumed0 = 0.0
+    m = re.search(r'dllama_router_failovers_total\{outcome="resumed"\} '
+                  r'([0-9.e+-]+)', mtext)
+    if m:
+        resumed0 = float(m.group(1))
+
+    # (2) same request through the router; SIGKILL the serving replica the
+    # moment real content frames are on the wire (role delta + >=2 tokens)
+    killed = {"port": None}
+
+    def assassin(n_frames):
+        if killed["port"] is None and n_frames >= 3:
+            # whichever replica holds an inflight stream is the victim
+            st, body = get(rport, "/router/replicas")
+            for r in json.loads(body)["replicas"]:
+                if r["inflight"] > 0:
+                    p = int(r["id"].rsplit(":", 1)[1])
+                    replicas[p].kill()
+                    killed["port"] = p
+                    return
+
+    raw = stream(rport, BODY, on_frames=assassin)
+    assert killed["port"] is not None, (
+        "the drill never found an inflight replica to SIGKILL — "
+        "the stream finished too fast to interrupt")
+    replicas[killed["port"]].wait(timeout=10)
+    ids, text, fin, done, retries = parse(raw)
+
+    # (3) zero loss, zero duplication, bit-exact vs the baseline
+    assert done and fin == base_fin, f"failover stream ended {fin!r}"
+    assert ids == base_ids, (
+        f"token loss/duplication across failover:\n  base {base_ids}\n"
+        f"  got  {ids}")
+    assert text == base_text, "text diverged across failover"
+    assert retries <= 1, f"{retries} retry comments (max 1 allowed)"
+
+    st, mtext = get(rport, "/metrics")
+    m = re.search(r'dllama_router_failovers_total\{outcome="resumed"\} '
+                  r'([0-9.e+-]+)', mtext)
+    assert m and float(m.group(1)) >= resumed0 + 1, (
+        "failovers{outcome=resumed} never advanced")
+
+    # (4) the survivor that absorbed the resume audits clean, both tiers
+    survivor = next(p for p in ports if p != killed["port"])
+    st, body = get(survivor, "/debug/kv")
+    kv = json.loads(body)
+    assert st == 200 and kv.get("audit", {}).get("ok") is True, (
+        f"survivor /debug/kv audit not clean: {kv.get('audit')}")
+
+    print(f"PASS: failover smoke OK — SIGKILL of :{killed['port']} "
+          f"mid-stream, client stream stayed byte-identical to the "
+          f"uninterrupted baseline ({len(base_ids)} tokens, "
+          f"finish={base_fin}, {retries} retry comment), survivor "
+          f":{survivor} KV audit clean")
+finally:
+    for proc in procs:
+        if proc.poll() is None:
+            proc.kill()
+PY

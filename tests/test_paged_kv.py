@@ -425,3 +425,137 @@ def test_all_slots_starved_finishes_one_to_free_pages():
         assert st["used"] == 0 or st["used"] <= 6
     finally:
         sched.shutdown()
+
+
+# -------------------------------------------------- host-RAM spill tier
+# (ISSUE 16): radix eviction swaps cold pages d2h instead of discarding;
+# a returning prompt restores them h2d at admission, byte-identical
+
+
+def _host_engine(kv_pages=12, host_pages=6, n_slots=3):
+    return BatchEngine(CFG, PARAMS, n_slots=n_slots, cache_dtype=jnp.float32,
+                       kv_layout="paged", page_size=PAGE, kv_pages=kv_pages,
+                       radix_cache="on", kv_host_pages=host_pages)
+
+
+def _tree_page_map(eng):
+    """{absolute token path through each page: device page index} for every
+    page the radix tree currently references."""
+    out = {}
+
+    def walk(node, prefix):
+        for ch in node.children.values():
+            full = prefix + tuple(ch.tokens)
+            start = len(prefix)
+            for i, p in enumerate(ch.pages):
+                out[full[:start + (i + 1) * PAGE]] = p
+            walk(ch, full)
+
+    walk(eng.radix.root, ())
+    return out
+
+
+def _page_bytes(eng, page):
+    kpg, vpg = eng._read_page(eng.cache, jnp.int32(page))
+    return np.asarray(kpg), np.asarray(vpg)
+
+
+def test_host_tier_spill_restore_byte_identity():
+    """Evict -> spill d2h -> returning prompt restores h2d: the restored
+    device pages are byte-identical to the pre-eviction ones, the lookup
+    covers every full page again (only the partial boundary page needs
+    re-prefill), counters/gauges reconcile, and the token stream repeats
+    bit-exact."""
+    from dllama_tpu.obs import metrics
+
+    eng = _host_engine()
+    sched = Scheduler(eng, chunk=4, overlap=False)
+    try:
+        prompt = list(range(1, 18))  # 17 tokens -> 2 full pages of 8
+        r1 = sched.submit(list(prompt), 0.0, 0.9, 6, frozenset(), seed=1)
+        out1 = list(r1.tokens())
+        before = {path: _page_bytes(eng, p)
+                  for path, p in _tree_page_map(eng).items()}
+        assert before, "radix tree should hold the finished request's pages"
+        host = eng.pool.host
+        out0 = ins.KV_SPILL.labels(direction="out").value()
+        in0 = ins.KV_SPILL.labels(direction="in").value()
+        freed = eng.radix_evict(100)
+        assert freed >= len(before)
+        assert host.used == len(before)
+        assert host.stats()["spilled"] == len(before)
+        assert ins.KV_SPILL.labels(
+            direction="out").value() - out0 == len(before)
+        assert metrics.REGISTRY.sample(
+            "dllama_kv_host_pages_used") == float(len(before))
+        assert eng.pool.audit()["ok"]
+        # the returning prompt restores every FULL page from the host tier
+        rows, hit = eng.radix_lookup(list(prompt))
+        assert rows == ((len(prompt) - 1) // PAGE) * PAGE == 16
+        assert host.used == 0
+        assert host.stats()["restored"] == len(before)
+        assert ins.KV_SPILL.labels(direction="in").value() - in0 \
+            == len(before)
+        after = _tree_page_map(eng)
+        assert set(after) == set(before)
+        for path, p in after.items():
+            k_new, v_new = _page_bytes(eng, p)
+            np.testing.assert_array_equal(k_new, before[path][0])
+            np.testing.assert_array_equal(v_new, before[path][1])
+        assert eng.pool.audit()["ok"]
+        # the same request repeats bit-exact THROUGH the restored pages
+        r2 = sched.submit(list(prompt), 0.0, 0.9, 6, frozenset(), seed=1)
+        assert list(r2.tokens()) == out1
+        assert eng.pool.audit()["ok"]
+    finally:
+        sched.shutdown()
+
+
+def test_host_tier_audit_catches_leaked_page():
+    """A host entry the pool didn't publish (leak stand-in: unaligned key,
+    wrong payload geometry, gauge drift) must fail PagePool.audit() loudly
+    and count on dllama_kv_audit_failures_total."""
+    from dllama_tpu.engine.batch import PoolAuditError
+    from dllama_tpu.obs import metrics
+
+    eng = _host_engine()
+    host = eng.pool.host
+    assert eng.pool.audit()["ok"]
+    fails0 = metrics.REGISTRY.sample("dllama_kv_audit_failures_total") or 0.0
+    bogus = np.zeros((CFG.n_layers, CFG.n_kv_heads, 3,
+                      CFG.dim // CFG.n_heads), np.float32)
+    host._entries[(1, 2, 3)] = (bogus, bogus)  # 3-token key, 3-row payload
+    with pytest.raises(PoolAuditError):
+        eng.pool.audit()
+    report = eng.pool.audit(raise_on_fail=False)
+    assert not report["ok"]
+    assert any("host" in p for p in report["problems"])
+    del host._entries[(1, 2, 3)]
+    host._publish()
+    assert eng.pool.audit()["ok"]
+    assert metrics.REGISTRY.sample("dllama_kv_audit_failures_total") \
+        >= fails0 + 2
+
+
+def test_warm_restart_drops_both_tiers_together():
+    """Warm restart must reset the HOST tier with the device tier: stale
+    host payloads surviving a restart would be restored into a rebuilt
+    pool whose contents they no longer match."""
+    eng = _host_engine()
+    sched = Scheduler(eng, chunk=4, overlap=False)
+    prompt = list(range(1, 18))
+    try:
+        r1 = sched.submit(list(prompt), 0.0, 0.9, 4, frozenset(), seed=1)
+        list(r1.tokens())
+    finally:
+        sched.shutdown()
+    eng.radix_evict(100)
+    host = eng.pool.host
+    assert host.used > 0
+    eng.warm_restart()
+    host2 = eng.pool.host
+    assert host2 is not host, "restart must rebuild the host pool"
+    assert host2.used == 0 and host2.stats()["spilled"] == 0
+    rows, _hit = eng.radix_lookup(list(prompt))
+    assert rows == 0  # both tiers gone: nothing to restore from
+    assert eng.pool.audit()["ok"]

@@ -32,6 +32,8 @@ class StubState:
         self.abort_after = None     # stream: emit N events, then cut the socket
         self.ntokens = 3
         self.stream_delay = 0.0     # seconds between stream events
+        self.resume_overlap = 0     # resume: re-emit N already-journaled
+        #                             frames (drills the dedup seam)
         self.served = []            # parsed bodies, in arrival order
         self.lock = threading.Lock()
 
@@ -89,20 +91,43 @@ def make_stub(state: StubState):
                     self.wfile.write(f"{len(p):x}\r\n".encode() + p + b"\r\n")
                     self.wfile.flush()
 
-                for i in range(state.ntokens):
+                # the stream contract a real replica honors (ISSUE 16): the
+                # i-th token of THIS stream is deterministic (100+i here —
+                # the stub's stand-in for greedy decode), `resume` re-enters
+                # at len(resume.tokens), identity (id/created) comes from
+                # the resume body when present, and frames carry
+                # position/token_ids when `include_token_ids` asks for them
+                resume = body.get("resume") or {}
+                start = len(resume.get("tokens") or [])
+                if start and state.resume_overlap:
+                    # a sloppy survivor replaying frames the client already
+                    # has — the ROUTER's journal must suppress these
+                    start = max(0, start - state.resume_overlap)
+                want_ids = bool(body.get("include_token_ids"))
+                cid = resume.get("id") or f"chatcmpl-{state.rid}"
+                emitted = 0
+                for i in range(start, state.ntokens):
                     if state.stream_delay:
                         time.sleep(state.stream_delay)
                     if state.abort_after is not None \
-                            and i >= state.abort_after:
+                            and emitted >= state.abort_after:
                         # mid-stream death: cut the connection, no [DONE].
                         # shutdown() (not close()) — rfile/wfile still hold
                         # fd refs, so close() alone would defer the FIN
                         self.connection.shutdown(socket.SHUT_RDWR)
                         return
-                    chunk(b'data: {"choices": [{"index": 0, "delta": '
-                          b'{"content": "t"}, "finish_reason": null}]}\n\n')
-                chunk(b'data: {"choices": [{"index": 0, "delta": {}, '
-                      b'"finish_reason": "stop"}]}\n\n')
+                    ev = {"id": cid, "created": 111,
+                          "choices": [{"index": 0,
+                                       "delta": {"content": f"t{i}"},
+                                       "finish_reason": None}]}
+                    if want_ids:
+                        ev["position"], ev["token_ids"] = i, [100 + i]
+                    chunk(b"data: " + json.dumps(ev).encode() + b"\n\n")
+                    emitted += 1
+                fin = {"id": cid, "created": 111,
+                       "choices": [{"index": 0, "delta": {},
+                                    "finish_reason": "stop"}]}
+                chunk(b"data: " + json.dumps(fin).encode() + b"\n\n")
                 chunk(b"data: [DONE]\n\n")
                 chunk(b"")
             else:
@@ -245,7 +270,10 @@ def test_replica_kill_mid_queue_reroutes_zero_lost(mesh):
 
 
 def test_replica_death_mid_stream_fails_exactly_once(mesh):
+    # --failover-max 0: the pre-ISSUE-16 exactly-once error contract must
+    # survive as the explicit opt-out (and the unresumable fallback)
     port, router, (a, b), _ = mesh
+    router.failover_max = 0
     # pin, then script the pinned stub to die after 2 stream events
     st, _, h1 = rpost(port, "/v1/chat/completions",
                       {"messages": SHARED, "max_tokens": 4})
@@ -270,6 +298,98 @@ def test_replica_death_mid_stream_fails_exactly_once(mesh):
     # in-band error event carries the request id
     errs = [json.loads(e) for e in events[:-1] if "error" in e]
     assert errs and errs[-1]["error"].get("request_id")
+
+
+def stream_raw(port, body, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", "/v1/chat/completions", json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    raw = resp.read().decode()
+    conn.close()
+    return raw
+
+
+def sse_events(raw):
+    return [json.loads(line[6:]) for line in raw.splitlines()
+            if line.startswith("data: ") and line[6:] != "[DONE]"]
+
+
+def assemble(raw):
+    """-> (content, token_ids, finish_reason, stream_ids) across all data
+    frames — the client's total view of one SSE stream."""
+    content, ids, finish, cids = "", [], None, set()
+    for e in sse_events(raw):
+        if "error" in e:
+            continue
+        ch = (e.get("choices") or [{}])[0]
+        content += (ch.get("delta") or {}).get("content") or ""
+        ids += e.get("token_ids", [])
+        if ch.get("finish_reason"):
+            finish = ch["finish_reason"]
+        if e.get("id"):
+            cids.add(e["id"])
+    return content, ids, finish, cids
+
+
+def test_midstream_failover_resumes_and_suppresses_duplicates(mesh):
+    """ISSUE 16 journal seam: the pinned replica dies after 2 token frames;
+    the survivor is scripted to REPLAY one already-delivered frame — the
+    client must still see every position exactly once, one `stop` finish,
+    one stream id, and at most one `: retrying` comment."""
+    port, router, (a, b), _ = mesh
+    st, _, h1 = rpost(port, "/v1/chat/completions",
+                      {"messages": SHARED, "max_tokens": 4})
+    victim, survivor = (a, b) if h1["X-Replica-Id"] == "stub-a" else (b, a)
+    victim.abort_after = 2
+    survivor.resume_overlap = 1
+    retried0 = ins.ROUTER_FAILOVERS.labels(outcome="retried").value()
+    resumed0 = ins.ROUTER_FAILOVERS.labels(outcome="resumed").value()
+    raw = stream_raw(port, {"messages": SHARED, "stream": True,
+                            "max_tokens": 8})
+    assert raw.rstrip().splitlines()[-1] == "data: [DONE]"
+    evs = sse_events(raw)
+    tok = [(e["position"], e["token_ids"]) for e in evs if "token_ids" in e]
+    assert [p for p, _ in tok] == list(range(a.ntokens)), tok
+    assert [t for _, ids in tok for t in ids] == \
+        [100 + i for i in range(a.ntokens)]
+    finishes = [e["choices"][0].get("finish_reason")
+                for e in evs if "choices" in e]
+    assert [f for f in finishes if f] == ["stop"]
+    assert len({e["id"] for e in evs if "id" in e}) == 1
+    assert raw.count(": retrying") == 1
+    # the survivor was handed the journaled prefix + the pinned seed
+    rb = survivor.served[-1]
+    assert rb["resume"]["tokens"] == [100, 101]
+    assert rb["include_token_ids"] is True
+    assert rb.get("seed") is not None
+    assert ins.ROUTER_FAILOVERS.labels(
+        outcome="retried").value() - retried0 == 1
+    assert ins.ROUTER_FAILOVERS.labels(
+        outcome="resumed").value() - resumed0 == 1
+
+
+def test_failover_budget_exhaustion_fails_exactly_once(mesh):
+    """Every replica dies on every attempt: after --failover-max resumes
+    the stream must fail EXACTLY once (finish_reason=error, in-band error,
+    [DONE]) with no token ever duplicated across the dead attempts."""
+    port, router, (a, b), _ = mesh
+    a.abort_after = 1
+    b.abort_after = 1
+    ex0 = ins.ROUTER_FAILOVERS.labels(outcome="exhausted").value()
+    raw = stream_raw(port, {"messages": SHARED, "stream": True,
+                            "max_tokens": 8})
+    assert raw.rstrip().splitlines()[-1] == "data: [DONE]"
+    evs = sse_events(raw)
+    poss = [e["position"] for e in evs if "token_ids" in e]
+    assert poss == sorted(set(poss)), f"duplicate/reordered tokens: {poss}"
+    finishes = [e["choices"][0].get("finish_reason")
+                for e in evs if "choices" in e]
+    assert [f for f in finishes if f] == ["error"]
+    assert any("error" in e for e in evs)
+    assert ins.ROUTER_FAILOVERS.labels(
+        outcome="exhausted").value() - ex0 == 1
 
 
 def test_drain_redirects_new_traffic(mesh):
@@ -492,3 +612,246 @@ def test_real_mesh_affinity_and_failover(real_mesh):
     conn.close()
     assert resp.status == 200
     assert kv["layout"] == "paged" and kv["audit"]["ok"] is True
+
+
+# --------------------------------------------------------------------------
+# mid-stream failover over REAL engines (ISSUE 16): bit-exact resume
+# --------------------------------------------------------------------------
+
+class SeverProxy:
+    """TCP forwarder that can cut the wire mid-SSE. Armed via
+    cut_after_frames=N it forwards the first N data frames verbatim then
+    severs the connection MID-frame — from the router's seat exactly the
+    death a SIGKILLed replica produces (EOF/RST, no terminal frame), minus
+    the process machinery an in-proc test can't have."""
+
+    def __init__(self, target_port: int):
+        self.target_port = target_port
+        self.cut_after_frames = None  # None = fully transparent
+        self.lsock = socket.socket()
+        self.lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.lsock.bind(("127.0.0.1", 0))
+        self.lsock.listen(16)
+        self.port = self.lsock.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                cli, _ = self.lsock.accept()
+            except OSError:
+                return
+            srv = socket.socket()
+            try:
+                srv.connect(("127.0.0.1", self.target_port))
+            except OSError:
+                cli.close()
+                continue
+            threading.Thread(target=self._pump_up, args=(cli, srv),
+                             daemon=True).start()
+            threading.Thread(target=self._pump_down, args=(srv, cli),
+                             daemon=True).start()
+
+    def _pump_up(self, cli, srv):
+        try:
+            while True:
+                d = cli.recv(65536)
+                if not d:
+                    break
+                srv.sendall(d)
+        except OSError:
+            pass
+        try:
+            srv.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+
+    def _pump_down(self, srv, cli):
+        buf = b""
+        frames = 0
+        try:
+            while True:
+                d = srv.recv(65536)
+                if not d:
+                    break
+                if self.cut_after_frames is None:
+                    cli.sendall(d)
+                    continue
+                buf += d
+                while True:
+                    seg, sep, rest = buf.partition(b"\n\n")
+                    if not sep:
+                        break
+                    buf = rest
+                    if b"data: " in seg:
+                        frames += 1
+                        if frames > self.cut_after_frames:
+                            # a few bytes of the doomed frame carry the
+                            # previous chunk's terminator, so everything
+                            # already relayed parses; then cut hard
+                            cli.sendall(seg[:8])
+                            cli.shutdown(socket.SHUT_RDWR)
+                            srv.close()
+                            return
+                    cli.sendall(seg + sep)
+            if buf:
+                cli.sendall(buf)
+        except OSError:
+            pass
+        try:
+            cli.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+
+    def close(self):
+        self._stop = True
+        try:
+            self.lsock.close()
+        except OSError:
+            pass
+
+
+@pytest.fixture(scope="module")
+def failover_real(tmp_path_factory):
+    """Two REAL engine replicas (paged KV + a small host spill tier), one
+    of them behind a severable wire, fronted by a started router."""
+    from dllama_tpu.engine.loader import load_model
+    from dllama_tpu.serve.api import make_server
+    from dllama_tpu.serve.router import make_router
+    from tests.test_serve import make_tiny_files
+
+    tmp = tmp_path_factory.mktemp("router_failover")
+    mpath, tpath, _cfg = make_tiny_files(tmp)
+    servers = []
+    for i in range(2):
+        loaded = load_model(mpath, tpath, mesh=None)
+        httpd, api = make_server(loaded, host="127.0.0.1", port=0,
+                                 n_slots=2, kv_layout="paged", page_size=8,
+                                 kv_host_pages=4)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        servers.append((httpd, api))
+    a_port = servers[0][0].server_address[1]
+    b_port = servers[1][0].server_address[1]
+    proxy = SeverProxy(a_port)  # replica A is the victim behind the wire
+    rserver, router = make_router(
+        [f"127.0.0.1:{proxy.port}", f"127.0.0.1:{b_port}"], poll_s=30.0)
+    router.start()
+    threading.Thread(target=rserver.serve_forever, daemon=True).start()
+    yield (rserver.server_address[1], router, a_port, b_port, proxy)
+    router.stop()
+    rserver.shutdown()
+    rserver.server_close()
+    proxy.close()
+    for httpd, api in servers:
+        try:
+            if api.scheduler is not None:
+                api.scheduler.shutdown()
+            httpd.shutdown()
+            httpd.server_close()
+        except OSError:
+            pass
+
+
+RESUME_MSGS = [{"role": "system", "content":
+                "Failover drill shared preamble, long enough to matter."},
+               {"role": "user", "content": "continue the drill"}]
+
+
+def _resume_bit_exact(a_port, b_port, body):
+    """Uninterrupted stream on replica A; resume at the midpoint on
+    replica B (which never saw the prompt) — the continuation must be
+    bit-exact: same token ids, same text, same finish, positions picking
+    up exactly where the journal stops, stream identity preserved."""
+    base_raw = stream_raw(a_port, body)
+    content, ids, finish, _ = assemble(base_raw)
+    assert len(ids) >= 2, f"stream too short to split: {ids}"
+    # split at a FRAME boundary (one frame may carry several token ids —
+    # held stop-prefix bytes ride the next text-bearing frame), mid-way
+    # through the token frames; the suffix is everything from that frame
+    # on, finish/flush frames included
+    frames = sse_events(base_raw)
+    tok_idx = [i for i, e in enumerate(frames) if "token_ids" in e]
+    assert len(tok_idx) >= 2, f"too few token frames: {frames}"
+    mid = tok_idx[len(tok_idx) // 2]
+    k = frames[mid]["position"]
+    assert k >= 1
+    suffix = "".join(
+        ((e.get("choices") or [{}])[0].get("delta") or {}).get("content")
+        or "" for e in frames[mid:])
+    rbody = dict(body)
+    rbody["resume"] = {"tokens": ids[:k], "id": "chatcmpl-drill",
+                       "created": 1234}
+    r_raw = stream_raw(b_port, rbody)
+    c2, ids2, fin2, cids2 = assemble(r_raw)
+    assert ids2 == ids[k:], f"resume diverged: {ids2} vs {ids[k:]}"
+    assert c2 == suffix
+    assert fin2 == finish
+    assert cids2 == {"chatcmpl-drill"}  # identity from the resume body
+    assert '"role"' not in r_raw  # the role delta is never re-sent
+    first = next(e for e in sse_events(r_raw) if "token_ids" in e)
+    assert first["position"] == k
+
+
+def test_cross_replica_resume_bit_exact_greedy(failover_real):
+    _, _, a_port, b_port, _ = failover_real
+    _resume_bit_exact(a_port, b_port, {
+        "messages": RESUME_MSGS, "stream": True, "max_tokens": 10,
+        "temperature": 0.0, "include_token_ids": True})
+
+
+def test_cross_replica_resume_bit_exact_sampled(failover_real):
+    _, _, a_port, b_port, _ = failover_real
+    _resume_bit_exact(a_port, b_port, {
+        "messages": RESUME_MSGS, "stream": True, "max_tokens": 10,
+        "temperature": 0.9, "top_p": 0.95, "seed": 7,
+        "include_token_ids": True})
+
+
+def test_sampled_resume_without_seed_rejected(failover_real):
+    _, _, a_port, _, _ = failover_real
+    st, data, _ = rpost(a_port, "/v1/chat/completions", {
+        "messages": RESUME_MSGS, "stream": False, "max_tokens": 4,
+        "temperature": 0.8,
+        "resume": {"tokens": [1, 2], "id": "x", "created": 1}})
+    assert st == 400
+    assert b"seed" in data
+
+
+def test_router_kill_mid_stream_bit_exact(failover_real):
+    """The acceptance drill: a replica's wire dies mid-stream behind the
+    router; with --failover-max >= 1 the client's completed stream is
+    byte-identical to the uninterrupted run — zero duplicated, zero
+    dropped tokens — and the survivor's KV audit stays clean. LAST in
+    this module: it marks the proxied replica down."""
+    from dllama_tpu.serve.router import Router
+
+    rport, router, a_port, b_port, proxy = failover_real
+    body = {"messages": [{"role": "system", "content":
+                          "kill-drill preamble nobody else uses"},
+                         {"role": "user", "content": "go"}],
+            "stream": True, "max_tokens": 10, "temperature": 0.0,
+            "seed": 11, "include_token_ids": True}
+    # uninterrupted baseline straight off the victim replica
+    content, ids, finish, _ = assemble(stream_raw(a_port, body))
+    assert len(ids) >= 5, f"stream too short for a mid-stream kill: {ids}"
+    # pin the prompt to the proxied victim, then arm the wire cut: the
+    # role delta + 2 token frames get through, the 4th frame dies mid-byte
+    fp = Router.fingerprint(body, False)
+    with router._mu:
+        router._affinity[fp] = f"127.0.0.1:{proxy.port}"
+    resumed0 = ins.ROUTER_FAILOVERS.labels(outcome="resumed").value()
+    proxy.cut_after_frames = 3
+    raw = stream_raw(rport, body)
+    c2, ids2, fin2, cids2 = assemble(raw)
+    assert ids2 == ids, f"token loss/dup across failover: {ids2} vs {ids}"
+    assert c2 == content
+    assert fin2 == finish
+    assert len(cids2) == 1  # one stream identity end to end
+    assert raw.count(": retrying") == 1
+    assert ins.ROUTER_FAILOVERS.labels(
+        outcome="resumed").value() - resumed0 == 1
+    # the survivor's paged-KV pool (device + host tier) reconciles
+    st, data = rget(b_port, "/debug/kv")
+    kv = json.loads(data)
+    assert st == 200 and kv["audit"]["ok"] is True
