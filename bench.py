@@ -52,6 +52,10 @@ Env knobs:
                        tiny replicas behind serve/router.py: prefix-affinity
                        warm-TTFT win vs round-robin + the 2-vs-1-replica
                        aggregate tok/s scaling ratio)
+  BENCH_FLEET_OBS      '0': skip the mesh observability record (fleet_obs
+                       on/off proxy-path A/B over two real tiny replicas +
+                       /router/metrics federation-scrape latency + merged-
+                       trace clock alignment)
   BENCH_HYBRID         '0': skip the hybrid chunked-prefill record (client-
                        observed admission stall + joiner TTFT, legacy sync
                        phase-split vs the fused hybrid step, bit-exactness
@@ -1711,6 +1715,225 @@ def bench_router(n_slots=2, steps=10, followers=5, clients=4,
                 pass
 
 
+def bench_fleet_obs(n_slots=2, steps=8, clients=3, rounds=4, scrapes=5):
+    """Mesh observability overhead record (ISSUE 17): the same two REAL
+    in-process replicas behind serve/router.py as bench_router, A/B'ing
+    the observability plane itself:
+
+    * **overhead leg**: identical concurrent closed-loop bursts through
+      a router with fleet_obs ON (trace minting + hop headers + router
+      span recording + client SLO windows + postmortem journal on every
+      proxied request) vs OFF (NULL tracer, no hop header, no journal),
+      run ALTERNATING with best-of-3 per arm, reporting
+      `tok_s_ratio_on_off` and `proxy_overhead_x` (off/on) — perfdiff
+      pins the latter at <= 1.03x (ISSUE 19 acceptance);
+    * **scrape leg**: timed GET /router/metrics federation scrapes
+      (mean/max ms, parse sanity: relabeled replica series and
+      dllama_fleet_ rollups present) plus one timed GET /router/trace
+      merge, reporting `trace.unaligned_replicas` — perfdiff-gated == 0:
+      every scraped replica must land clock-aligned in the merged file.
+
+    Builds its OWN tiny fixture model (routing + observability cost, not
+    model compute). BENCH_FLEET_OBS=0 skips. CPU-feasible (~1 min)."""
+    import http.client as _hc
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from dllama_tpu.engine.loader import load_model
+    from dllama_tpu.models.config import LlamaConfig
+    from dllama_tpu.models.formats import save_model, tensor_plan
+    from dllama_tpu.serve.api import make_server
+    from dllama_tpu.serve.router import make_router
+    from dllama_tpu.tokenizer.tokenizer import Tokenizer
+
+    tmp = tempfile.mkdtemp(prefix="dllama_bench_fleetobs_")
+    vocab = [bytes([i]) for i in range(256)]
+    scores = [0.0] * 256
+    bos_id = len(vocab)
+    vocab += [b"<s>", b"</s>"]
+    scores += [0.0, 0.0]
+    tok = Tokenizer(vocab, scores, bos_id, [bos_id + 1],
+                    chat_template="...<|start_header_id|>...")
+    tpath = os.path.join(tmp, "tok.t")
+    tok.save(tpath)
+    tiny = LlamaConfig(dim=64, hidden_dim=128, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=len(vocab), seq_len=512)
+    rng = np.random.default_rng(0)
+    tensors = {}
+    for name, shape, _ft in tensor_plan(tiny):
+        if name.endswith(("rms_att", "rms_ffn")) or name == "final_norm":
+            tensors[name] = np.ones(shape, np.float32)
+        else:
+            tensors[name] = (rng.standard_normal(shape) * 0.05).astype(
+                np.float32)
+    mpath = os.path.join(tmp, "model.m")
+    save_model(mpath, tiny, tensors)
+
+    def post(port, body, timeout=120):
+        conn = _hc.HTTPConnection("127.0.0.1", port, timeout=timeout)
+        conn.request("POST", "/v1/chat/completions", json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = json.loads(resp.read())
+        conn.close()
+        if resp.status != 200:
+            raise RuntimeError(f"completion -> {resp.status}: {data}")
+        return data
+
+    def get(port, path, timeout=30):
+        conn = _hc.HTTPConnection("127.0.0.1", port, timeout=timeout)
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        data = resp.read().decode("utf-8", "replace")
+        conn.close()
+        if resp.status != 200:
+            raise RuntimeError(f"{path} -> {resp.status}")
+        return data
+
+    def complete(port, system, user, max_tokens=steps):
+        return post(port, {
+            "messages": [{"role": "system", "content": system},
+                         {"role": "user", "content": user}],
+            "max_tokens": max_tokens, "temperature": 0.0})
+
+    servers, routers = [], []
+    try:
+        for _ in range(2):
+            loaded = load_model(mpath, tpath, mesh=None)
+            httpd, api = make_server(loaded, host="127.0.0.1", port=0,
+                                     n_slots=n_slots, kv_layout="paged",
+                                     page_size=8)
+            threading.Thread(target=httpd.serve_forever,
+                             daemon=True).start()
+            servers.append((httpd, api))
+        addrs = [f"127.0.0.1:{h.server_address[1]}" for h, _ in servers]
+
+        def boot_router(fleet_obs):
+            server, router = make_router(addrs, poll_s=1.0,
+                                         fleet_obs=fleet_obs)
+            router.start()
+            threading.Thread(target=server.serve_forever,
+                             daemon=True).start()
+            routers.append((server, router))
+            deadline = time.monotonic() + 30
+            while not all(r.ready and r.handshaken and r.config_ok
+                          for r in router.replicas):
+                if time.monotonic() > deadline:
+                    raise RuntimeError("router never saw every replica "
+                                       "ready")
+                time.sleep(0.2)
+                for rep in router.replicas:
+                    router._poll_one(rep)
+            return server.server_address[1]
+
+        def burst(port, tag):
+            tokens = [0] * clients
+            errors: list[BaseException] = []
+
+            def run(ci):
+                try:
+                    for r in range(rounds):
+                        body = complete(port, f"distinct {tag} prefix c{ci}",
+                                        f"round {r}")
+                        tokens[ci] += body["usage"]["completion_tokens"]
+                except BaseException as e:  # surfaced below, never swallowed
+                    errors.append(e)
+
+            threads = [threading.Thread(target=run, args=(ci,))
+                       for ci in range(clients)]
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.monotonic() - t0
+            if errors:
+                raise RuntimeError(
+                    f"fleet_obs {tag} leg: {len(errors)} client thread(s) "
+                    f"failed: {errors[0]!r}")
+            return {"agg_tok_s": round(sum(tokens) / max(wall, 1e-9), 3),
+                    "completions": clients * rounds,
+                    "wall_s": round(wall, 3)}
+
+        port_on = boot_router(fleet_obs=True)
+        port_off = boot_router(fleet_obs=False)
+        # TWO untimed warm bursts of the EXACT timed shapes (all leg tags
+        # are byte-length-equal): the legs run in a fixed order, and a
+        # shape compiled on the first leg's clock would masquerade as
+        # observability cost. Two passes, not one — the first burst's
+        # cold prefills and the second's radix-partial-hit prefills
+        # compile DIFFERENT chunk buckets; only the third distinct-tag
+        # burst onward is compile-free. The OFF router gets one warm pass
+        # of its own (router-side connection/affinity warmth; the replica
+        # compile caches are shared, the ON warms already paid those)
+        burst(port_on, "obs-wm1")
+        burst(port_on, "obs-wm2")
+        burst(port_off, "obs-wm3")
+        # ALTERNATING measured bursts, best-of per arm: the perfdiff
+        # ceiling on proxy_overhead_x is tight (1.03x), and a single
+        # burst per arm is hostage to scheduler noise on a shared CPU —
+        # interleaving means a load spike hits both arms, and best-of
+        # compares each arm's least-disturbed run
+        on_runs, off_runs = [], []
+        for i in range(3):
+            on_runs.append(burst(port_on, f"obs-on{i}"))
+            off_runs.append(burst(port_off, f"obs-of{i}"))
+        on = max(on_runs, key=lambda b: b["agg_tok_s"])
+        off = max(off_runs, key=lambda b: b["agg_tok_s"])
+
+        # scrape leg, against the ON router while its journal is warm
+        lat_ms = []
+        for _ in range(scrapes):
+            t0 = time.monotonic()
+            text = get(port_on, "/router/metrics")
+            lat_ms.append((time.monotonic() - t0) * 1e3)
+        assert 'replica="' in text and "dllama_fleet_" in text, (
+            "federated exposition missing relabeled/fleet series")
+        t0 = time.monotonic()
+        merged = json.loads(get(port_on, "/router/trace"))
+        trace_ms = (time.monotonic() - t0) * 1e3
+        other = merged["otherData"]
+        unaligned = (2 - other["replicas_merged"]) + sum(
+            1 for c in other["clock"].values() if not c["aligned"])
+
+        return {
+            "slots": n_slots, "clients": clients, "rounds": rounds,
+            "on": on, "off": off,
+            "tok_s_ratio_on_off": round(
+                on["agg_tok_s"] / max(off["agg_tok_s"], 1e-9), 4),
+            # the ISSUE 19 acceptance pin: federation + tracing may cost
+            # the proxy hot path at most 3% (ceiling 1.03 in perfdiff)
+            "proxy_overhead_x": round(
+                off["agg_tok_s"] / max(on["agg_tok_s"], 1e-9), 4),
+            "scrape": {
+                "federated_ms_mean": round(sum(lat_ms) / len(lat_ms), 3),
+                "federated_ms_max": round(max(lat_ms), 3),
+                "scrapes": scrapes,
+            },
+            "trace": {
+                "merge_ms": round(trace_ms, 3),
+                "replicas_merged": other["replicas_merged"],
+                "unaligned_replicas": unaligned,
+                "events": len(merged["traceEvents"]),
+            },
+        }
+    finally:
+        for server, router in routers:
+            router.stop()
+            server.shutdown()
+            server.server_close()
+        for httpd, api in servers:
+            try:
+                if api.scheduler is not None:
+                    api.scheduler.shutdown()
+                httpd.shutdown()
+                httpd.server_close()
+            except OSError:
+                pass
+
+
 def bench_slo(cfg, params, n_slots=8, chunk=4, steps=48, pf_chunk=64,
               slo_ttft_ms=5000.0, slo_itl_ms=500.0):
     """SLO & saturation record (ISSUE 7): serve a short mixed burst through
@@ -2316,6 +2539,17 @@ def worker():
         except Exception as e:
             router_rec = {"error": repr(e)[:200]}
 
+    # mesh observability record (ISSUE 17): fleet_obs on/off proxy-path
+    # A/B + federation-scrape latency + merged-trace clock alignment over
+    # two real tiny replicas; BENCH_FLEET_OBS=0 skips
+    fleet_obs_rec = None
+    if (os.environ.get("BENCH_FLEET_OBS") != "0"
+            and time.monotonic() < deadline - 90):
+        try:
+            fleet_obs_rec = bench_fleet_obs()
+        except Exception as e:
+            fleet_obs_rec = {"error": repr(e)[:200]}
+
     # paged-attention route A/B: jnp gather vs the fused flash-decode
     # kernel at 2-3 page sizes (ISSUE 8); BENCH_PAGED_KERNEL=0 skips
     paged_kernel_ab = None
@@ -2374,6 +2608,7 @@ def worker():
         "paged_kernel": paged_kernel_ab,
         "radix": radix_rec,
         "router": router_rec,
+        "fleet_obs": fleet_obs_rec,
         "slo": slo_rec,
         "spec_batch": spec_batch_rec,
         "kb_per_token_per_chip": kb_measured if kb_measured is not None else round(kb, 1),

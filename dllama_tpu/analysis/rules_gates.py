@@ -47,19 +47,28 @@ PERFDIFF_KEYS = ("hybrid.stall_reduction_x", "hybrid.ttft_overhead_x",
                  # ISSUE 15: the router's affinity warm-TTFT win and the
                  # 2-vs-1-replica scaling ratio stay gated
                  "router.affinity.warm_ttft_ratio_on_off",
-                 "router.scale.agg_tok_s_ratio_2_1")
+                 "router.scale.agg_tok_s_ratio_2_1",
+                 # ISSUE 17: the observability plane stays ~free on the
+                 # proxy path and every merged replica stays clock-aligned
+                 "fleet_obs.tok_s_ratio_on_off",
+                 "fleet_obs.trace.unaligned_replicas",
+                 # ISSUE 19: the acceptance pin — proxy overhead with the
+                 # plane on vs off, ceiling 1.03x
+                 "fleet_obs.proxy_overhead_x")
 
 #: aot_check.py markers: the paged flash-decode op inventory + its fused-
 #: scatter cases (ISSUE 8)
 AOT_MARKERS = ("paged_decode_attention", "fused scatter")
 
 #: bench records the perf gate rules read
-BENCH_DEFS = ("bench_hybrid", "bench_compile", "bench_router")
+BENCH_DEFS = ("bench_hybrid", "bench_compile", "bench_router",
+              "bench_fleet_obs")
 
 #: smoke scripts the gates cite (path, must-be-executable)
 GATED_SCRIPTS = ("scripts/hybrid_smoke.sh", "scripts/compile_smoke.sh",
                  "scripts/analysis_smoke.sh", "scripts/router_smoke.sh",
-                 "scripts/failover_smoke.sh", "scripts/chaos_soak.sh")
+                 "scripts/failover_smoke.sh", "scripts/chaos_soak.sh",
+                 "scripts/fleet_smoke.sh")
 
 
 def _line_of(src, needle: str, default: int = 1) -> int:

@@ -191,6 +191,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "dies mid-SSE (capped exponential backoff with "
                         "jitter; 0 = fail the stream exactly once with "
                         "finish_reason=error, the pre-failover contract)")
+    p.add_argument("--fleet-obs", choices=["on", "off"], default="on",
+                   help="router mode: the mesh observability plane — "
+                        "distributed trace propagation (X-Dllama-Trace hop "
+                        "header + router-side spans), per-replica clock-"
+                        "offset estimation, and the /router/trace|metrics|"
+                        "fleet|requests/{id} fleet endpoints stay up but "
+                        "empty of router spans when off (the bench A/B "
+                        "baseline)")
     p.add_argument("--slots", type=int, default=0,
                    help="serve mode: continuous-batching slots (0 = single-request + prefix cache)")
     p.add_argument("--overlap", choices=["on", "off"], default="on",
@@ -285,9 +293,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "requests over it burn dllama_slo_violations_total"
                         "{kind=ttft} and drop out of goodput; windowed "
                         "attainment at /debug/perf and "
-                        "dllama_slo_attainment (default: no target)")
+                        "dllama_slo_attainment. Router mode: same target "
+                        "judged from the CLIENT's seat (failover gaps "
+                        "included) into dllama_router_slo_attainment and "
+                        "GET /router/fleet (default: no target)")
     p.add_argument("--slo-itl-ms", type=float, default=None,
-                   help="serve mode: inter-token-latency SLO target in ms "
+                   help="serve AND router mode: inter-token-latency SLO "
+                        "target in ms "
                         "(mean ITL per request, same derivation as the "
                         "itl_ms metrics); violations burn "
                         "dllama_slo_violations_total{kind=itl} "
@@ -629,6 +641,10 @@ def cmd_router(args) -> int:
         workers=args.router_workers,
         drain_timeout_s=args.drain_timeout_s,
         failover_max=args.failover_max,
+        fleet_obs=args.fleet_obs == "on",
+        trace_capacity=args.trace_buffer,
+        slo_ttft_ms=args.slo_ttft_ms,
+        slo_itl_ms=args.slo_itl_ms,
     )
 
 

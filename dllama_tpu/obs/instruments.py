@@ -151,6 +151,55 @@ FRONTEND_CONNECTIONS = metrics.gauge(
     "dllama_process_threads; the threads front-end does not move this "
     "gauge",
     ("server",))
+REPLICA_CLOCK_OFFSET = metrics.gauge(
+    "dllama_replica_clock_offset_seconds",
+    "Router's NTP-lite estimate of each replica's monotonic-clock offset "
+    "(replica clock minus router clock, min-RTT sample over the health-poll "
+    "window) — what GET /router/trace shifts that replica's spans by to "
+    "land them on the merged mesh timeline",
+    ("replica",))
+REPLICA_CLOCK_UNCERTAINTY = metrics.gauge(
+    "dllama_replica_clock_uncertainty_seconds",
+    "Error bound of the offset estimate (half the min round-trip of the "
+    "window: the remote clock read can sit anywhere inside the round-trip) "
+    "— merged-trace alignment is only trusted to this resolution",
+    ("replica",))
+FEDERATION_SCRAPE_SECONDS = metrics.histogram(
+    "dllama_router_federation_scrape_seconds",
+    "Wall time of one GET /router/metrics federation pass: concurrent "
+    "scrape of every live replica + relabel/merge into one exposition "
+    "(the router's own registry renders inside this window too)",
+    buckets=metrics.LATENCY_BUCKETS_S)
+FLEET_SCRAPE_AGE = metrics.gauge(
+    "dllama_fleet_scrape_age_seconds",
+    "Age of each replica's last SUCCESSFUL /metrics scrape at federation "
+    "time — a dead replica's cached series keep federating (last-known "
+    "values) while this gauge grows, so the fleet view reads STALE, never "
+    "as zero traffic; alert on it instead of on vanishing series",
+    ("replica",))
+ROUTER_TTFT_SECONDS = metrics.histogram(
+    "dllama_router_ttft_seconds",
+    "CLIENT-perspective time to first token measured at the router "
+    "(request arrival to the first content frame relayed; non-streamed "
+    "requests observe their full proxy latency) — includes connect, "
+    "routing, queueing, and any failover the replica-side "
+    "dllama_ttft_seconds cannot see",
+    buckets=metrics.LATENCY_BUCKETS_S)
+ROUTER_ITL_SECONDS = metrics.histogram(
+    "dllama_router_itl_seconds",
+    "CLIENT-perspective mean inter-token latency per proxied stream "
+    "(first to last content frame over frames-1, measured at the router) "
+    "— a failover's backoff + resume gap lands here, invisible to any "
+    "single replica's dllama_itl_seconds",
+    buckets=metrics.CHUNK_BUCKETS_S)
+ROUTER_SLO_ATTAINMENT = metrics.gauge(
+    "dllama_router_slo_attainment",
+    "Windowed fraction of proxied requests finishing inside every "
+    "configured SLO (--slo-ttft-ms / --slo-itl-ms) as the CLIENT saw "
+    "them, per serving replica plus the replica=\"fleet\" rollup; a gap "
+    "vs the replicas' own dllama_slo_attainment is network/failover-"
+    "induced violation the replicas cannot measure (refreshed at scrape)",
+    ("replica",))
 ROUTER_FAILOVERS = metrics.counter(
     "dllama_router_failovers_total",
     "Mid-stream cross-replica failovers, by outcome (resumed = the stream "

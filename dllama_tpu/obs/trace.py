@@ -34,9 +34,43 @@ every layer can import it without cycles or optional-dependency gates.
 from __future__ import annotations
 
 import time
+import uuid
 from collections import OrderedDict, deque
 
 from dllama_tpu.utils import locks
+
+#: the distributed-trace hop header (ISSUE 17): the router mints one trace
+#: context per proxied request and stamps every upstream leg with
+#: ``trace_id:parent_span:hop`` — the replica tags its flight-recorder
+#: record (and, through the record, its exported spans) with the trace id,
+#: so a failover's second replica leg joins the SAME trace
+HOP_HEADER = "X-Dllama-Trace"
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex trace id (distinct from the request id: one trace may
+    span several request legs across replicas)."""
+    return uuid.uuid4().hex[:16]
+
+
+def format_hop(trace_id: str, parent_span: str, hop: int) -> str:
+    """Serialize a trace context for the hop header."""
+    return f"{trace_id}:{parent_span}:{int(hop)}"
+
+
+def parse_hop(value) -> tuple[str, str, int] | None:
+    """Parse a hop-header value -> (trace_id, parent_span, hop), or None
+    when absent/malformed (tracing is best-effort: a bad header must never
+    fail the request carrying it)."""
+    if not value or not isinstance(value, str):
+        return None
+    parts = value.split(":")
+    if len(parts) != 3 or not parts[0]:
+        return None
+    try:
+        return parts[0], parts[1], int(parts[2])
+    except ValueError:
+        return None
 
 #: span names the serving stack emits — the documented contract between the
 #: instrumentation, the README trace-catalog table, and scripts/checks.sh's
@@ -52,6 +86,12 @@ SPAN_CATALOG = {
     "decode.spec": "one batched speculative propose/verify cycle (track: device)",
     "emit.scan": "post-consume token emit + EOS/budget stop scan (track: scheduler)",
     "compile": "one jit trace/lower/compile attributed to a dispatch site (obs/compile ledger); args carry fn/key/classification — visible in Perfetto as compile stealing device time mid-traffic (track: compile)",
+    "proxy": "router: one relay leg of a proxied SSE stream — headers to terminal frame or upstream death; args carry replica/verdict (track: router)",
+    "connect": "router: connect + request + response headers of one upstream forwarding attempt; args carry replica/hop (track: router)",
+    "poll": "router: one /health poll exchange against a replica — doubles as the NTP-lite clock sample; args carry replica/ok (track: poll)",
+    "failover.attempt": "router: one mid-stream failover attempt — the jittered exponential backoff + survivor pick before a resume dispatch; args carry attempt (track: router)",
+    "resume": "router: connect + resume request to a survivor replica, journal replay included; args carry replica/tokens (track: router)",
+    "journal": "router: a proxied stream's failover-journal hold window, acquire to release; args carry valid (False = ring-capped, unresumable) + tokens journaled + retries (track: router)",
 }
 
 #: instant-event names (``ph: "i"`` in the export), same drift contract
@@ -69,6 +109,7 @@ EVENT_CATALOG = {
     "request.timeout": "a request hit its per-request deadline (timeout_s / X-Request-Timeout); args carry where (queued/prefill/decoding) (track: requests)",
     "request.preempted": "a running request was suspended at a chunk boundary for higher-priority work; its pages stay referenced and it resumes byte-identical later; args carry reason (slot/capacity) + emitted tokens (track: requests)",
     "request.resumed": "a preempted request re-entered a slot and its stream continued (track: requests)",
+    "affinity.pick": "router: one routing decision; args carry replica/warm (affinity hit) — the warm-routing record a merged trace shows next to the replica's radix lookups (track: router)",
 }
 
 
@@ -178,6 +219,9 @@ class NullTracer:
     def req_end(self, *a, **kw):
         pass
 
+    def trace_of(self, req_id):
+        return None
+
     def export_chrome(self) -> dict:
         return {"traceEvents": []}
 
@@ -238,6 +282,13 @@ class Tracer:
     @staticmethod
     def now() -> float:
         return time.monotonic()
+
+    @property
+    def epoch(self) -> float:
+        """The monotonic instant exported timestamps are relative to —
+        published in the /health clock payload so a router can place this
+        process's trace on the mesh timeline (ISSUE 17)."""
+        return self._epoch
 
     def _rel_ms(self, t: float | None):
         return None if t is None else round((t - self._epoch) * 1000.0, 3)
@@ -398,6 +449,17 @@ class Tracer:
             self.span_at("request", t0, t, cat="request", track="requests",
                          req_id=req_id, finish=str(finish_reason))
 
+    def trace_of(self, req_id: str) -> str | None:
+        """The distributed trace id a request was marked with (req_mark
+        ``trace_id=...`` from the hop header), or None — the hook log
+        lines use to carry trace_id next to request_id."""
+        if not req_id:
+            return None
+        with self._lock:
+            rec = self._requests.get(req_id)
+            tid = None if rec is None else rec.get("trace_id")
+        return tid if isinstance(tid, str) and tid else None
+
     # --------------------------------------------------------------- export
 
     def export_chrome(self) -> dict:
@@ -409,6 +471,13 @@ class Tracer:
         with self._lock:
             events = list(self._events)
             tracks = dict(self._tracks)
+            # distributed-trace tagging (ISSUE 17): events keyed by a req_id
+            # whose flight-recorder record carries a trace_id export with it,
+            # so a cross-replica merge can group legs under one trace
+            traces = {rid: rec["trace_id"] for rid, rec in
+                      self._requests.items()
+                      if isinstance(rec.get("trace_id"), str)
+                      and rec.get("trace_id")}
         meta = [{"ph": "M", "name": "process_name", "pid": 1,
                  "args": {"name": "dllama-tpu"}}]
         for name, tid in sorted(tracks.items(), key=lambda kv: kv[1]):
@@ -421,6 +490,9 @@ class Tracer:
                   "args": dict(args)}
             if req_id:
                 ev["args"]["req_id"] = req_id
+                tr_id = traces.get(req_id)
+                if tr_id and "trace_id" not in ev["args"]:
+                    ev["args"]["trace_id"] = tr_id
             if t1 is None:
                 ev["ph"] = "i"
                 ev["s"] = "t"  # thread-scoped instant
@@ -464,6 +536,38 @@ class Tracer:
             self._dropped = 0
 
 
+def merge_chrome(parts: list[tuple[str, dict, float]]) -> dict:
+    """Merge several Chrome trace exports onto ONE timeline (ISSUE 17).
+
+    ``parts`` is ``[(label, export, shift_us), ...]`` — each export a
+    :meth:`Tracer.export_chrome` dict, each ``shift_us`` the microseconds to
+    ADD to that part's timestamps to land them on the merged clock (the
+    router computes it from its NTP-lite per-replica offset estimate; the
+    router's own part shifts by 0). Each part becomes one Perfetto process
+    (pid = its 1-based position, process_name = its label) keeping its own
+    thread tracks, so the merged file shows the router track above one
+    process-track per replica. Events are re-sorted globally by (ts, -dur)
+    — the same non-decreasing-per-track guarantee export_chrome gives."""
+    meta: list[dict] = []
+    body: list[dict] = []
+    for pid, (label, export, shift_us) in enumerate(parts, start=1):
+        for ev in (export or {}).get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            if ev.get("ph") == "M":
+                if ev.get("name") == "process_name":
+                    ev["args"] = {"name": label}
+                meta.append(ev)
+                continue
+            try:
+                ev["ts"] = round(float(ev.get("ts", 0.0)) + shift_us, 1)
+            except (TypeError, ValueError):
+                ev["ts"] = shift_us
+            body.append(ev)
+    body.sort(key=lambda e: (e.get("ts", 0.0), -e.get("dur", 0.0)))
+    return {"traceEvents": meta + body, "displayTimeUnit": "ms"}
+
+
 #: the process-global tracer (CLI: --trace-buffer; 0 installs NULL_TRACER).
 #: Call sites read this attribute per use, so configure() can swap it live.
 TRACER: Tracer | NullTracer = Tracer()
@@ -479,3 +583,19 @@ def configure(capacity: int, max_requests: int = 128,
     else:
         TRACER = Tracer(int(capacity), max_requests, max_chunks_per_request)
     return TRACER
+
+
+def log_extra(req_id: str, **fields) -> dict:
+    """Structured-log ``extra`` dict (ISSUE 17 logging parity): request_id,
+    plus the mesh trace id when this request's flight record carries one (a
+    router hop header put it there), plus any truthy caller fields — so
+    ``--log-format json`` lines from router and replicas join on the same
+    trace_id key."""
+    x = {"request_id": req_id}
+    tid = TRACER.trace_of(req_id)
+    if tid:
+        x["trace_id"] = tid
+    for k, v in fields.items():
+        if v:
+            x[k] = v
+    return x

@@ -59,6 +59,7 @@ from concurrent.futures import ThreadPoolExecutor
 from http.client import parse_headers
 
 from dllama_tpu.obs import instruments as ins
+from dllama_tpu.obs import trace
 from dllama_tpu.serve import api as api_mod
 from dllama_tpu.utils import locks
 
@@ -197,7 +198,7 @@ class _SseMachine:
             # no polling thread involved, the loop's readable/EOF signal
             # IS the probe (ISSUE 15 satellite)
             log.info("client disconnected; request %s cancelled", self.rid,
-                     extra={"request_id": self.rid})
+                     extra=trace.log_extra(self.rid))
             if self.req is not None:
                 self.api.scheduler.cancel(self.req, reason="cancelled")
             self._complete()
@@ -220,7 +221,7 @@ class _SseMachine:
             # tier's mid-stream failure path, then a clean stream end
             self.api.scheduler.cancel(self.req, reason="cancelled")
             log.exception("streamed completion %s failed mid-stream",
-                          self.rid, extra={"request_id": self.rid})
+                          self.rid, extra=trace.log_extra(self.rid))
             from dllama_tpu.serve.scheduler import SchedulerRejected
 
             msg = (str(e) if isinstance(e, (api_mod.ApiError,
@@ -253,7 +254,7 @@ class _SseMachine:
             self._emit("" if self.legacy else {},
                        finish=finish, timings=timings)
             log.info("completion %s done: %d completion tokens",
-                     self.rid, self.asm.n, extra={"request_id": self.rid})
+                     self.rid, self.asm.n, extra=trace.log_extra(self.rid))
             self._terminate()
             return True
         if toks:

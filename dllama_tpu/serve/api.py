@@ -346,6 +346,11 @@ class ApiServer:
         # uptime answers "did it just restart", RSS + threads answer "is it
         # leaking" without a scrape pipeline
         h["process"] = ins.refresh_process_gauges()
+        # NTP-lite clock payload (ISSUE 17): our monotonic clock at answer
+        # time is the router's offset sample; the tracer epoch lets it map
+        # our Chrome-export timestamps onto the mesh timeline
+        h["clock"] = {"monotonic_s": time.monotonic(),
+                      "trace_epoch_s": getattr(trace.TRACER, "epoch", None)}
         return h
 
     def precheck_capacity(self) -> None:
@@ -1251,7 +1256,7 @@ class RequestRoutes:
         u = result.get("usage", {})
         log.info("completion %s done: %d prompt + %d completion tokens",
                  rid, u.get("prompt_tokens", 0), u.get("completion_tokens", 0),
-                 extra={"request_id": rid})
+                 extra=trace.log_extra(rid))
 
     def do_POST(self):
         # the request id is minted at ADMISSION — before any outcome is
@@ -1260,6 +1265,13 @@ class RequestRoutes:
         rid = self._req_id = new_request_id(self.headers.get("X-Request-Id"))
         chat = self.path in ("/v1/chat/completions", "/chat/completions")
         legacy = self.path in ("/v1/completions", "/completions")
+        # distributed trace context (ISSUE 17): a router hop header joins
+        # this replica's spans/flight record to the mesh-wide trace — the
+        # mark lands before admission so even shed requests correlate
+        hopctx = trace.parse_hop(self.headers.get(trace.HOP_HEADER))
+        if hopctx is not None and (chat or legacy):
+            trace.TRACER.req_mark(rid, trace_id=hopctx[0],
+                                  parent_span=hopctx[1], hop=hopctx[2])
         # the body is consumed BEFORE any early-return response: on the
         # keep-alive (HTTP/1.1) thread tier, unread body bytes would be
         # parsed as the NEXT request line — a 404'd POST must not poison its
@@ -1318,7 +1330,7 @@ class RequestRoutes:
                 self._send_json(200, result)
         except ApiError as e:
             log.info("request %s rejected: %s", rid, e.message,
-                     extra={"request_id": rid})
+                     extra=trace.log_extra(rid))
             self._send_json(e.status, {"error": {"message": e.message}})
         except QueueFull as e:
             # load shedding: the request never entered the queue; tell the
@@ -1326,24 +1338,24 @@ class RequestRoutes:
             # The would-have-been id makes shed traffic correlatable: the
             # client got it in X-Request-Id, this line carries it too.
             log.warning("request %s shed (queue full): %s", rid, e,
-                        extra={"request_id": rid})
+                        extra=trace.log_extra(rid))
             self._send_json(429, {"error": {"message": str(e)}},
                             {"Retry-After": str(int(e.retry_after_s))})
         except SchedulerRejected as e:
             # draining or unhealthy: 503 so balancers retry elsewhere
             log.warning("request %s shed (%s): %s", rid,
-                        e.__class__.__name__, e, extra={"request_id": rid})
+                        e.__class__.__name__, e, extra=trace.log_extra(rid))
             self._send_json(503, {"error": {"message": str(e)}},
                             {"Retry-After": str(int(e.retry_after_s))})
         except ClientDisconnected:
             log.info("client disconnected; request %s cancelled", rid,
-                     extra={"request_id": rid})
+                     extra=trace.log_extra(rid))
         except CLIENT_GONE:
             log.info("client connection lost mid-response (request %s)", rid,
-                     extra={"request_id": rid})
+                     extra=trace.log_extra(rid))
         except Exception:
             log.exception("completion %s failed", rid,
-                          extra={"request_id": rid})
+                          extra=trace.log_extra(rid))
             try:
                 self._send_json(500, {"error": {"message": "internal error"}})
             except CLIENT_GONE:
@@ -1458,7 +1470,7 @@ class RequestRoutes:
             # Client-safe exception types keep their message; anything else
             # is masked like the non-stream 500 path (no internals leak).
             log.exception("streamed completion %s failed mid-stream", rid,
-                          extra={"request_id": rid})
+                          extra=trace.log_extra(rid))
             msg = (str(e) if isinstance(e, (ApiError, SchedulerRejected))
                    else "internal error")
             err = {"message": msg or e.__class__.__name__,

@@ -1182,7 +1182,7 @@ class Scheduler:
                            reason=reason, tokens=req.produced)
         log.info("preempted request (reason=%s, %d tokens emitted; pages "
                  "stay referenced)", reason, req.produced,
-                 extra={"request_id": req.req_id})
+                 extra=trace.log_extra(req.req_id))
         return True
 
     def _maybe_preempt(self) -> None:
@@ -1379,7 +1379,7 @@ class Scheduler:
                                             start_pos=reuse, req_id=req.req_id)
             except Exception as e:  # bad request (too long, …) — fail just this one
                 log.exception("admission rejected",
-                              extra={"request_id": req.req_id})
+                              extra=trace.log_extra(req.req_id))
                 # the slot's cache state is unknown: a paged add_begin may
                 # have freed + partially reallocated its pages before
                 # failing (e.g. a pool.alloc fault mid-grow), so the old
@@ -1522,7 +1522,7 @@ class Scheduler:
                 self._commit_admission(req, adm, reuse)
             except Exception as e:
                 log.exception("commit failed",
-                              extra={"request_id": req.req_id})
+                              extra=trace.log_extra(req.req_id))
                 # _commit_admission pops up front, so the head here is the
                 # NEXT admission — pop only if the failure preceded the pop
                 if self._inflight and self._inflight[0][1] is adm:
@@ -1607,7 +1607,7 @@ class Scheduler:
                     self._commit_admission(req, adm, reuse)
             except Exception as e:
                 log.exception("prefill failed",
-                              extra={"request_id": req.req_id})
+                              extra=trace.log_extra(req.req_id))
                 # add_step failures leave the head in place; a commit
                 # failure reaches here with it already popped by
                 # _commit_admission — pop only our own tuple, never the
@@ -2117,7 +2117,7 @@ class Scheduler:
             if bad is not None and bad[slot]:
                 log.error("non-finite logits in decode chunk %d (slot %d); "
                           "failing the request, engine stays up",
-                          chunk.seq, slot, extra={"request_id": req.req_id})
+                          chunk.seq, slot, extra=trace.log_extra(req.req_id))
                 self.slot_tokens[slot] = []  # rows are poisoned: never reuse
                 req.finish_reason = "error"  # before the put (client-visible)
                 req.out.put(RuntimeError(

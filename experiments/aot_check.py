@@ -24,6 +24,9 @@ import sys
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# topology-AOT needs no TPU attached, and off GCP the instance-metadata
+# probe stalls through 30 failing fetches before libtpu gives up — skip it
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
 
 import jax
 import jax.numpy as jnp

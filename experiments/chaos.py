@@ -604,15 +604,105 @@ def run_mesh_chaos(n_replicas: int = 3, n_requests: int = 30, seed: int = 0,
         with mu:
             live = [p for p, proc in replicas.items() if proc.poll() is None]
         for p in live:
-            try:
-                st, body = get(p, "/debug/kv", timeout=30)
-                kv = json.loads(body)
-                audits[p] = kv.get("audit", {}).get("ok")
-                if st != 200 or audits[p] is not True:
-                    problems.append(f"replica :{p} KV audit not clean")
-            except (OSError, ValueError) as e:
-                problems.append(f"replica :{p} /debug/kv unreachable: {e!r}")
+            # a replica respawned late in the schedule may still be
+            # XLA-compiling (a cold serve boot is O(minutes) on CPU) —
+            # connection-refused within its boot window is "booting", not
+            # a violation, so give each live process the boot deadline
+            audit_deadline = time.monotonic() + boot_deadline_s
+            while True:
+                try:
+                    st, body = get(p, "/debug/kv", timeout=30)
+                    kv = json.loads(body)
+                    audits[p] = kv.get("audit", {}).get("ok")
+                    if st != 200 or audits[p] is not True:
+                        problems.append(f"replica :{p} KV audit not clean")
+                    break
+                except (OSError, ValueError) as e:
+                    with mu:
+                        gone = replicas[p].poll() is not None
+                    if gone or time.monotonic() > audit_deadline:
+                        problems.append(
+                            f"replica :{p} /debug/kv unreachable: {e!r}")
+                        break
+                    time.sleep(1.0)
         report["audits"] = audits
+
+        # ---- directed failover drill (ISSUE 19) -------------------------
+        # The random schedule alone rarely lands a SIGKILL mid-stream with
+        # an UNSATURATED survivor (a resume dispatched into a shedding
+        # degraded mesh exhausts its budget instead of resuming), so the
+        # cross-replica-trace assertion below would usually have no
+        # subject. Drill it deterministically on the HEALED mesh, after
+        # the random schedule has stopped: stream one request through the
+        # router, SIGKILL whichever replica holds it once content frames
+        # are on the wire, and require the stream to finish on the
+        # survivor. Running it last also means nothing can SIGKILL the
+        # survivor afterward and erase its tracer ring before the merged
+        # trace is read. The drill's `resumed` verdict lands in the same
+        # counters the reconciliation below scrapes.
+        drill_killed = {"port": None}
+
+        def _drill_assassin(n_frames):
+            if drill_killed["port"] is None and n_frames >= 3:
+                _st, body_r = get(rport, "/router/replicas")
+                for rr in json.loads(body_r)["replicas"]:
+                    if rr["inflight"] > 0:
+                        p = int(rr["id"].rsplit(":", 1)[1])
+                        with mu:
+                            replicas[p].kill()
+                        drill_killed["port"] = p
+                        return
+
+        if not wait_ready(boot_deadline_s, want_all=True):
+            problems.append("mesh never FULLY healed — the directed "
+                            "failover drill needs every replica back")
+        else:
+            drill_body = {"messages": [
+                              {"role": "system",
+                               "content": "mesh soak shared preamble drill"},
+                              {"role": "user",
+                               "content": "stream me a dozen tokens"}],
+                          "stream": True, "max_tokens": 12,
+                          "temperature": 0.0, "seed": seed + 7}
+            conn = http.client.HTTPConnection("127.0.0.1", rport,
+                                              timeout=120)
+            try:
+                conn.request("POST", "/v1/chat/completions",
+                             json.dumps(drill_body),
+                             {"Content-Type": "application/json",
+                              "X-Request-Id": "req-mesh-drill"})
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    resp.read()
+                    problems.append("failover drill shed on an idle mesh: "
+                                    f"{resp.status}")
+                else:
+                    raw = b""
+                    while True:
+                        chunk = resp.read1(65536)
+                        if not chunk:
+                            break
+                        raw += chunk
+                        _drill_assassin(raw.count(b"data: "))
+                    if drill_killed["port"] is None:
+                        problems.append(
+                            "failover drill never caught a replica inflight"
+                            " — the stream finished too fast to interrupt")
+                    elif (not raw.rstrip().endswith(b"data: [DONE]")
+                            or b'"error"' in raw):
+                        problems.append(
+                            "failover drill stream did not resume cleanly "
+                            f"on the survivor: {raw[-300:]!r}")
+            except (OSError, http.client.HTTPException) as e:
+                problems.append(f"failover drill stream broke: {e!r}")
+            finally:
+                conn.close()
+            if drill_killed["port"] is not None:
+                with mu:
+                    replicas[drill_killed["port"]].wait(timeout=10)
+                    replicas[drill_killed["port"]] = spawn_replica(
+                        drill_killed["port"])
+                chaos_log.append(("kill", drill_killed["port"]))
 
         # router counters reconcile with the client view: every
         # error-finished stream is exactly one exhausted/unresumable verdict
@@ -628,6 +718,68 @@ def run_mesh_chaos(n_replicas: int = 3, n_requests: int = 30, seed: int = 0,
                 f"({fov})")
         if fov.get("resumed", 0) > fov.get("retried", 0):
             problems.append(f"resumed > retried: {fov}")
+
+        # the fleet plane under fire (ISSUE 19): GET /router/fleet must
+        # tell the SAME failover story as the raw counters and the client
+        # view — its reconciliation block is only trustworthy if it holds
+        # while replicas are dying, not just in a quiet mesh
+        try:
+            st, fbody = get(rport, "/router/fleet", timeout=30)
+            fleet = json.loads(fbody) if st == 200 else {}
+        except (OSError, ValueError) as e:
+            st, fleet = 0, {}
+            problems.append(f"/router/fleet unreachable: {e!r}")
+        if st == 200:
+            fblock = fleet.get("fleet") or {}
+            ffov = fblock.get("failovers") or {}
+            report["fleet_failovers"] = ffov
+            for k in ("retried", "resumed", "exhausted", "unresumable"):
+                if ffov.get(k) != fov.get(k, 0):
+                    problems.append(
+                        f"/router/fleet failovers[{k}]={ffov.get(k)} "
+                        f"disagrees with /metrics ({fov.get(k, 0)})")
+            cerr = fblock.get("client_errors") or {}
+            if cerr.get("stream_error") != errors_seen:
+                problems.append(
+                    f"/router/fleet client_errors.stream_error="
+                    f"{cerr.get('stream_error')} != client-observed error "
+                    f"streams ({errors_seen})")
+        elif st:
+            problems.append(f"/router/fleet status {st}")
+
+        # the merged mesh trace must hold >= 1 CROSS-REPLICA resumed
+        # request: a `resume` span on the router track (pid 1) whose
+        # req_id also has events on a replica track (pid > 1 — the
+        # survivor; the original replica was SIGKILLed and respawned with
+        # an empty ring, so its leg is gone by design)
+        if fov.get("resumed", 0) < 1:
+            problems.append("fault schedule produced no resumed stream — "
+                            "the cross-replica trace check has no subject")
+        else:
+            try:
+                st, tbody = get(rport, "/router/trace", timeout=60)
+                merged = json.loads(tbody) if st == 200 else {}
+            except (OSError, ValueError) as e:
+                st, merged = 0, {}
+                problems.append(f"/router/trace unreachable: {e!r}")
+            evs = merged.get("traceEvents") or []
+            resumed_ids = {e.get("args", {}).get("req_id")
+                           for e in evs
+                           if e.get("name") == "resume"
+                           and e.get("pid") == 1
+                           and e.get("args", {}).get("req_id")}
+            cross = set()
+            for e in evs:
+                if (e.get("pid", 1) > 1 and e.get("ph") != "M"
+                        and e.get("args", {}).get("req_id") in resumed_ids):
+                    cross.add(e["args"]["req_id"])
+            report["trace_resumed_req_ids"] = len(resumed_ids)
+            report["trace_cross_replica_resumed"] = len(cross)
+            if st == 200 and not cross:
+                problems.append(
+                    "merged /router/trace has no cross-replica resumed "
+                    f"request (resume spans for {len(resumed_ids)} req_ids, "
+                    "none with replica-track events)")
 
         report["chaos_events"] = len(chaos_log)
         report["elapsed_s"] = round(time.monotonic() - t0, 2)

@@ -27,6 +27,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from dllama_tpu.ops.quant import Q_BLOCK, QTensor
 from dllama_tpu.ops.pallas import q40_matmul as qmod
+from dllama_tpu.ops.pallas.tiling import COMPILER_PARAMS
 from dllama_tpu.ops.pallas.tiling import pick_tile as _pick_tile
 
 # --smoke flips these: interpret-mode Pallas, 2 timing iters (see docstring)
@@ -98,7 +99,7 @@ def make_call(kernel, m, k, n, *, tiles=None, bf16=False):
         out_specs=pl.BlockSpec((tm, tn), lambda i, j, kb: (i, j)),
         out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
         scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=INTERPRET,

@@ -385,6 +385,26 @@ def run_loadgen_http(target: str, tenants: list[TenantSpec],
     with lock:
         all_f = list(flights)
     replicas = sorted({f.replica for f in all_f if f.replica})
+    # when the target is a router front, attach its client-seat SLO view
+    # (GET /router/fleet) so the generator's own measurements reconcile
+    # against what the router scored over the same window; a plain
+    # replica target has no such endpoint and the key stays None
+    router_view = None
+    try:
+        import http.client
+
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("GET", "/router/fleet")
+        resp = conn.getresponse()
+        body = resp.read()
+        conn.close()
+        if resp.status == 200:
+            fl_view = json.loads(body).get("fleet") or {}
+            router_view = {"client": fl_view.get("client"),
+                           "failovers": fl_view.get("failovers"),
+                           "client_errors": fl_view.get("client_errors")}
+    except (OSError, ValueError):
+        pass
     return {
         "seed": seed,
         "target": target,
@@ -400,6 +420,7 @@ def run_loadgen_http(target: str, tenants: list[TenantSpec],
                            "tokens": sum(f.tokens for f in all_f
                                          if f.replica == rid)}
                      for rid in replicas},
+        "router": router_view,
         "tok_s": round(sum(f.tokens for f in all_f) / max(wall, 1e-9), 3),
     }
 

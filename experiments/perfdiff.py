@@ -75,6 +75,21 @@ RULES: list[tuple[str, str, float]] = [
     # between the in-process replicas, so scaling is well under 2x)
     ("router.affinity.warm_ttft_ratio_on_off", "lower", 0.50),
     ("router.scale.agg_tok_s_ratio_2_1", "higher", 0.50),
+    # ISSUE 17 mesh observability: the full plane (trace minting + hop
+    # headers + router spans + postmortem journal) must stay ~free on the
+    # proxied path (ratio on/off is normalized; loose — CPU-fallback
+    # hosts time tiny bursts where scheduler jitter dominates), every
+    # scraped replica must land clock-ALIGNED in the merged trace
+    # (absolute invariant, unscaled ceiling like the ledger residual),
+    # and the federation scrape must stay cheap enough to poll
+    ("fleet_obs.tok_s_ratio_on_off", "higher", 0.50),
+    ("fleet_obs.trace.unaligned_replicas", "ceiling", 0.0),
+    ("fleet_obs.scrape.federated_ms_mean", "lower", 1.00),
+    # ISSUE 19 acceptance pin: federation + tracing + client SLO windows
+    # may cost the proxy hot path at most 3% (off/on best-of-3 alternating
+    # bursts — an UNSCALED ceiling, not a normalized ratio: 1.03 means
+    # 1.03x, on every host)
+    ("fleet_obs.proxy_overhead_x", "ceiling", 1.03),
     # ISSUE 9 radix record: warm TTFT must stay collapsed relative to cold
     # (ratio is normalized; loose tolerance — CPU hosts time compile-warm
     # suffix prefills against a chunked cold prefill)

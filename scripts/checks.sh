@@ -61,6 +61,13 @@ for name in metrics.REGISTRY.names():
 # ...and the failover / host-spill-tier series are what
 # scripts/failover_smoke.sh, the chaos mesh, and the test_paged_kv host
 # drills assert on (ISSUE 16): removal must fail here too
+# ...and the clock-offset / federation-scrape series are what
+# scripts/fleet_smoke.sh, the bench fleet_obs record, and the
+# test_fleet_obs merge/federation drills assert on (ISSUE 17): removal
+# must fail here too
+# ...and the scrape-staleness / client-seat SLO series are what the
+# federated /metrics staleness contract and GET /router/fleet
+# reconciliation stand on (ISSUE 19): removal must fail here too
 for name in ("dllama_kv_pages_total", "dllama_kv_pages_used",
              "dllama_kv_pages_shared",
              "dllama_radix_lookups_total", "dllama_radix_hit_tokens_total",
@@ -78,7 +85,13 @@ for name in ("dllama_kv_pages_total", "dllama_kv_pages_used",
              "dllama_replica_healthy", "dllama_frontend_connections",
              "dllama_router_failovers_total",
              "dllama_kv_host_pages_total", "dllama_kv_host_pages_used",
-             "dllama_kv_spill_total"):
+             "dllama_kv_spill_total",
+             "dllama_replica_clock_offset_seconds",
+             "dllama_replica_clock_uncertainty_seconds",
+             "dllama_router_federation_scrape_seconds",
+             "dllama_fleet_scrape_age_seconds",
+             "dllama_router_ttft_seconds", "dllama_router_itl_seconds",
+             "dllama_router_slo_attainment"):
     if name not in metrics.REGISTRY.names():
         missing.append(f"unregistered:{name}")
 for name in sorted(trace.SPAN_CATALOG):

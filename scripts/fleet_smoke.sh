@@ -1,0 +1,224 @@
+#!/usr/bin/env bash
+# fleet_smoke.sh — the ISSUE 17/19 acceptance drill: the fleet
+# observability plane survives a mid-stream SIGKILL failover over the
+# real wire.
+#
+# Boots TWO `python -m dllama_tpu serve` replicas (real CLI, tiny fixture
+# model, paged layout) plus one `python -m dllama_tpu router`, streams a
+# completion tagged with a client X-Request-Id, SIGKILLs the replica
+# serving it mid-stream, then — after the stream resumes and finishes on
+# the survivor — asserts the three observability surfaces reconcile:
+#
+#   1. GET /router/trace returns ONE merged Perfetto/Chrome file: the
+#      router's own track (pid 1) plus the survivor's offset-shifted
+#      track, timestamps globally sorted, the survivor's clock entry
+#      aligned within its NTP-lite uncertainty, and the drill's trace id
+#      tying spans on BOTH tracks — connect / proxy / failover.attempt /
+#      resume / journal on the router track and the survivor's own
+#      request span under the same id.
+#   2. GET /metrics (and its /router/metrics alias) parses as one
+#      exposition: survivor series relabeled replica="127.0.0.1:PORT",
+#      counters and histogram buckets pre-aggregated into dllama_fleet_*
+#      families, and a dllama_fleet_scrape_age_seconds staleness gauge
+#      per scraped replica.
+#   3. GET /router/requests/{rid} joins both legs under one trace id:
+#      forward -> died_mid_stream on the victim (unreachable, SIGKILLed),
+#      resume -> ok on the survivor, with the survivor's flight-recorder
+#      timeline showing the SAME req_id finished.
+#
+# SMOKE TARGET, not a pytest test (lives outside tests/, exempt from the
+# tier-1 run). CPU-only, ~2 min. Exit 0 = PASS.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import http.client
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.getcwd())
+from tests.test_serve import make_tiny_files  # the tier-1 fixture model
+
+tmp = tempfile.mkdtemp(prefix="dllama_fleet_smoke_")
+mpath, tpath, _cfg = make_tiny_files(__import__("pathlib").Path(tmp))
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+ports = [free_port(), free_port()]
+rport = free_port()
+
+replicas = {
+    p: subprocess.Popen(
+        [sys.executable, "-m", "dllama_tpu", "serve", "--model", mpath,
+         "--tokenizer", tpath, "--slots", "2", "--port", str(p),
+         "--kv-layout", "paged", "--page-size", "8", "--kv-pages", "56"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    for p in ports
+}
+router = subprocess.Popen(
+    [sys.executable, "-m", "dllama_tpu", "router", "--port", str(rport),
+     "--replica", f"127.0.0.1:{ports[0]}",
+     "--replica", f"127.0.0.1:{ports[1]}",
+     "--poll-s", "0.2", "--failover-max", "2", "--log-format", "json"],
+    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+RID = "req-fleet-smoke-1"
+
+
+def get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read().decode()
+    conn.close()
+    return r.status, body
+
+
+BODY = {"messages": [
+            {"role": "system", "content": "You are a terse assistant."},
+            {"role": "user", "content": "stream me a dozen tokens"}],
+        "stream": True, "max_tokens": 12, "temperature": 0.0, "seed": 11}
+
+
+def stream(port, body, on_frames=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", "/v1/chat/completions", json.dumps(body),
+                 {"Content-Type": "application/json", "X-Request-Id": RID})
+    resp = conn.getresponse()
+    assert resp.status == 200, f"stream -> {resp.status}: {resp.read()!r}"
+    raw = b""
+    while True:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        raw += chunk
+        if on_frames is not None:
+            on_frames(raw.count(b"data: "))
+    conn.close()
+    return raw.decode()
+
+
+procs = list(replicas.values()) + [router]
+try:
+    deadline = time.time() + 300  # two first-boot XLA compiles on CPU
+    while True:
+        try:
+            st, body = get(rport, "/router/replicas")
+            reps = json.loads(body)["replicas"] if st == 200 else []
+        except (OSError, ValueError):
+            reps = []
+        if len(reps) == 2 and all(r["ready"] and r["config_ok"]
+                                  for r in reps):
+            break
+        for proc in procs:
+            if proc.poll() is not None:
+                sys.exit("FAIL: a process exited before the mesh was ready")
+        if time.time() > deadline:
+            sys.exit("FAIL: router mesh never became ready")
+        time.sleep(0.25)
+
+    # the drill: SIGKILL whichever replica holds the inflight stream the
+    # moment real content frames are on the wire
+    killed = {"port": None}
+
+    def assassin(n_frames):
+        if killed["port"] is None and n_frames >= 3:
+            st, body = get(rport, "/router/replicas")
+            for r in json.loads(body)["replicas"]:
+                if r["inflight"] > 0:
+                    p = int(r["id"].rsplit(":", 1)[1])
+                    replicas[p].kill()
+                    killed["port"] = p
+                    return
+
+    raw = stream(rport, BODY, on_frames=assassin)
+    assert killed["port"] is not None, (
+        "the drill never found an inflight replica to SIGKILL — "
+        "the stream finished too fast to interrupt")
+    replicas[killed["port"]].wait(timeout=10)
+    assert raw.rstrip().endswith("data: [DONE]"), "stream never finished"
+    survivor = next(p for p in ports if p != killed["port"])
+    victim_rid = f"127.0.0.1:{killed['port']}"
+    survivor_rid = f"127.0.0.1:{survivor}"
+
+    # (3 first — it hands us the trace id) cross-hop postmortem join
+    st, body = get(rport, f"/router/requests/{RID}")
+    assert st == 200, f"/router/requests/{RID} -> {st}"
+    pm = json.loads(body)
+    tid = pm["trace_id"]
+    assert tid and len(tid) == 16, f"postmortem trace id malformed: {tid!r}"
+    rec = pm["router"]
+    assert rec["outcome"] == "ok" and rec["stream"] is True, rec
+    kinds = [(a["kind"], a["outcome"], a["replica"])
+             for a in rec["attempts"]]
+    assert ("forward", "died_mid_stream", victim_rid) in kinds, kinds
+    assert ("resume", "ok", survivor_rid) in kinds, kinds
+    assert pm["replicas"][victim_rid] == {"error": "unreachable"}, (
+        pm["replicas"][victim_rid])
+    leg = pm["replicas"][survivor_rid]
+    assert leg.get("req_id") == RID and leg.get("state") == "finished", leg
+
+    # (1) ONE merged Perfetto trace, offset-aligned, one trace id across
+    # both the router track and the survivor's shifted track
+    st, body = get(rport, "/router/trace")
+    assert st == 200, f"/router/trace -> {st}"
+    merged = json.loads(body)
+    other = merged["otherData"]
+    assert other["replicas_merged"] >= 1, other  # victim is dead
+    clk = other["clock"][survivor_rid]
+    assert clk["aligned"] is True, clk
+    assert abs(clk["offset_s"]) <= max(clk["uncertainty_s"], 0.5), clk
+    events = merged["traceEvents"]
+    body_ts = [e["ts"] for e in events if e.get("ph") != "M"]
+    assert body_ts == sorted(body_ts), "merged trace not globally sorted"
+    ours = [e for e in events
+            if (e.get("args") or {}).get("trace_id") == tid]
+    pids = {e["pid"] for e in ours}
+    assert 1 in pids and any(p > 1 for p in pids), (
+        f"trace {tid} missing a router or replica leg: pids={pids}")
+    router_names = {e["name"] for e in ours if e["pid"] == 1}
+    for want in ("connect", "proxy", "failover.attempt", "resume",
+                 "journal"):
+        assert want in router_names, (want, router_names)
+
+    # (2) federated exposition: relabeled survivor series + fleet rollups
+    # + per-replica scrape staleness (the victim, SIGKILLed mid-scrape
+    # cadence, must read STALE via its last-known series — not vanish)
+    st, mtext = get(rport, "/metrics")
+    assert st == 200, f"/metrics -> {st}"
+    assert f'replica="{survivor_rid}"' in mtext, (
+        "survivor series not relabeled")
+    assert "dllama_fleet_" in mtext, "no pre-aggregated fleet families"
+    assert (f'dllama_fleet_scrape_age_seconds{{replica="{survivor_rid}"}}'
+            in mtext), "no staleness gauge for the survivor"
+    assert mtext.endswith("\n"), "exposition must end with a newline"
+    st2, mtext2 = get(rport, "/router/metrics")
+    assert st2 == 200, "/router/metrics alias gone"
+
+    # fleet join sees the survivor's clock too
+    st, body = get(rport, "/router/fleet")
+    fleet = json.loads(body)
+    assert st == 200 and fleet["fleet"]["replicas"] == 2, fleet
+    surv = next(r for r in fleet["replicas"] if r["id"] == survivor_rid)
+    assert surv["clock"] is not None, surv
+
+    print(f"PASS: fleet smoke OK — SIGKILL of :{killed['port']} mid-stream; "
+          f"postmortem joined forward/died_mid_stream + resume/ok under "
+          f"trace {tid}; merged trace carries both legs "
+          f"(pids={sorted(pids)}) with survivor clock offset "
+          f"{clk['offset_s']:+.4f}s (±{clk['uncertainty_s']:.4f}s); "
+          f"federation relabeled replica=\"{survivor_rid}\"")
+finally:
+    for proc in procs:
+        if proc.poll() is None:
+            proc.kill()
+PY

@@ -1,0 +1,572 @@
+"""Fleet observability plane tests (ISSUE 19): NTP-lite clock-offset
+estimation, clock-aligned Chrome-trace merging, metrics federation
+(relabel + exact counter/histogram fleet rollups + staleness, checked
+under the same exposition grammar as test_metrics), client-perspective
+router SLO windows, distributed trace propagation through the router's
+failover path, and the cross-hop postmortem join — all against the
+controllable stub replicas from test_router (the real-engine e2e lives
+there, next to the real mesh fixture)."""
+
+import http.client
+import json
+import random
+import threading
+import time
+
+import pytest
+
+from dllama_tpu.obs import instruments as ins
+from dllama_tpu.obs import metrics, trace
+from dllama_tpu.obs.perf import ClockOffset, SloPolicy
+from dllama_tpu.serve.router import Router, federate, make_router
+from tests.test_metrics import check_histogram, parse_exposition
+from tests.test_router import (SHARED, StubState, make_stub, rget, rpost,
+                               sse_events)
+
+
+# ------------------------------------------------------ clock offset (unit)
+
+
+def test_clock_offset_empty_and_basic():
+    co = ClockOffset()
+    assert co.estimate() is None
+    # symmetric exchange: remote read at the midpoint -> exact recovery
+    skew, rtt = 3.25, 0.050
+    co.sample(100.0, 100.0 + rtt, (100.0 + 100.0 + rtt) / 2.0 + skew)
+    est = co.estimate()
+    assert est["samples"] == 1
+    assert est["offset_s"] == pytest.approx(skew)
+    assert est["rtt_s"] == pytest.approx(rtt)
+    assert est["uncertainty_s"] == pytest.approx(rtt / 2.0)
+
+
+def test_clock_offset_min_rtt_sample_wins():
+    """Queue-polluted exchanges carry the worst offset error — the window
+    estimate must come from the tightest round trip, and the true offset
+    must sit inside its +/- rtt/2 bound."""
+    skew = 4.0
+    co = ClockOffset()
+    # (outbound delay, inbound delay): asymmetric pairs skew the estimate
+    # by (d1 - d2) / 2, always within rtt / 2
+    for d1, d2 in [(0.200, 0.010), (0.002, 0.001), (0.050, 0.400)]:
+        t_send = 50.0
+        t_recv = t_send + d1 + d2
+        co.sample(t_send, t_recv, t_send + d1 + skew)
+    est = co.estimate()
+    assert est["rtt_s"] == pytest.approx(0.003)  # the tight exchange
+    assert abs(est["offset_s"] - skew) <= est["uncertainty_s"]
+    assert est["samples"] == 3
+
+
+def test_clock_offset_window_slides():
+    co = ClockOffset(window=4)
+    co.sample(0.0, 0.001, 0.0005 + 1.0)          # tight, offset 1.0
+    for i in range(4):                            # ...evicted by 4 loose
+        co.sample(10.0, 10.5, 10.25 + 2.0)
+    est = co.estimate()
+    assert est["samples"] == 4
+    assert est["offset_s"] == pytest.approx(2.0)
+    assert est["uncertainty_s"] == pytest.approx(0.25)
+
+
+# --------------------------------------------------------- trace merge (unit)
+
+
+def _export(track, events):
+    return {"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "dllama-tpu"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 1,
+         "args": {"name": track}},
+    ] + events, "displayTimeUnit": "ms"}
+
+
+def test_merge_chrome_relabels_shifts_and_sorts():
+    a = _export("router", [
+        {"ph": "X", "name": "proxy.stream", "pid": 1, "tid": 1,
+         "ts": 100.0, "dur": 50.0, "args": {}},
+        {"ph": "i", "name": "affinity.pick", "pid": 1, "tid": 1,
+         "ts": 500.0, "s": "t", "args": {}},
+    ])
+    b = _export("scheduler", [
+        {"ph": "X", "name": "prefill", "pid": 1, "tid": 1,
+         "ts": 200.0, "dur": 10.0, "args": {}},
+        {"ph": "X", "name": "request", "pid": 1, "tid": 1,
+         "ts": 200.0, "dur": 90.0, "args": {}},
+    ])
+    merged = trace.merge_chrome([("router", a, 0.0), ("r1", b, -1100.0)])
+    meta = [e for e in merged["traceEvents"] if e.get("ph") == "M"]
+    body = [e for e in merged["traceEvents"] if e.get("ph") != "M"]
+    # each part became its own Perfetto process, renamed to its label
+    procs = {e["pid"]: e["args"]["name"] for e in meta
+             if e["name"] == "process_name"}
+    assert procs == {1: "router", 2: "r1"}
+    # shift applied to non-meta events only; meta keeps no ts
+    by = {(e["pid"], e["name"]): e for e in body}
+    assert by[(2, "prefill")]["ts"] == pytest.approx(-900.0)
+    assert by[(1, "proxy.stream")]["ts"] == pytest.approx(100.0)
+    # global (ts, -dur) order: parent-before-child at equal start
+    keyed = [(e["ts"], -e.get("dur", 0.0)) for e in body]
+    assert keyed == sorted(keyed)
+    assert [e["name"] for e in body[:2]] == ["request", "prefill"]
+
+
+def test_merge_chrome_tolerates_empty_parts():
+    merged = trace.merge_chrome([("router", {}, 0.0),
+                                 ("r1", {"traceEvents": []}, 5.0)])
+    assert merged["traceEvents"] == []
+
+
+def test_merge_chrome_real_tracers_stay_monotone():
+    t1, t2 = trace.Tracer(64), trace.Tracer(64)
+    now = time.monotonic()
+    t1.span_at("request", now, now + 0.01, track="requests", req_id="r1")
+    t2.span_at("prefill", now, now + 0.002, track="requests", req_id="r1")
+    t2.event("first_token", track="requests", req_id="r1")
+    merged = trace.merge_chrome([
+        ("router", t1.export_chrome(), 0.0),
+        ("rep", t2.export_chrome(), (t2.epoch - t1.epoch) * 1e6),
+    ])
+    body = [e for e in merged["traceEvents"] if e.get("ph") != "M"]
+    assert len(body) == 3
+    assert [e["ts"] for e in body] == sorted(e["ts"] for e in body)
+
+
+# --------------------------------------------------------- federation (unit)
+
+
+R1_TEXT = """# HELP dllama_requests_finished_total finished requests
+# TYPE dllama_requests_finished_total counter
+dllama_requests_finished_total{reason="stop"} 3
+dllama_requests_finished_total{reason="error"} 1
+# HELP dllama_ttft_seconds time to first token
+# TYPE dllama_ttft_seconds histogram
+dllama_ttft_seconds_bucket{le="0.1"} 1
+dllama_ttft_seconds_bucket{le="+Inf"} 2
+dllama_ttft_seconds_sum 0.35
+dllama_ttft_seconds_count 2
+# HELP dllama_queue_depth queued requests
+# TYPE dllama_queue_depth gauge
+dllama_queue_depth 0
+"""
+
+R2_TEXT = """# HELP dllama_requests_finished_total finished requests
+# TYPE dllama_requests_finished_total counter
+dllama_requests_finished_total{reason="stop"} 4
+"""
+
+OWN_TEXT = """# HELP dllama_router_requests_total proxied requests
+# TYPE dllama_router_requests_total counter
+dllama_router_requests_total{outcome="ok"} 7
+"""
+
+
+def test_federate_relabels_and_preaggregates():
+    out = federate(OWN_TEXT, [("r1", R1_TEXT), ("r2", R2_TEXT)])
+    fams, samples = parse_exposition(out)  # full grammar gate
+    # the router's own series stay unlabeled — it IS the scrape target
+    assert samples[("dllama_router_requests_total", '{outcome="ok"}')] == 7
+    # every replica series gained a LEADING replica label
+    assert samples[("dllama_requests_finished_total",
+                    '{replica="r1",reason="stop"}')] == 3
+    assert samples[("dllama_requests_finished_total",
+                    '{replica="r2",reason="stop"}')] == 4
+    assert samples[("dllama_queue_depth", '{replica="r1"}')] == 0
+    # one HELP/TYPE block per family, kinds preserved
+    assert fams["dllama_requests_finished_total"] == "counter"
+    assert fams["dllama_queue_depth"] == "gauge"
+    assert fams["dllama_ttft_seconds"] == "histogram"
+    # histogram invariants survive the relabel
+    check_histogram(samples, "dllama_ttft_seconds")
+    # counters pre-aggregated across replicas, keyed by original labels
+    assert fams["dllama_fleet_requests_finished_total"] == "counter"
+    assert samples[("dllama_fleet_requests_finished_total",
+                    '{reason="stop"}')] == 7
+    assert samples[("dllama_fleet_requests_finished_total",
+                    '{reason="error"}')] == 1
+    # histograms merged BUCKET-WISE into the fleet view (ISSUE 19 —
+    # exact, buckets are fixed per family); only r1 exposes this one
+    assert fams["dllama_fleet_ttft_seconds"] == "histogram"
+    assert samples[("dllama_fleet_ttft_seconds_bucket", '{le="0.1"}')] == 1
+    assert samples[("dllama_fleet_ttft_seconds_bucket", '{le="+Inf"}')] == 2
+    assert samples[("dllama_fleet_ttft_seconds_sum", "")] == 0.35
+    assert samples[("dllama_fleet_ttft_seconds_count", "")] == 2
+    check_histogram(samples, "dllama_fleet_ttft_seconds")
+    # gauges are NOT naively summed into the fleet view (a sum of queue
+    # depths sampled at different instants is not a fleet queue depth)
+    assert not any(n.startswith("dllama_fleet_queue_depth")
+                   for n, _ in samples)
+
+
+def test_federate_drops_garbage_keeps_rest():
+    noisy = "garbage not a metric !!\n" + R2_TEXT + "also&bad 1\n"
+    out = federate(OWN_TEXT, [("r2", noisy)])
+    fams, samples = parse_exposition(out)
+    assert samples[("dllama_requests_finished_total",
+                    '{replica="r2",reason="stop"}')] == 4
+    assert "garbage" not in out
+
+
+def test_histogram_federation_equals_union_registry():
+    """ISSUE 19 property test: bucket-wise merge of N scraped exposition
+    texts is EXACTLY the histogram a single registry observing the union
+    stream would render — same buckets, same sums, same counts, not
+    approximately. Observations are dyadic rationals (k/1024) so float
+    addition is exact and the equality really is ==, independent of the
+    order replicas happened to see their shares of the stream."""
+    buckets = (0.25, 0.5, 1.0, 2.0)
+    regs = [metrics.Registry() for _ in range(3)]
+    union = metrics.Registry()
+    hs = [r.histogram("dllama_lat_seconds", "latency", ("kind",),
+                      buckets=buckets) for r in regs]
+    hu = union.histogram("dllama_lat_seconds", "latency", ("kind",),
+                         buckets=buckets)
+    rnd = random.Random(0xF1EE7)
+    for _ in range(600):
+        v = rnd.randrange(0, 4096) / 1024.0
+        kind = ("prefill", "decode")[rnd.randrange(2)]
+        hs[rnd.randrange(3)].labels(kind=kind).observe(v)
+        hu.labels(kind=kind).observe(v)
+    out = federate("", [(f"r{i}", r.render())
+                        for i, r in enumerate(regs)])
+    fams, samples = parse_exposition(out)
+    assert fams["dllama_fleet_lat_seconds"] == "histogram"
+    check_histogram(samples, "dllama_fleet_lat_seconds")
+    _, want = parse_exposition(union.render())
+    for (name, lbl), v in want.items():
+        assert name.startswith("dllama_lat_seconds")
+        fleet_key = ("dllama_fleet_" + name[len("dllama_"):], lbl)
+        assert samples[fleet_key] == v, (fleet_key, samples[fleet_key], v)
+    # ...and nothing beyond the union's sample set was invented
+    n_fleet = sum(1 for n, _ in samples
+                  if n.startswith("dllama_fleet_lat_seconds"))
+    assert n_fleet == len(want)
+
+
+# ----------------------------------------------------- router wiring (stubs)
+
+
+@pytest.fixture
+def obs_mesh():
+    """Two stub replicas with SKEWED reported clocks behind a started
+    router (poller inert at poll_s=30 — tests drive _poll_one directly)."""
+    a, b = StubState("stub-a"), StubState("stub-b")
+    a.clock_skew, b.clock_skew = 2.5, -1.25
+    ha, hb = make_stub(a), make_stub(b)
+    server, router = make_router(
+        [f"127.0.0.1:{ha.server_address[1]}",
+         f"127.0.0.1:{hb.server_address[1]}"],
+        poll_s=30.0)
+    router.start()
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield server.server_address[1], router, (a, b), (ha, hb)
+    router.stop()
+    server.shutdown()
+    server.server_close()
+    for h in (ha, hb):
+        try:
+            h.shutdown()
+            h.server_close()
+        except OSError:
+            pass
+
+
+def test_poller_estimates_skewed_clocks(obs_mesh):
+    port, router, (a, b), _ = obs_mesh
+    a.trace_epoch = 123.5
+    for rep in router.replicas:
+        for _ in range(3):
+            router._poll_one(rep)
+    # router-side replica ids are addresses, in --replica order: a then b
+    ra, rb = router.replicas
+    ea, eb = ra.clock.estimate(), rb.clock.estimate()
+    # loopback rtt is sub-millisecond; the scripted skews dominate
+    assert ea["offset_s"] == pytest.approx(2.5, abs=0.2)
+    assert eb["offset_s"] == pytest.approx(-1.25, abs=0.2)
+    assert ra.trace_epoch == 123.5 and rb.trace_epoch is None
+    assert ins.REPLICA_CLOCK_OFFSET.labels(
+        replica=ra.rid).value() == pytest.approx(ea["offset_s"])
+    assert ins.REPLICA_CLOCK_UNCERTAINTY.labels(
+        replica=rb.rid).value() == pytest.approx(eb["uncertainty_s"])
+    # the offset rides the health snapshot into /health and /router/fleet
+    st, data = rget(port, "/health")
+    reps = {r["id"]: r for r in json.loads(data)["replicas"]}
+    assert reps[ra.rid]["clock"]["offset_s"] == pytest.approx(
+        ea["offset_s"])
+
+
+def test_fleet_obs_off_disables_clock_and_tracer(obs_mesh):
+    _, router, (a, b), (ha, hb) = obs_mesh
+    r2 = Router([f"127.0.0.1:{ha.server_address[1]}"], poll_s=30.0,
+                fleet_obs=False)
+    assert r2.tracer is trace.NULL_TRACER
+    r2._poll_one(r2.replicas[0])
+    assert r2.replicas[0].live
+    assert r2.replicas[0].clock.estimate() is None
+
+
+def test_merged_trace_shifts_replica_onto_router_clock(obs_mesh):
+    port, router, (a, b), _ = obs_mesh
+    a.trace_epoch = 777.0
+    a.trace_export = _export("scheduler", [
+        {"ph": "X", "name": "request", "pid": 1, "tid": 1, "ts": 1000.0,
+         "dur": 40.0, "args": {"req_id": "req-x", "trace_id": "ab" * 8}},
+    ])
+    # b leaves trace_export=None -> its /debug/trace 404s -> skipped
+    for rep in router.replicas:
+        for _ in range(3):
+            router._poll_one(rep)
+    # a proxied request puts the router's own spans on the merged timeline
+    st, _, _ = rpost(port, "/v1/chat/completions",
+                     {"messages": SHARED, "max_tokens": 4})
+    assert st == 200
+    st, data = rget(port, "/router/trace")
+    assert st == 200
+    merged = json.loads(data)
+    other = merged["otherData"]
+    assert other["replicas_merged"] == 1
+    clk = other["clock"][router.replicas[0].rid]
+    assert clk["aligned"] is True
+    assert clk["trace_epoch_s"] == 777.0
+    # shift = (epoch_replica - offset - epoch_router) us, offset ~ skew
+    want = (777.0 - clk["offset_s"] - other["router_epoch_s"]) * 1e6
+    assert clk["shift_us"] == pytest.approx(want, abs=1.0)
+    body = [e for e in merged["traceEvents"] if e.get("ph") != "M"]
+    rep_ev = next(e for e in body if e["name"] == "request")
+    assert rep_ev["pid"] == 2
+    assert rep_ev["ts"] == pytest.approx(1000.0 + clk["shift_us"], abs=1.0)
+    router_names = {e["name"] for e in body if e["pid"] == 1}
+    assert {"connect", "affinity.pick"} <= router_names
+    assert [e["ts"] for e in body] == sorted(e["ts"] for e in body)
+
+
+def test_router_metrics_endpoint_federates(obs_mesh):
+    port, router, (a, b), _ = obs_mesh
+    a.metrics_text = R1_TEXT
+    scraped0 = (metrics.REGISTRY.sample(
+        "dllama_router_federation_scrape_seconds") or {"count": 0})["count"]
+    st, _, _ = rpost(port, "/v1/chat/completions",
+                     {"messages": SHARED, "max_tokens": 4})
+    assert st == 200
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", "/router/metrics")
+    resp = conn.getresponse()
+    ctype, text = resp.getheader("Content-Type"), resp.read().decode()
+    conn.close()
+    assert resp.status == 200 and "text/plain" in ctype
+    fams, samples = parse_exposition(text)  # the scraper's grammar gate
+    ra, rb = router.replicas
+    # the router's own registry stays unlabeled (it IS the scrape target):
+    # the process self-gauges refreshed by the federation pass, plus its
+    # proxied-request counters, appear without a leading replica tag
+    assert any(n.startswith("dllama_process_") and not lbl
+               for n, lbl in samples)
+    # both stubs relabeled into the same exposition
+    assert samples[("dllama_requests_finished_total",
+                    f'{{replica="{ra.rid}",reason="stop"}}')] == 3
+    assert ("dllama_stub_requests_total", f'{{replica="{rb.rid}"}}') \
+        in samples
+    check_histogram(samples, "dllama_ttft_seconds")
+    assert samples[("dllama_fleet_requests_finished_total",
+                    '{reason="stop"}')] == 3
+    scraped1 = metrics.REGISTRY.sample(
+        "dllama_router_federation_scrape_seconds")["count"]
+    assert scraped1 == scraped0 + 1
+
+
+def test_federation_staleness_holds_last_scrape(obs_mesh):
+    """ISSUE 19 staleness contract: a replica the scrape can't reach keeps
+    federating its LAST successful exposition — a dead replica must read
+    STALE (age gauge growing), never as traffic dropping to zero."""
+    port, router, (a, b), (ha, hb) = obs_mesh
+    a.metrics_text = R1_TEXT
+    ra, rb = router.replicas
+    st, text = rget(port, "/metrics")  # the default route IS the fleet view
+    assert st == 200
+    fams, samples = parse_exposition(text.decode())
+    assert fams["dllama_fleet_scrape_age_seconds"] == "gauge"
+    age_a0 = samples[("dllama_fleet_scrape_age_seconds",
+                      f'{{replica="{ra.rid}"}}')]
+    assert age_a0 == pytest.approx(0.0, abs=0.5)
+    # kill replica a outright: its counters must HOLD last-known values
+    ha.shutdown()
+    ha.server_close()
+    time.sleep(0.05)
+    st, text = rget(port, "/metrics")
+    assert st == 200
+    fams, samples = parse_exposition(text.decode())
+    assert samples[("dllama_requests_finished_total",
+                    f'{{replica="{ra.rid}",reason="stop"}}')] == 3
+    assert samples[("dllama_fleet_requests_finished_total",
+                    '{reason="stop"}')] == 3
+    age_a1 = samples[("dllama_fleet_scrape_age_seconds",
+                      f'{{replica="{ra.rid}"}}')]
+    age_b1 = samples[("dllama_fleet_scrape_age_seconds",
+                      f'{{replica="{rb.rid}"}}')]
+    assert age_a1 > age_a0 and age_a1 > age_b1
+    assert age_b1 == pytest.approx(0.0, abs=0.5)
+
+
+def test_router_client_slo_windows_and_attainment(obs_mesh):
+    """Client-perspective SLO scoring is judged at the ROUTER, with its
+    own targets: per-replica and fleet windows, attainment = ok/finished,
+    NaN (unknown) on a drained window — never 1.0 by absence."""
+    _, _, (a, b), (ha, hb) = obs_mesh
+    r2 = Router([f"127.0.0.1:{ha.server_address[1]}",
+                 f"127.0.0.1:{hb.server_address[1]}"], poll_s=30.0,
+                slo=SloPolicy(ttft_ms=100.0, itl_ms=50.0))
+    rid0, rid1 = (rep.rid for rep in r2.replicas)
+    r2.observe_client(rid0, 0.050, 0.010)   # both kinds under target
+    r2.observe_client(rid0, 0.250)          # TTFT blown, ITL unknowable
+    r2.observe_client(rid1, None, 0.020)    # ITL-only, met
+    snap = r2._client_snapshot("fleet")
+    assert snap["window_finished"] == 3
+    assert snap["attainment"] == pytest.approx(2 / 3)
+    assert snap["ttft_ms"]["count"] == 2
+    assert snap["ttft_ms"]["p95"] == pytest.approx(250.0, abs=10.0)
+    assert snap["itl_ms"]["count"] == 2
+    assert snap["targets"] == {"ttft_ms": 100.0, "itl_ms": 50.0}
+    s0 = r2._client_snapshot(rid0)
+    assert s0["window_finished"] == 2
+    assert s0["attainment"] == pytest.approx(0.5)
+    r2.refresh_client_gauges()
+    assert ins.ROUTER_SLO_ATTAINMENT.labels(
+        replica="fleet").value() == pytest.approx(2 / 3)
+    # an unknown replica key is dropped, not created: the window dict is
+    # pre-populated at init and never mutated (lock-free reads)
+    r2.observe_client("nobody", 0.010)
+    assert set(r2._client) == {"fleet", rid0, rid1}
+    # a drained/empty window publishes NaN, not a perfect score
+    r3 = Router(["127.0.0.1:1"], poll_s=30.0)
+    r3.refresh_client_gauges()
+    v = ins.ROUTER_SLO_ATTAINMENT.labels(replica="fleet").value()
+    assert v != v  # NaN
+
+
+def test_router_fleet_endpoint_joins_health_and_clock(obs_mesh):
+    port, router, (a, b), _ = obs_mesh
+    for rep in router.replicas:
+        router._poll_one(rep)
+    st, data = rget(port, "/router/fleet")
+    assert st == 200
+    fleet = json.loads(data)
+    assert fleet["mesh"]["model"] == "stub-model"
+    assert fleet["fleet"]["replicas"] == 2
+    assert fleet["fleet"]["live"] == 2 and fleet["fleet"]["scraped"] == 2
+    ra = router.replicas[0]
+    reps = {r["id"]: r for r in fleet["replicas"]}
+    assert reps[ra.rid]["clock"]["offset_s"] == pytest.approx(2.5, abs=0.2)
+    # stubs expose no /debug/perf|kv|radix: the view degrades, not 500s
+    assert reps[ra.rid]["slo"] is None and reps[ra.rid]["kv"] is None
+    assert fleet["fleet"]["throughput_tok_s"] == 0.0
+    assert fleet["fleet"]["slo_attainment"] is None
+    # ISSUE 19 reconciliation surfaces: client-seat windows per replica
+    # and fleet-wide, plus failover counters vs client-observed errors
+    assert reps[ra.rid]["client"]["window_finished"] == 0
+    assert fleet["fleet"]["client"]["attainment"] is None
+    assert set(fleet["fleet"]["failovers"]) == {
+        "retried", "resumed", "exhausted", "unresumable"}
+    assert set(fleet["fleet"]["client_errors"]) == {
+        "stream_error", "shed", "upstream_error"}
+
+
+def _stream_with_rid(port, body, rid, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", "/v1/chat/completions", json.dumps(body),
+                 {"Content-Type": "application/json", "X-Request-Id": rid})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    raw = resp.read().decode()
+    conn.close()
+    return raw
+
+
+def test_trace_propagation_and_postmortem_across_failover(obs_mesh):
+    """The heart of the tentpole: the victim leg and the resumed leg ride
+    ONE trace id (hop header, hop count incrementing), and the postmortem
+    join reconstructs the whole story — forward died, resume succeeded —
+    with each replica's own timeline attached."""
+    port, router, (a, b), (ha, hb) = obs_mesh
+    addrs = {a.rid: f"127.0.0.1:{ha.server_address[1]}",
+             b.rid: f"127.0.0.1:{hb.server_address[1]}"}
+    st, _, h1 = rpost(port, "/v1/chat/completions",
+                      {"messages": SHARED, "max_tokens": 4})
+    victim, survivor = (a, b) if h1["X-Replica-Id"] == "stub-a" else (b, a)
+    victim.abort_after = 2
+    rid = "req-obs-failover"
+    victim.timelines[rid] = {"req_id": rid, "state": "died",
+                             "decode_tokens": 2}
+    # survivor's leg left unset -> its join degrades to {"error": ...}
+    raw = _stream_with_rid(port, {"messages": SHARED, "stream": True,
+                                  "max_tokens": 8}, rid)
+    assert raw.rstrip().splitlines()[-1] == "data: [DONE]"
+    finishes = [e["choices"][0].get("finish_reason")
+                for e in sse_events(raw) if "choices" in e]
+    assert [f for f in finishes if f] == ["stop"]
+
+    # hop headers: same trace id on both legs, hop count incremented,
+    # resume leg parented under the resume span
+    vh = victim.header_log[-1]["x-dllama-trace"]
+    sh = survivor.header_log[-1]["x-dllama-trace"]
+    v_tid, v_parent, v_hop = trace.parse_hop(vh)
+    s_tid, s_parent, s_hop = trace.parse_hop(sh)
+    assert v_tid == s_tid and len(v_tid) == 16
+    assert (v_parent, v_hop) == ("connect", 1)
+    assert (s_parent, s_hop) == ("resume", 2)
+
+    # cross-hop postmortem join
+    st, data = rget(port, f"/router/requests/{rid}")
+    assert st == 200
+    pm = json.loads(data)
+    assert pm["trace_id"] == v_tid
+    rec = pm["router"]
+    assert rec["stream"] is True and rec["outcome"] == "ok"
+    assert rec["retries"] == 1
+    kinds = [(x["kind"], x["outcome"]) for x in rec["attempts"]]
+    assert ("forward", "died_mid_stream") in kinds
+    assert ("resume", "ok") in kinds
+    at = [x["at_ms"] for x in rec["attempts"]]
+    assert at == sorted(at)
+    assert pm["replicas"][addrs[victim.rid]] == {
+        "req_id": rid, "state": "died", "decode_tokens": 2}
+    assert pm["replicas"][addrs[survivor.rid]] == {"error": "status 404"}
+
+    # the router's own trace shows both legs under the one trace id
+    st, data = rget(port, "/router/trace")
+    merged = json.loads(data)
+    mine = [e for e in merged["traceEvents"] if e.get("ph") != "M"
+            and e.get("args", {}).get("trace_id") == v_tid]
+    names = {e["name"] for e in mine}
+    assert {"connect", "proxy", "failover.attempt", "resume"} <= names
+    # the journal span closes the request's router-side story
+    journal = next(e for e in mine if e["name"] == "journal")
+    assert journal["args"]["retries"] == 1
+
+    # unknown ids 404, never 500
+    st, data = rget(port, "/router/requests/req-nope")
+    assert st == 404
+
+
+def test_non_stream_and_shed_outcomes_recorded(obs_mesh):
+    port, router, (a, b), _ = obs_mesh
+    rid = "req-obs-plain"
+    st, _, _ = rpost(port, "/v1/chat/completions",
+                     {"messages": SHARED, "max_tokens": 4},
+                     headers={"X-Request-Id": rid})
+    assert st == 200
+    st, data = rget(port, f"/router/requests/{rid}")
+    pm = json.loads(data)
+    assert pm["router"]["outcome"] == "ok" and pm["router"]["stream"] is False
+    assert [x["kind"] for x in pm["router"]["attempts"]] == ["forward"]
+    # the non-stream round trip fed the router's client-seat TTFT window
+    snap = router._client_snapshot("fleet")
+    assert snap["ttft_ms"]["count"] >= 1 and snap["window_finished"] >= 1
+
+    a.saturated = b.saturated = True
+    rid2 = "req-obs-shed"
+    st, _, _ = rpost(port, "/v1/chat/completions",
+                     {"messages": SHARED, "max_tokens": 4},
+                     headers={"X-Request-Id": rid2})
+    assert st == 429
+    st, data = rget(port, f"/router/requests/{rid2}")
+    assert json.loads(data)["router"]["outcome"] == "shed"

@@ -35,6 +35,13 @@ class StubState:
         self.resume_overlap = 0     # resume: re-emit N already-journaled
         #                             frames (drills the dedup seam)
         self.served = []            # parsed bodies, in arrival order
+        # mesh observability surface (ISSUE 17)
+        self.clock_skew = 0.0       # seconds added to the reported clock
+        self.trace_epoch = None     # /health clock.trace_epoch_s
+        self.metrics_text = None    # /metrics body (None = tiny default)
+        self.trace_export = None    # /debug/trace payload (None = 404)
+        self.timelines = {}         # req_id -> /debug/requests/{id} payload
+        self.header_log = []        # inbound POST headers, lower-cased keys
         self.lock = threading.Lock()
 
 
@@ -64,10 +71,37 @@ def make_stub(state: StubState):
                     "draining": state.draining,
                     "queue_depth": 0, "busy_slots": 0,
                     "build": {"version": state.version},
+                    "clock": {
+                        "monotonic_s": time.monotonic() + state.clock_skew,
+                        "trace_epoch_s": state.trace_epoch,
+                    },
                 })
             elif self.path == "/v1/models":
                 self._json(200, {"object": "list",
                                  "data": [{"id": state.model}]})
+            elif self.path == "/metrics":
+                text = state.metrics_text or (
+                    "# HELP dllama_stub_requests_total bodies served\n"
+                    "# TYPE dllama_stub_requests_total counter\n"
+                    f"dllama_stub_requests_total {len(state.served)}\n")
+                data = text.encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            elif self.path == "/debug/trace":
+                if state.trace_export is None:
+                    self._json(404, {"error": {"message": "tracing off"}})
+                else:
+                    self._json(200, state.trace_export)
+            elif self.path.startswith("/debug/requests/"):
+                tl = state.timelines.get(self.path.rsplit("/", 1)[1])
+                if tl is None:
+                    self._json(404, {"error": {"message": "unknown"}})
+                else:
+                    self._json(200, tl)
             else:
                 self._json(404, {"error": {"message": "nope"}})
 
@@ -76,6 +110,8 @@ def make_stub(state: StubState):
             body = json.loads(self.rfile.read(length) or b"{}")
             with state.lock:
                 state.served.append(body)
+                state.header_log.append(
+                    {k.lower(): v for k, v in self.headers.items()})
             if state.saturated:
                 self._json(429, {"error": {"message": "queue full"}},
                            {"Retry-After": "3"})
@@ -173,10 +209,10 @@ def mesh():
             pass
 
 
-def rpost(port, path, body, timeout=30):
+def rpost(port, path, body, timeout=30, headers=None):
     conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
     conn.request("POST", path, json.dumps(body),
-                 {"Content-Type": "application/json"})
+                 dict({"Content-Type": "application/json"}, **(headers or {})))
     resp = conn.getresponse()
     data = resp.read()
     headers = dict(resp.getheaders())
@@ -567,6 +603,72 @@ def real_mesh(tmp_path_factory):
             httpd.server_close()
         except OSError:
             pass
+
+
+def test_real_mesh_trace_propagation_and_postmortem(real_mesh):
+    """ISSUE 17 e2e over real engines: the router mints ONE trace id for a
+    proxied request, the replica adopts it from the X-Dllama-Trace hop
+    header into its flight recorder, GET /router/trace merges both
+    processes' spans under that id on one clock-aligned timeline, and
+    GET /router/requests/{id} joins the router's routing record with the
+    replica's own timeline.  Runs BEFORE the failover drill below — that
+    one kills a replica for good (module-scoped mesh)."""
+    from tests.test_metrics import parse_exposition
+
+    port, router, servers = real_mesh
+    for rep in router.replicas:
+        router._poll_one(rep)  # poll_s=30: capture clock + trace epoch now
+    rid = "req-obs-e2e-1"
+    st, data, headers = rpost(
+        port, "/v1/chat/completions",
+        {"messages": [{"role": "user", "content": "trace me"}],
+         "max_tokens": 4, "temperature": 0.0},
+        headers={"X-Request-Id": rid})
+    assert st == 200, data
+
+    # cross-hop postmortem: router journal joined with the replica timeline
+    st, data = rget(port, f"/router/requests/{rid}")
+    assert st == 200, data
+    pm = json.loads(data)
+    tid = pm["trace_id"]
+    assert tid and len(tid) == 16 and tid != rid
+    assert pm["router"]["outcome"] == "ok"
+    assert [a["kind"] for a in pm["router"]["attempts"]] == ["forward"]
+    serving = pm["router"]["attempts"][0]["replica"]
+    assert serving in {r.rid for r in router.replicas}
+    leg = pm["replicas"][serving]
+    assert leg["req_id"] == rid and leg["trace_id"] == tid
+    assert leg["state"] == "finished"
+
+    # merged mesh trace: both replicas merged, offsets aligned and tiny
+    # (same host), router + replica spans under the SAME trace id
+    st, data = rget(port, "/router/trace")
+    assert st == 200
+    merged = json.loads(data)
+    assert merged["otherData"]["replicas_merged"] == 2
+    clocks = merged["otherData"]["clock"]
+    assert set(clocks) == {r.rid for r in router.replicas}
+    for c in clocks.values():
+        assert c["aligned"] is True
+        assert abs(c["offset_s"]) <= max(c["uncertainty_s"], 0.25)
+    body = [e for e in merged["traceEvents"] if e.get("ph") != "M"]
+    assert [e["ts"] for e in body] == sorted(e["ts"] for e in body)
+    traced = [e for e in body if e.get("args", {}).get("trace_id") == tid]
+    pids = {e["pid"] for e in traced}
+    assert 1 in pids and any(p > 1 for p in pids), pids
+    names = {e["name"] for e in traced}
+    assert "connect" in names        # the router's own leg
+    assert "request" in names        # the replica's span joined the trace
+
+    # federation: one grammar-clean exposition with replica-labeled series
+    # and pre-aggregated fleet counters
+    st, data = rget(port, "/router/metrics")
+    assert st == 200
+    fams, samples = parse_exposition(data.decode())
+    assert fams["dllama_fleet_requests_finished_total"] == "counter"
+    assert any(n == "dllama_requests_finished_total"
+               and f'replica="{serving}"' in lbl
+               for (n, lbl) in samples)
 
 
 def test_real_mesh_affinity_and_failover(real_mesh):

